@@ -1,0 +1,104 @@
+"""Training CLI — the one entry point replacing every per-model ``train.py``.
+
+Parity with ``python train.py -m <model> [-c]`` (ResNet/pytorch/train.py:541-562)
+plus dataset/workdir flags that the reference hard-coded per directory.
+
+Usage:
+    python -m deep_vision_tpu.cli.train -m lenet5 --data-root ~/mnist
+    python -m deep_vision_tpu.cli.train -m lenet5 --synthetic --epochs 2
+    python -m deep_vision_tpu.cli.train -m resnet50 --resume
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="deep_vision_tpu trainer")
+    p.add_argument("-m", "--model", required=True,
+                   help="config name (see --list)")
+    p.add_argument("--data-root", default=None, help="dataset directory")
+    p.add_argument("--synthetic", action="store_true",
+                   help="synthetic data smoke run (no dataset needed)")
+    p.add_argument("--synthetic-size", type=int, default=1024)
+    p.add_argument("-c", "--resume", action="store_true",
+                   help="resume from latest checkpoint in workdir")
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--epochs", type=int, default=None, help="override config")
+    p.add_argument("--batch-size", type=int, default=None, help="override config")
+    p.add_argument("--mesh", default=None,
+                   help="mesh spec like 'data=8' or 'data=4,model=2'")
+    p.add_argument("--list", action="store_true", help="list configs and exit")
+    return p
+
+
+def parse_mesh_spec(spec: str | None):
+    from deep_vision_tpu.parallel import make_mesh
+
+    if spec is None:
+        return make_mesh()
+    sizes = {}
+    for part in spec.split(","):
+        k, v = part.split("=")
+        sizes[k.strip()] = int(v)
+    return make_mesh(sizes)
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+
+    from deep_vision_tpu.core.config import get_config, list_configs
+
+    if args.list:
+        print("\n".join(list_configs()))
+        return 0
+
+    cfg = get_config(args.model)
+    if args.epochs is not None:
+        cfg.total_epochs = args.epochs
+    if args.batch_size is not None:
+        cfg.batch_size = args.batch_size
+
+    from deep_vision_tpu.core.trainer import Trainer
+    from deep_vision_tpu.data.loader import ArrayLoader
+    from deep_vision_tpu.tasks.classification import ClassificationTask
+
+    mesh = parse_mesh_spec(args.mesh)
+    print(f"devices: {mesh.devices.ravel().tolist()} mesh={dict(mesh.shape)}")
+
+    if cfg.task != "classification":
+        raise NotImplementedError(
+            f"task '{cfg.task}' CLI wiring lands with its stack")
+
+    task = ClassificationTask(cfg.num_classes, cfg.label_smoothing)
+
+    if args.synthetic:
+        from deep_vision_tpu.data.mnist import synthetic_mnist
+
+        if cfg.image_size != 32:
+            raise NotImplementedError("synthetic data is MNIST-shaped for now")
+        train_data = synthetic_mnist(args.synthetic_size, seed=1)
+        val_data = synthetic_mnist(max(args.synthetic_size // 4, cfg.batch_size),
+                                   seed=2)
+    elif args.model == "lenet5":
+        from deep_vision_tpu.data.mnist import load_mnist
+
+        assert args.data_root, "--data-root required without --synthetic"
+        train_data = load_mnist(args.data_root, "train")
+        val_data = load_mnist(args.data_root, "test")
+    else:
+        raise NotImplementedError("ImageNet pipeline lands in the next slice")
+
+    train_loader = ArrayLoader(train_data, cfg.batch_size, seed=cfg.seed)
+    val_loader = ArrayLoader(val_data, cfg.eval_batch_size, shuffle=False)
+
+    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir)
+    state = trainer.fit(train_loader, val_loader, resume=args.resume)
+    final = trainer.evaluate(state, val_loader)
+    print("final:", " ".join(f"{k}={v:.4f}" for k, v in final.items()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

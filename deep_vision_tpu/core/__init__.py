@@ -1,0 +1,4 @@
+from deep_vision_tpu.core.state import TrainState
+from deep_vision_tpu.core.trainer import Trainer
+
+__all__ = ["TrainState", "Trainer"]

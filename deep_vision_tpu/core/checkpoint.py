@@ -1,0 +1,70 @@
+"""Checkpoint/resume via Orbax.
+
+One mechanism replacing the reference's four (see state.py docstring).
+Payload = ``state.save_dict()`` + host-side extras (epoch, scheduler state,
+metric history) so a resumed run continues the LR schedule and logger series
+exactly like the reference's ``-c`` flag (ResNet/pytorch/train.py:293-307).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import jax
+import orbax.checkpoint as ocp
+
+from deep_vision_tpu.core.state import TrainState
+
+
+class Checkpointer:
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: TrainState, extras: dict | None = None,
+             force: bool = False):
+        """``extras`` must be JSON-serializable (epoch, scheduler, history)."""
+        payload = {"state": state.save_dict()}
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(payload),
+                extras=ocp.args.JsonSave(extras or {}),
+            ),
+            force=force,
+        )
+        self._mgr.wait_until_finished()
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, state: TrainState, step: int | None = None
+                ) -> tuple[TrainState, dict]:
+        """Restore into the structure of a freshly-initialized ``state``."""
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct, {"state": state.save_dict()}
+        )
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract),
+                extras=ocp.args.JsonRestore(),
+            ),
+        )
+        new_state = state.load_dict(restored["state"]["state"])
+        return new_state, dict(restored["extras"] or {})
+
+    def close(self):
+        self._mgr.close()

@@ -1,0 +1,82 @@
+"""Metric history + logging.
+
+Replaces the reference's three observability paths with one: the in-memory
+``loggers`` dict-of-series that rode inside checkpoints
+(ResNet/pytorch/train.py:260-285), per-epoch pickles
+(ResNet/tensorflow/train.py:81-144), and per-batch stdout prints
+(ResNet/pytorch/train.py:472-485).  History is a plain dict (checkpointable),
+mirrored to a JSONL file for offline plotting (TensorBoard-free).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Mapping
+
+
+class MetricLogger:
+    def __init__(self, workdir: str | None = None, filename: str = "metrics.jsonl"):
+        self.history: dict[str, dict[str, list]] = {}
+        self._path = None
+        if workdir is not None:
+            os.makedirs(workdir, exist_ok=True)
+            self._path = os.path.join(workdir, filename)
+
+    def log(self, name: str, step: int, value: float):
+        series = self.history.setdefault(name, {"steps": [], "values": []})
+        series["steps"].append(int(step))
+        series["values"].append(float(value))
+        if self._path:
+            with open(self._path, "a") as f:
+                f.write(json.dumps({"name": name, "step": int(step),
+                                    "value": float(value), "time": time.time()}) + "\n")
+
+    def log_dict(self, step: int, metrics: Mapping[str, float]):
+        for k, v in metrics.items():
+            self.log(k, step, v)
+
+    def latest(self, name: str) -> float | None:
+        s = self.history.get(name)
+        return s["values"][-1] if s and s["values"] else None
+
+    def best(self, name: str, mode: str = "max") -> float | None:
+        s = self.history.get(name)
+        if not s or not s["values"]:
+            return None
+        return max(s["values"]) if mode == "max" else min(s["values"])
+
+    def state_dict(self) -> dict:
+        return self.history
+
+    def load_state_dict(self, d: dict):
+        self.history = {k: {"steps": list(v["steps"]), "values": list(v["values"])}
+                        for k, v in d.items()}
+
+
+class ThroughputMeter:
+    """Images/sec with warmup exclusion — the reference printed this per-100
+    batches (YOLO/tensorflow/train.py:217-223)."""
+
+    def __init__(self, warmup_steps: int = 2):
+        self.warmup_steps = warmup_steps
+        self.reset()
+
+    def reset(self):
+        self._n = 0
+        self._images = 0
+        self._start = None
+
+    def update(self, batch_size: int):
+        self._n += 1
+        if self._n == self.warmup_steps:
+            self._start = time.perf_counter()
+        elif self._n > self.warmup_steps:
+            self._images += batch_size
+
+    @property
+    def images_per_sec(self) -> float:
+        if self._start is None or self._images == 0:
+            return 0.0
+        return self._images / (time.perf_counter() - self._start)

@@ -1,0 +1,65 @@
+"""Training state: the single checkpointable unit.
+
+Unifies the reference's four checkpoint payloads —
+``{epoch, model, optimizer, scheduler, loggers}`` torch dict
+(ResNet/pytorch/train.py:422-428), Keras HDF5 full-model
+(ResNet/tensorflow/train.py:65-78), ``save_weights``
+(YOLO/tensorflow/train.py:252-257) and object-graph ``tf.train.Checkpoint``
+(CycleGAN/tensorflow/train.py:133-148) — into one pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import core, struct
+
+
+class TrainState(struct.PyTreeNode):
+    """Immutable train state; ``apply_fn``/``tx`` are static (not saved)."""
+
+    step: jax.Array
+    params: core.FrozenDict[str, Any] | dict
+    opt_state: optax.OptState
+    batch_stats: core.FrozenDict[str, Any] | dict  # {} for BN-free models
+    rng: jax.Array
+    apply_fn: Callable = struct.field(pytree_node=False)
+    tx: optax.GradientTransformation = struct.field(pytree_node=False)
+
+    def apply_gradients(self, grads, **changes) -> "TrainState":
+        updates, new_opt_state = self.tx.update(grads, self.opt_state, self.params)
+        new_params = optax.apply_updates(self.params, updates)
+        return self.replace(
+            step=self.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            **changes,
+        )
+
+    @classmethod
+    def create(cls, *, apply_fn, params, tx, batch_stats=None, rng=None) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=tx.init(params),
+            batch_stats=batch_stats if batch_stats is not None else {},
+            rng=rng if rng is not None else jax.random.PRNGKey(0),
+            apply_fn=apply_fn,
+            tx=tx,
+        )
+
+    # --- checkpoint payload (pure arrays, no callables) -------------------
+    def save_dict(self) -> dict:
+        return {
+            "step": self.step,
+            "params": self.params,
+            "opt_state": self.opt_state,
+            "batch_stats": self.batch_stats,
+            "rng": self.rng,
+        }
+
+    def load_dict(self, payload: dict) -> "TrainState":
+        return self.replace(**payload)

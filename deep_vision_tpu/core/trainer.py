@@ -1,0 +1,231 @@
+"""The unified Trainer.
+
+One trainer replacing the reference's three generations (SURVEY §1): the
+PyTorch imperative loop (``run_epochs``/``train``/``validate``,
+ResNet/pytorch/train.py:310-520), TF1-Keras ``model.fit``
+(ResNet/tensorflow/train.py:221-297), and TF2 MirroredStrategy custom loops
+(YOLO/tensorflow/train.py:122-250).
+
+TPU mapping:
+- the whole train step (forward, loss, backward, optimizer) is ONE jitted
+  function with donated state — XLA fuses elementwise ops into the conv/matmul
+  MXU kernels and inserts the data-parallel gradient all-reduce from the
+  batch's ``data``-axis sharding (GSPMD), the psum the reference got from NCCL
+  inside DataParallel/MirroredStrategy;
+- metrics come back as device scalars, fetched asynchronously so the host
+  epoch loop (LR plateau logic, best-val checkpointing — the reference's
+  host-side callbacks) never stalls the device pipeline;
+- eval accumulates metric *sums* on device and normalizes on host, like the
+  reference's running ``total_correct/total`` counters
+  (ResNet/pytorch/train.py:488-520).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import Any, Iterable
+
+import jax
+import jax.numpy as jnp
+
+from deep_vision_tpu.core import checkpoint as ckpt_lib
+from deep_vision_tpu.core.config import TrainConfig
+from deep_vision_tpu.core.metrics import MetricLogger, ThroughputMeter
+from deep_vision_tpu.core.optim import (
+    build_optimizer,
+    build_scheduler,
+    get_learning_rate,
+    set_learning_rate,
+)
+from deep_vision_tpu.core.state import TrainState
+from deep_vision_tpu.parallel import make_mesh, replicate, shard_batch
+
+
+class Trainer:
+    """Single-model/single-optimizer trainer (classification, detection,
+    pose).  Adversarial multi-model training lives in
+    :class:`deep_vision_tpu.core.adversarial.AdversarialTrainer`."""
+
+    def __init__(self, config: TrainConfig, model, task, mesh=None,
+                 workdir: str | None = None):
+        self.config = config
+        self.model = model
+        self.task = task
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.workdir = workdir or os.path.join("runs", config.name)
+        self.logger = MetricLogger(self.workdir)
+        self.tx = build_optimizer(config.optimizer)
+        self.scheduler = build_scheduler(
+            config.scheduler.name, config.optimizer.learning_rate,
+            **config.scheduler.kwargs)
+        self.checkpointer = ckpt_lib.Checkpointer(
+            os.path.join(self.workdir, "checkpoints"),
+            max_to_keep=config.keep_checkpoints)
+        self._has_bn: bool | None = None
+        self._jit_train_step = None
+        self._jit_eval_step = None
+        self.start_epoch = 1
+
+    # ------------------------------------------------------------------ init
+
+    def init_state(self, sample_batch: dict) -> TrainState:
+        rng = jax.random.PRNGKey(self.config.seed)
+        init_rng, state_rng = jax.random.split(rng)
+        image = jnp.asarray(sample_batch["image"][:1])
+        variables = jax.jit(
+            functools.partial(self.model.init, train=False)
+        )({"params": init_rng, "dropout": init_rng}, image)
+        params = variables["params"]
+        batch_stats = variables.get("batch_stats", {})
+        self._has_bn = "batch_stats" in variables
+        state = TrainState.create(
+            apply_fn=self.model.apply, params=params, tx=self.tx,
+            batch_stats=batch_stats, rng=state_rng)
+        return replicate(state, self.mesh)
+
+    def maybe_resume(self, state: TrainState) -> TrainState:
+        """Resume from the latest checkpoint if one exists (the reference's
+        ``-c`` flag, ResNet/pytorch/train.py:381-388)."""
+        if self.checkpointer.latest_step() is None:
+            return state
+        state, extras = self.checkpointer.restore(state)
+        self.start_epoch = int(extras.get("epoch", 0)) + 1
+        if "scheduler" in extras:
+            self.scheduler.load_state_dict(extras["scheduler"])
+        if "history" in extras:
+            self.logger.load_state_dict(extras["history"])
+        print(f"[resume] restored step={int(state.step)} "
+              f"start_epoch={self.start_epoch}")
+        return replicate(state, self.mesh)
+
+    # ------------------------------------------------------------- jit steps
+
+    def _build_steps(self):
+        task, has_bn = self.task, self._has_bn
+
+        def train_step(state: TrainState, batch: dict):
+            step_rng = jax.random.fold_in(state.rng, state.step)
+
+            def loss_fn(params):
+                variables = {"params": params}
+                if has_bn:
+                    variables["batch_stats"] = state.batch_stats
+                out = state.apply_fn(
+                    variables, batch["image"], train=True,
+                    rngs={"dropout": step_rng},
+                    mutable=["batch_stats"] if has_bn else False)
+                if has_bn:
+                    out, new_vars = out
+                    new_bs = new_vars["batch_stats"]
+                else:
+                    new_bs = state.batch_stats
+                loss, aux = task.loss(out, batch)
+                return loss, (new_bs, aux)
+
+            (loss, (new_bs, aux)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
+            new_state = state.apply_gradients(grads, batch_stats=new_bs)
+            metrics = {"loss": loss, **aux}
+            return new_state, metrics
+
+        def eval_step(state: TrainState, batch: dict):
+            variables = {"params": state.params}
+            if has_bn:
+                variables["batch_stats"] = state.batch_stats
+            out = state.apply_fn(variables, batch["image"], train=False)
+            return task.eval_metrics(out, batch)
+
+        self._jit_train_step = jax.jit(train_step, donate_argnums=0)
+        self._jit_eval_step = jax.jit(eval_step)
+
+    def train_step(self, state, batch):
+        if self._jit_train_step is None:
+            self._build_steps()
+        return self._jit_train_step(state, shard_batch(batch, self.mesh))
+
+    def eval_step(self, state, batch):
+        if self._jit_eval_step is None:
+            self._build_steps()
+        return self._jit_eval_step(state, shard_batch(batch, self.mesh))
+
+    # ------------------------------------------------------------------ loops
+
+    def evaluate(self, state: TrainState, val_data: Iterable) -> dict:
+        totals: dict[str, float] = {}
+        for batch in val_data:
+            sums = jax.device_get(self.eval_step(state, batch))
+            for k, v in sums.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+        count = max(totals.pop("count", 1.0), 1.0)
+        return {k: v / count for k, v in totals.items()}
+
+    def train_epoch(self, state: TrainState, train_data: Iterable,
+                    epoch: int) -> TrainState:
+        cfg = self.config
+        meter = ThroughputMeter()
+        pending = None  # async metric fetch: log step N-1 while N runs
+        for i, batch in enumerate(train_data):
+            bs = len(jax.tree_util.tree_leaves(batch)[0])
+            state, metrics = self.train_step(state, batch)
+            meter.update(bs)
+            if pending is not None and (i % cfg.log_every_steps == 0):
+                m = {k: float(v) for k, v in jax.device_get(pending).items()}
+                self.logger.log_dict(int(state.step) - 1,
+                                     {f"train_{k}": v for k, v in m.items()})
+                print(f"Epoch {epoch} Batch {i} loss {m['loss']:.4f} "
+                      f"lr {get_learning_rate(jax.device_get(state.opt_state)):.2e} "
+                      f"{meter.images_per_sec:.1f} img/s", flush=True)
+            pending = metrics
+        if pending is not None:
+            m = {k: float(v) for k, v in jax.device_get(pending).items()}
+            self.logger.log_dict(int(state.step),
+                                 {f"train_{k}": v for k, v in m.items()})
+        self.logger.log("images_per_sec", int(state.step), meter.images_per_sec)
+        return state
+
+    def fit(self, train_data, val_data=None, state: TrainState | None = None,
+            resume: bool = False, monitor: str | None = None) -> TrainState:
+        """The reference's ``run_epochs`` (ResNet/pytorch/train.py:310-428):
+        epoch loop of train → validate → scheduler.step(metric) → checkpoint."""
+        cfg = self.config
+        if state is None:
+            sample = next(iter(train_data))
+            state = self.init_state(sample)
+        if resume:
+            state = self.maybe_resume(state)
+        monitor = monitor or getattr(self.task, "monitor", None)
+        best = None
+        for epoch in range(self.start_epoch, cfg.total_epochs + 1):
+            lr = self.scheduler.lr
+            state = state.replace(
+                opt_state=set_learning_rate(state.opt_state, lr))
+            if hasattr(train_data, "set_epoch"):
+                train_data.set_epoch(epoch)
+            t0 = time.time()
+            state = self.train_epoch(state, train_data, epoch)
+            metric_val = None
+            if val_data is not None:
+                val_metrics = self.evaluate(state, val_data)
+                self.logger.log_dict(
+                    int(state.step),
+                    {f"val_{k}": v for k, v in val_metrics.items()})
+                if monitor is not None:
+                    metric_val = val_metrics.get(monitor)
+                print(f"Epoch {epoch} val "
+                      + " ".join(f"{k}={v:.4f}" for k, v in val_metrics.items())
+                      + f" ({time.time() - t0:.1f}s)", flush=True)
+            self.scheduler.step(epoch, metric_val)
+            if epoch % cfg.checkpoint_every_epochs == 0:
+                self.save(state, epoch)
+            if metric_val is not None and (best is None or metric_val > best):
+                best = metric_val
+        return state
+
+    def save(self, state: TrainState, epoch: int):
+        self.checkpointer.save(
+            int(jax.device_get(state.step)), state,
+            extras={"epoch": epoch,
+                    "scheduler": self.scheduler.state_dict(),
+                    "history": self.logger.state_dict()})

@@ -1,0 +1,3 @@
+from deep_vision_tpu.data.loader import ArrayLoader, prefetch_to_device
+
+__all__ = ["ArrayLoader", "prefetch_to_device"]

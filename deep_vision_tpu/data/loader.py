@@ -1,0 +1,81 @@
+"""Host-side batching + device prefetch.
+
+Replaces torch ``DataLoader(num_workers=16)`` (ResNet/pytorch/train.py:229-234)
+and ``tf.data`` prefetch/AUTOTUNE (YOLO/tensorflow/train.py:265-272) with
+numpy batching plus a background thread that ``device_put``s ahead of the
+compute stream (double buffering): while step N runs on the TPU, batch N+1 is
+already being transferred H2D, so HBM never waits on the host.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+
+from deep_vision_tpu.parallel import shard_batch
+
+
+class ArrayLoader:
+    """In-memory dict-of-arrays dataset → shuffled fixed-size batches.
+
+    The epoch-seeded reshuffle mirrors ``DataLoader(shuffle=True)``;
+    ``drop_last=True`` keeps shapes static for XLA (no recompiles).
+    """
+
+    def __init__(self, data: dict[str, np.ndarray], batch_size: int,
+                 shuffle: bool = True, drop_last: bool = True, seed: int = 0,
+                 transform: Callable[[dict, np.random.Generator], dict] | None = None):
+        self.data = data
+        n = len(next(iter(data.values())))
+        for k, v in data.items():
+            assert len(v) == n, f"length mismatch on '{k}'"
+        self.n = n
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+        self.transform = transform
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return self.n // self.batch_size
+        return (self.n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[dict]:
+        rng = np.random.default_rng(self.seed + self.epoch)
+        idx = rng.permutation(self.n) if self.shuffle else np.arange(self.n)
+        end = (self.n // self.batch_size) * self.batch_size if self.drop_last else self.n
+        for start in range(0, end, self.batch_size):
+            sel = idx[start:start + self.batch_size]
+            batch = {k: v[sel] for k, v in self.data.items()}
+            if self.transform is not None:
+                batch = self.transform(batch, rng)
+            yield batch
+
+
+def prefetch_to_device(iterable: Iterable, mesh, depth: int = 2) -> Iterator:
+    """Background-thread device_put pipeline (the double-buffer)."""
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    _END = object()
+
+    def producer():
+        try:
+            for item in iterable:
+                q.put(shard_batch(item, mesh))
+        finally:
+            q.put(_END)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    while True:
+        item = q.get()
+        if item is _END:
+            break
+        yield item

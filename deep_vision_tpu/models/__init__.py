@@ -1,0 +1,6 @@
+"""Flax model zoo — one module per family, NHWC, dtype-polymorphic (bf16
+compute on TPU, f32 params)."""
+
+from deep_vision_tpu.models.lenet import LeNet5
+
+__all__ = ["LeNet5"]

@@ -1,0 +1,62 @@
+"""Shared building blocks for the model zoo.
+
+Conventions (TPU-first):
+- NHWC layout, channels-last (XLA's native conv layout on TPU).
+- ``dtype`` = compute/activation dtype (bf16 for MXU throughput); params are
+  always float32 and cast at use (flax's ``param_dtype=float32`` default).
+- He/normal init matching the reference's explicit init where it has one
+  (ResNet/pytorch/models/resnet50.py:84-93 kaiming_normal fan_out).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+# kaiming_normal(fan_out) for ReLU nets, as the reference's He init.
+conv_kernel_init = nn.initializers.variance_scaling(2.0, "fan_out", "normal")
+
+
+class ConvBN(nn.Module):
+    """Conv → BatchNorm → (optional) activation.
+
+    BatchNorm semantics under the data-sharded mesh: the batch axis is a
+    single global axis under GSPMD jit, so batch statistics are *global*
+    (sync-BN) — stronger than the reference's implicit per-replica BN under
+    DataParallel (SURVEY §7 hard-part 3); documented here as a deliberate
+    choice.
+    """
+
+    features: int
+    kernel_size: Sequence[int] = (3, 3)
+    strides: Sequence[int] = (1, 1)
+    padding: Any = "SAME"
+    use_bias: bool = False
+    groups: int = 1
+    act: Callable | None = nn.relu
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, self.kernel_size, self.strides,
+                    padding=self.padding, use_bias=self.use_bias,
+                    feature_group_count=self.groups,
+                    kernel_init=conv_kernel_init, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=self.momentum,
+                         epsilon=self.epsilon, dtype=self.dtype)(x)
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
+def count_params(params) -> int:
+    return sum(x.size for x in jax.tree_util.tree_leaves(params))
+
+
+def global_avg_pool(x):
+    return jnp.mean(x, axis=(1, 2))

@@ -1,0 +1,38 @@
+"""LeNet-5 — parity with LeNet/pytorch/models/lenet5.py:14-67 and
+LeNet/tensorflow/models/lenet5.py:7-34.
+
+C1 conv6@5×5 → tanh → S2 avgpool2 → tanh → C3 conv16@5×5 → tanh →
+S4 avgpool2 → tanh → C5 conv120@5×5 → tanh → F6 dense84 → tanh → dense10.
+Input: 32×32×1 NHWC (MNIST padded 28→32).  61,706 params.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class LeNet5(nn.Module):
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(6, (5, 5), padding="VALID", dtype=self.dtype)(x)   # 32→28
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), (2, 2))                             # 28→14
+        x = nn.tanh(x)
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype)(x)  # 14→10
+        x = nn.tanh(x)
+        x = nn.avg_pool(x, (2, 2), (2, 2))                             # 10→5
+        x = nn.tanh(x)
+        x = nn.Conv(120, (5, 5), padding="VALID", dtype=self.dtype)(x)  # 5→1
+        x = nn.tanh(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(84, dtype=self.dtype)(x)
+        x = nn.tanh(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

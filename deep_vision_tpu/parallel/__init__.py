@@ -1,0 +1,19 @@
+from deep_vision_tpu.parallel.mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    make_mesh,
+    replicate,
+    shard_batch,
+    batch_sharding,
+    replicated_sharding,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "make_mesh",
+    "replicate",
+    "shard_batch",
+    "batch_sharding",
+    "replicated_sharding",
+]

@@ -1,0 +1,3 @@
+from deep_vision_tpu.tasks.classification import ClassificationTask
+
+__all__ = ["ClassificationTask"]

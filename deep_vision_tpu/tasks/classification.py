@@ -1,0 +1,60 @@
+"""Classification task: softmax cross-entropy + top-k accuracy.
+
+Mirrors the reference's ``nn.CrossEntropyLoss`` + ``accuracy(topk=(1,5))``
+(ResNet/pytorch/train.py:358, :524-538) and the Inception multi-head loss
+(aux classifiers weighted 0.3 — Inception/pytorch/train.py discounts per the
+GoogLeNet paper; model emits (logits, aux1, aux2) in training mode,
+Inception/pytorch/models/inception_v1.py:92-113).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+class ClassificationTask:
+    monitor = "top1"
+
+    def __init__(self, num_classes: int, label_smoothing: float = 0.0,
+                 aux_weight: float = 0.3):
+        self.num_classes = num_classes
+        self.label_smoothing = label_smoothing
+        self.aux_weight = aux_weight
+
+    def _xent(self, logits, labels):
+        logits = logits.astype(jnp.float32)
+        if self.label_smoothing > 0:
+            onehot = optax.smooth_labels(
+                jnp.eye(self.num_classes)[labels], self.label_smoothing)
+            return optax.softmax_cross_entropy(logits, onehot).mean()
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, labels).mean()
+
+    def loss(self, outputs, batch):
+        labels = batch["label"]
+        if isinstance(outputs, (tuple, list)):  # main + aux heads (Inception)
+            main, *aux = outputs
+            loss = self._xent(main, labels)
+            for a in aux:
+                loss = loss + self.aux_weight * self._xent(a, labels)
+            logits = main
+        else:
+            loss = self._xent(outputs, labels)
+            logits = outputs
+        top1 = (jnp.argmax(logits, -1) == labels).mean()
+        return loss, {"top1": top1}
+
+    def eval_metrics(self, outputs, batch):
+        logits = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+        logits = logits.astype(jnp.float32)
+        labels = batch["label"]
+        n = labels.shape[0]
+        xent = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
+        top1 = (jnp.argmax(logits, -1) == labels).sum()
+        k = min(5, logits.shape[-1])
+        topk_idx = jnp.argsort(logits, -1)[:, -k:]
+        top5 = (topk_idx == labels[:, None]).any(-1).sum()
+        return {"loss": xent.sum(), "top1": top1.astype(jnp.float32),
+                "top5": top5.astype(jnp.float32),
+                "count": jnp.asarray(n, jnp.float32)}

@@ -1,0 +1,4 @@
+"""Experiment zoo: registers a TrainConfig per model, replacing the
+reference's per-directory ``training_config`` dicts."""
+
+import deep_vision_tpu.zoo.lenet  # noqa: F401

@@ -1,0 +1,28 @@
+"""LeNet-5/MNIST experiment — config parity with
+LeNet/pytorch/train.py:15-32 (Adam lr=1e-3, batch 64, 50 epochs,
+ReduceLROnPlateau factor=0.1 mode='max')."""
+
+from deep_vision_tpu.core.config import (
+    OptimizerConfig,
+    SchedulerConfig,
+    TrainConfig,
+    register_config,
+)
+from deep_vision_tpu.models.lenet import LeNet5
+
+
+@register_config("lenet5")
+def lenet5() -> TrainConfig:
+    return TrainConfig(
+        name="lenet5",
+        model=lambda: LeNet5(),
+        task="classification",
+        batch_size=64,
+        total_epochs=50,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        scheduler=SchedulerConfig(
+            name="plateau", kwargs=dict(mode="max", factor=0.1, patience=10)),
+        half_precision=False,  # MNIST-scale; f32 is fine
+        image_size=32,
+        num_classes=10,
+    )

@@ -1,0 +1,33 @@
+"""Test env: 8 virtual CPU devices so pmap/pjit/mesh paths are exercised
+without a pod (SURVEY §4 implication (d))."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# The image's sitecustomize force-registers the TPU ('axon') platform and
+# overrides JAX_PLATFORMS, so pin CPU via config (must run before any
+# backend init).
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from deep_vision_tpu.parallel import make_mesh
+
+    return make_mesh({"data": 8})
+
+
+@pytest.fixture(scope="session")
+def mesh1():
+    from deep_vision_tpu.parallel import make_mesh
+
+    return make_mesh({"data": 1}, devices=jax.devices()[:1])

@@ -1,0 +1,29 @@
+"""Shape/param-count golden tests (SURVEY §4: the reference's torchsummary
+printouts are the spec)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deep_vision_tpu.models.common import count_params
+from deep_vision_tpu.models.lenet import LeNet5
+
+
+def test_lenet5_shapes_and_params():
+    model = LeNet5()
+    x = jnp.zeros((2, 32, 32, 1))
+    variables = model.init(jax.random.PRNGKey(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 10)
+    # classic LeNet-5: 156 + 2416 + 48120 + 10164 + 850
+    assert count_params(variables["params"]) == 61_706
+
+
+def test_lenet5_deterministic():
+    model = LeNet5()
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(4, 32, 32, 1)),
+                    jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x)
+    a = model.apply(variables, x)
+    b = model.apply(variables, x)
+    np.testing.assert_allclose(a, b)
