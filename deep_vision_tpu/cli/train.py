@@ -27,6 +27,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--workdir", default=None)
     p.add_argument("--epochs", type=int, default=None, help="override config")
     p.add_argument("--batch-size", type=int, default=None, help="override config")
+    p.add_argument("--image-size", type=int, default=None,
+                   help="override config (smoke runs at low res)")
     p.add_argument("--mesh", default=None,
                    help="mesh spec like 'data=8' or 'data=4,model=2'")
     p.add_argument("--list", action="store_true", help="list configs and exit")
@@ -58,7 +60,9 @@ def main(argv=None):
     if args.epochs is not None:
         cfg.total_epochs = args.epochs
     if args.batch_size is not None:
-        cfg.batch_size = args.batch_size
+        cfg.batch_size = cfg.eval_batch_size = args.batch_size
+    if args.image_size is not None:
+        cfg.image_size = args.image_size
 
     from deep_vision_tpu.core.trainer import Trainer
     from deep_vision_tpu.data.loader import ArrayLoader
@@ -74,13 +78,14 @@ def main(argv=None):
     task = ClassificationTask(cfg.num_classes, cfg.label_smoothing)
 
     if args.synthetic:
-        from deep_vision_tpu.data.mnist import synthetic_mnist
+        from deep_vision_tpu.data.synthetic import synthetic_classification
 
-        if cfg.image_size != 32:
-            raise NotImplementedError("synthetic data is MNIST-shaped for now")
-        train_data = synthetic_mnist(args.synthetic_size, seed=1)
-        val_data = synthetic_mnist(max(args.synthetic_size // 4, cfg.batch_size),
-                                   seed=2)
+        train_data = synthetic_classification(
+            args.synthetic_size, cfg.image_size, cfg.channels,
+            cfg.num_classes, seed=1)
+        val_data = synthetic_classification(
+            max(args.synthetic_size // 4, cfg.batch_size), cfg.image_size,
+            cfg.channels, cfg.num_classes, seed=2)
     elif args.model == "lenet5":
         from deep_vision_tpu.data.mnist import load_mnist
 
@@ -91,7 +96,8 @@ def main(argv=None):
         raise NotImplementedError("ImageNet pipeline lands in the next slice")
 
     train_loader = ArrayLoader(train_data, cfg.batch_size, seed=cfg.seed)
-    val_loader = ArrayLoader(val_data, cfg.eval_batch_size, shuffle=False)
+    val_loader = ArrayLoader(val_data, cfg.eval_batch_size, shuffle=False,
+                             drop_last=False, pad_last=True)
 
     trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir)
     state = trainer.fit(train_loader, val_loader, resume=args.resume)

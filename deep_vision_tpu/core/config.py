@@ -35,6 +35,7 @@ class TrainConfig:
     label_smoothing: float = 0.0
     half_precision: bool = True  # bf16 activations/compute on TPU
     image_size: int = 224
+    channels: int = 3
     num_classes: int = 1000
     checkpoint_every_epochs: int = 1
     keep_checkpoints: int = 3

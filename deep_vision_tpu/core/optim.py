@@ -92,11 +92,17 @@ def set_learning_rate(opt_state, lr: float):
 
 
 class Scheduler:
-    """Base: call ``step(epoch, metric)`` after each epoch; read ``.lr``."""
+    """Base contract: ``epoch_begin(epoch)`` fixes the LR used *during*
+    ``epoch`` (1-indexed) — so warmup applies to the very first epoch;
+    ``step(epoch, metric)`` runs after validation for metric-driven
+    schedules (plateau).  Read ``.lr``."""
 
     def __init__(self, base_lr: float):
         self.base_lr = base_lr
         self.lr = base_lr
+
+    def epoch_begin(self, epoch: int) -> float:
+        return self.lr
 
     def step(self, epoch: int, metric: float | None = None) -> float:
         return self.lr
@@ -152,14 +158,20 @@ class EpochTableSchedule(Scheduler):
     (YOLO/tensorflow/train.py:56-68: {0:1e-3, 40:1e-4, ...})."""
 
     def __init__(self, table: dict[int, float]):
-        self.table = dict(sorted(table.items()))
+        self.table = {int(k): v for k, v in sorted(table.items())}
         super().__init__(next(iter(self.table.values())))
 
-    def step(self, epoch, metric=None):
-        for boundary, lr in self.table.items():
+    def epoch_begin(self, epoch):
+        for boundary, lr in sorted(self.table.items()):
             if epoch >= boundary:
                 self.lr = lr
         return self.lr
+
+    def load_state_dict(self, d: dict):
+        # JSON round-trips stringify int keys; restore them
+        d = dict(d)
+        d["table"] = {int(k): v for k, v in d["table"].items()}
+        self.__dict__.update(d)
 
 
 class LinearDecay(Scheduler):
@@ -170,11 +182,11 @@ class LinearDecay(Scheduler):
         super().__init__(base_lr)
         self.total_epochs, self.decay_start = total_epochs, decay_start
 
-    def step(self, epoch, metric=None):
+    def epoch_begin(self, epoch):
         if epoch <= self.decay_start:
             self.lr = self.base_lr
         else:
-            frac = (epoch - self.decay_start) / max(
+            frac = (epoch - 1 - self.decay_start) / max(
                 1, self.total_epochs - self.decay_start
             )
             self.lr = self.base_lr * max(0.0, 1.0 - frac)
@@ -192,13 +204,14 @@ class WarmupCosine(Scheduler):
         self.total_epochs, self.warmup_epochs = total_epochs, warmup_epochs
         self.final_lr = final_lr
 
-    def step(self, epoch, metric=None):
+    def epoch_begin(self, epoch):
         import math
 
-        if epoch < self.warmup_epochs:
-            self.lr = self.base_lr * (epoch + 1) / self.warmup_epochs
+        if epoch <= self.warmup_epochs:
+            # ramp base·(1/w) … base·(w/w) over the first w epochs
+            self.lr = self.base_lr * epoch / self.warmup_epochs
         else:
-            t = (epoch - self.warmup_epochs) / max(
+            t = (epoch - 1 - self.warmup_epochs) / max(
                 1, self.total_epochs - self.warmup_epochs
             )
             self.lr = self.final_lr + 0.5 * (self.base_lr - self.final_lr) * (

@@ -36,7 +36,6 @@ from deep_vision_tpu.core.metrics import MetricLogger, ThroughputMeter
 from deep_vision_tpu.core.optim import (
     build_optimizer,
     build_scheduler,
-    get_learning_rate,
     set_learning_rate,
 )
 from deep_vision_tpu.core.state import TrainState
@@ -63,6 +62,8 @@ class Trainer:
         self.checkpointer = ckpt_lib.Checkpointer(
             os.path.join(self.workdir, "checkpoints"),
             max_to_keep=config.keep_checkpoints)
+        self.best_checkpointer = ckpt_lib.Checkpointer(
+            os.path.join(self.workdir, "checkpoints_best"), max_to_keep=1)
         self._has_bn: bool | None = None
         self._jit_train_step = None
         self._jit_eval_step = None
@@ -175,7 +176,7 @@ class Trainer:
                 self.logger.log_dict(int(state.step) - 1,
                                      {f"train_{k}": v for k, v in m.items()})
                 print(f"Epoch {epoch} Batch {i} loss {m['loss']:.4f} "
-                      f"lr {get_learning_rate(jax.device_get(state.opt_state)):.2e} "
+                      f"lr {self.scheduler.lr:.2e} "
                       f"{meter.images_per_sec:.1f} img/s", flush=True)
             pending = metrics
         if pending is not None:
@@ -198,7 +199,9 @@ class Trainer:
         monitor = monitor or getattr(self.task, "monitor", None)
         best = None
         for epoch in range(self.start_epoch, cfg.total_epochs + 1):
-            lr = self.scheduler.lr
+            # LR for THIS epoch (so warmup covers epoch 1); plateau-style
+            # metric schedules adjust in scheduler.step() after validation.
+            lr = self.scheduler.epoch_begin(epoch)
             state = state.replace(
                 opt_state=set_learning_rate(state.opt_state, lr))
             if hasattr(train_data, "set_epoch"):
@@ -220,7 +223,13 @@ class Trainer:
             if epoch % cfg.checkpoint_every_epochs == 0:
                 self.save(state, epoch)
             if metric_val is not None and (best is None or metric_val > best):
+                # best-val checkpoint, kept separately from the rolling window
+                # (the reference's save-best-by-val, YOLO/tensorflow/train.py:243-247)
                 best = metric_val
+                self.best_checkpointer.save(
+                    int(jax.device_get(state.step)), state,
+                    extras={"epoch": epoch, "metric": float(metric_val),
+                            "monitor": monitor or ""})
         return state
 
     def save(self, state: TrainState, epoch: int):

@@ -27,6 +27,7 @@ class ArrayLoader:
 
     def __init__(self, data: dict[str, np.ndarray], batch_size: int,
                  shuffle: bool = True, drop_last: bool = True, seed: int = 0,
+                 pad_last: bool = False,
                  transform: Callable[[dict, np.random.Generator], dict] | None = None):
         self.data = data
         n = len(next(iter(data.values())))
@@ -36,6 +37,7 @@ class ArrayLoader:
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
+        self.pad_last = pad_last
         self.seed = seed
         self.epoch = 0
         self.transform = transform
@@ -54,7 +56,17 @@ class ArrayLoader:
         end = (self.n // self.batch_size) * self.batch_size if self.drop_last else self.n
         for start in range(0, end, self.batch_size):
             sel = idx[start:start + self.batch_size]
+            n_real = len(sel)
+            if self.pad_last and n_real < self.batch_size:
+                # pad to the static batch size (no XLA recompile, shard-safe)
+                # with weight=0 fillers so metrics ignore them
+                pad = np.zeros(self.batch_size - n_real, idx.dtype)
+                sel = np.concatenate([sel, pad])
             batch = {k: v[sel] for k, v in self.data.items()}
+            if self.pad_last:
+                weight = np.zeros(len(sel), np.float32)
+                weight[:n_real] = 1.0
+                batch["weight"] = weight
             if self.transform is not None:
                 batch = self.transform(batch, rng)
             yield batch

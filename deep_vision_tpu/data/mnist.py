@@ -65,13 +65,7 @@ def load_mnist(root: str, split: str = "train") -> dict[str, np.ndarray]:
 
 def synthetic_mnist(n: int = 512, seed: int = 0, num_classes: int = 10
                     ) -> dict[str, np.ndarray]:
-    """Learnable synthetic digits for smoke tests: class-dependent blobs."""
-    rng = np.random.default_rng(seed)
-    labels = rng.integers(0, num_classes, size=n).astype(np.int32)
-    images = rng.normal(0, 0.3, size=(n, 32, 32, 1)).astype(np.float32)
-    ys, xs = np.mgrid[0:32, 0:32]
-    for c in range(num_classes):
-        cy, cx = 6 + 2 * (c // 4), 6 + 2 * (c % 4) + 8
-        blob = np.exp(-(((ys - cy) ** 2 + (xs - cx) ** 2) / 18.0))
-        images[labels == c] += 2.0 * blob[..., None]
-    return {"image": images, "label": labels}
+    """Learnable synthetic digits for smoke tests (MNIST-shaped wrapper)."""
+    from deep_vision_tpu.data.synthetic import synthetic_classification
+
+    return synthetic_classification(n, 32, 1, num_classes, seed)

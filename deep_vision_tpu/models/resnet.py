@@ -1,0 +1,165 @@
+"""ResNet family — parity targets in /root/reference:
+
+- ResNet-34 V1: ResNet/pytorch/models/resnet34.py (BasicBlock, stages 3/4/6/3)
+- ResNet-50 V1: ResNet/pytorch/models/resnet50.py:96-165 (BottleneckBlock with
+  projection shortcut), ``_make_blocks`` :64-82, He fan_out init :84-93
+- ResNet-152 V1: ResNet/pytorch/models/resnet152.py (stages 3/8/36/3)
+- ResNet-50 V2: ResNet/tensorflow/models/resnet50v2.py:18-170 (pre-activation:
+  BN→ReLU before each conv, final BN→ReLU before pooling)
+
+TPU-first design notes:
+- NHWC + bf16 activations; params stay f32 (cast at use) so BN statistics and
+  the optimizer see full precision while the MXU runs bf16 matmuls.
+- The whole network is a static trace — stage loops unroll at trace time into
+  one XLA program; residual adds fuse into the conv epilogues.
+- stride-2 3×3 convs use explicit SAME padding; shapes stay static so XLA can
+  tile every conv onto the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Type
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from deep_vision_tpu.models.common import conv_kernel_init, global_avg_pool
+
+
+class BasicBlock(nn.Module):
+    """Two 3×3 convs + identity/projection shortcut (ResNet-18/34)."""
+
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, kernel_init=conv_kernel_init,
+                       dtype=self.dtype)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        shortcut = x
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        y = nn.relu(bn()(y))
+        y = conv(self.filters, (3, 3))(y)
+        # zero-init the last BN scale: residual branch starts as identity
+        # (the standard trick the 76% recipe needs; reference lacks it)
+        y = bn(scale_init=nn.initializers.zeros)(y)
+        if self.strides != 1 or x.shape[-1] != self.filters:
+            shortcut = conv(self.filters, (1, 1),
+                            (self.strides, self.strides))(shortcut)
+            shortcut = bn()(shortcut)
+        return nn.relu(y + shortcut)
+
+
+class BottleneckBlock(nn.Module):
+    """1×1 reduce → 3×3 → 1×1 expand (×4), projection on stage entry —
+    the reference's BottleneckBlock (ResNet/pytorch/models/resnet50.py:96-165)."""
+
+    filters: int  # bottleneck width; output is 4×filters
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, kernel_init=conv_kernel_init,
+                       dtype=self.dtype)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        shortcut = x
+        y = nn.relu(bn()(conv(self.filters, (1, 1))(x)))
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = nn.relu(bn()(y))
+        y = conv(4 * self.filters, (1, 1))(y)
+        y = bn(scale_init=nn.initializers.zeros)(y)
+        if self.strides != 1 or x.shape[-1] != 4 * self.filters:
+            shortcut = conv(4 * self.filters, (1, 1),
+                            (self.strides, self.strides))(shortcut)
+            shortcut = bn()(shortcut)
+        return nn.relu(y + shortcut)
+
+
+class PreActBottleneckBlock(nn.Module):
+    """V2 pre-activation bottleneck (BN→ReLU→conv ×3) —
+    ResNet/tensorflow/models/resnet50v2.py:18-170."""
+
+    filters: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, kernel_init=conv_kernel_init,
+                       dtype=self.dtype)
+        bn = partial(nn.BatchNorm, use_running_average=not train,
+                     momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+        pre = nn.relu(bn()(x))
+        # projection sees the pre-activated input (He et al. 2016, fig 4e)
+        shortcut = x
+        if self.strides != 1 or x.shape[-1] != 4 * self.filters:
+            shortcut = conv(4 * self.filters, (1, 1),
+                            (self.strides, self.strides))(pre)
+        y = conv(self.filters, (1, 1))(pre)
+        y = nn.relu(bn()(y))
+        y = conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        y = nn.relu(bn()(y))
+        y = conv(4 * self.filters, (1, 1))(y)
+        return y + shortcut
+
+
+class ResNet(nn.Module):
+    """Generic ResNet: 7×7/2 stem → 3×3/2 maxpool → 4 stages → GAP → FC."""
+
+    stage_sizes: Sequence[int]
+    block_cls: Type[nn.Module] = BottleneckBlock
+    num_classes: int = 1000
+    preact: bool = False  # V2: final BN+ReLU after stages
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, kernel_init=conv_kernel_init,
+                    dtype=self.dtype)(x)                        # 224→112
+        if not self.preact:
+            x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                     momentum=0.9, epsilon=1e-5,
+                                     dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), (2, 2), padding=[(1, 1), (1, 1)])  # →56
+        for stage, num_blocks in enumerate(self.stage_sizes):
+            filters = 64 * 2 ** stage
+            for i in range(num_blocks):
+                strides = 2 if stage > 0 and i == 0 else 1
+                x = self.block_cls(
+                    filters=filters, strides=strides,
+                    dtype=self.dtype)(x, train=train)
+        if self.preact:
+            x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                     momentum=0.9, epsilon=1e-5,
+                                     dtype=self.dtype)(x))
+        x = global_avg_pool(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet34(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock,
+                  num_classes=num_classes, dtype=dtype)
+
+
+def ResNet50(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock,
+                  num_classes=num_classes, dtype=dtype)
+
+
+def ResNet152(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=(3, 8, 36, 3), block_cls=BottleneckBlock,
+                  num_classes=num_classes, dtype=dtype)
+
+
+def ResNet50V2(num_classes: int = 1000, dtype: Any = jnp.float32) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=PreActBottleneckBlock,
+                  num_classes=num_classes, preact=True, dtype=dtype)

@@ -80,6 +80,8 @@ def shard_batch(tree: Any, mesh: Mesh) -> Any:
     n_data = mesh.shape[DATA_AXIS]
 
     def _put(x):
+        if isinstance(x, jax.Array):  # already placed (e.g. prefetch thread)
+            return x
         x = np.asarray(x)
         if x.ndim == 0:
             return jax.device_put(x, replicated_sharding(mesh))

@@ -49,12 +49,12 @@ class ClassificationTask:
         logits = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
         logits = logits.astype(jnp.float32)
         labels = batch["label"]
-        n = labels.shape[0]
+        # weight=0 marks padded filler rows from pad_last loaders
+        w = batch.get("weight", jnp.ones(labels.shape[0], jnp.float32))
         xent = optax.softmax_cross_entropy_with_integer_labels(logits, labels)
-        top1 = (jnp.argmax(logits, -1) == labels).sum()
+        top1 = ((jnp.argmax(logits, -1) == labels) * w).sum()
         k = min(5, logits.shape[-1])
         topk_idx = jnp.argsort(logits, -1)[:, -k:]
-        top5 = (topk_idx == labels[:, None]).any(-1).sum()
-        return {"loss": xent.sum(), "top1": top1.astype(jnp.float32),
-                "top5": top5.astype(jnp.float32),
-                "count": jnp.asarray(n, jnp.float32)}
+        top5 = ((topk_idx == labels[:, None]).any(-1) * w).sum()
+        return {"loss": (xent * w).sum(), "top1": top1,
+                "top5": top5, "count": w.sum()}

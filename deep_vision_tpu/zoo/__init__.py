@@ -2,3 +2,4 @@
 reference's per-directory ``training_config`` dicts."""
 
 import deep_vision_tpu.zoo.lenet  # noqa: F401
+import deep_vision_tpu.zoo.resnet  # noqa: F401

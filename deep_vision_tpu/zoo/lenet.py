@@ -24,5 +24,6 @@ def lenet5() -> TrainConfig:
             name="plateau", kwargs=dict(mode="max", factor=0.1, patience=10)),
         half_precision=False,  # MNIST-scale; f32 is fine
         image_size=32,
+        channels=1,
         num_classes=10,
     )

@@ -1,0 +1,52 @@
+"""Shape/param-count golden tests for the ResNet family (SURVEY §4a: the
+reference's torchsummary printouts, ResNet/pytorch/train.py:350, are the spec;
+param counts match torchvision's canonical models)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deep_vision_tpu.models import resnet
+from deep_vision_tpu.models.common import count_params
+
+
+def _init(model, size=64):
+    x = jnp.zeros((1, size, size, 3), jnp.float32)
+    return model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+
+
+@pytest.mark.parametrize("ctor,expected", [
+    (resnet.ResNet34, 21_797_672),
+    (resnet.ResNet50, 25_557_032),
+    (resnet.ResNet152, 60_192_808),
+])
+def test_param_counts(ctor, expected):
+    variables = _init(ctor())
+    assert count_params(variables["params"]) == expected
+
+
+def test_resnet50v2_structure():
+    variables = _init(resnet.ResNet50V2())
+    n = count_params(variables["params"])
+    # V2 reorganizes BN (pre-activation) but stays bottleneck-50-sized
+    assert 25_000_000 < n < 26_000_000
+
+
+def test_forward_shapes_and_dtype():
+    model = resnet.ResNet50(num_classes=10, dtype=jnp.bfloat16)
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32  # logits always f32
+
+
+def test_train_mode_updates_batch_stats():
+    model = resnet.ResNet34(num_classes=10)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 64, 64, 3))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    _, new_vars = model.apply(variables, x, train=True, mutable=["batch_stats"])
+    old = jax.tree_util.tree_leaves(variables["batch_stats"])
+    new = jax.tree_util.tree_leaves(new_vars["batch_stats"])
+    assert any(not np.allclose(a, b) for a, b in zip(old, new))
