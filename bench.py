@@ -1,0 +1,84 @@
+"""Driver benchmark: ResNet-50 train-step throughput on the attached chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): the reference's only measured training throughput is
+~800 img/s aggregate on 8 GPUs (ResNet-34 log timestamps,
+ResNet/pytorch/logs/resnet34-yanjiali-010319.log) ⇒ ~100 img/s/chip; the
+driver metric is "ResNet-50 ILSVRC2012 images/sec/chip" so vs_baseline
+divides by 100.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_IMG_PER_SEC_PER_CHIP = 100.0
+
+
+def main():
+    from deep_vision_tpu.core.optim import OptimizerConfig, build_optimizer
+    from deep_vision_tpu.core.state import TrainState
+    from deep_vision_tpu.models.resnet import ResNet50
+    from deep_vision_tpu.tasks.classification import ClassificationTask
+
+    batch, size = 256, 224
+    model = ResNet50(dtype=jnp.bfloat16)
+    task = ClassificationTask(1000)
+    tx = build_optimizer(OptimizerConfig(
+        name="sgd", learning_rate=0.1, momentum=0.9, weight_decay=1e-4))
+
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.normal(rng, (batch, size, size, 3), jnp.float32)
+    y = jax.random.randint(rng, (batch,), 0, 1000)
+
+    variables = jax.jit(functools.partial(model.init, train=False))(
+        {"params": rng}, x[:1])
+    state = TrainState.create(
+        apply_fn=model.apply, params=variables["params"], tx=tx,
+        batch_stats=variables["batch_stats"], rng=rng)
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def train_step(state, image, label):
+        def loss_fn(params):
+            out, new_vars = state.apply_fn(
+                {"params": params, "batch_stats": state.batch_stats},
+                image, train=True, mutable=["batch_stats"])
+            loss, _ = task.loss(out, {"label": label})
+            return loss, new_vars["batch_stats"]
+
+        (loss, new_bs), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        return state.apply_gradients(grads, batch_stats=new_bs), loss
+
+    # compile + warmup (device_get, not block_until_ready: the latter can
+    # return early through the axon tunnel)
+    state, loss = train_step(state, x, y)
+    for _ in range(3):
+        state, loss = train_step(state, x, y)
+    float(jax.device_get(loss))
+
+    steps = 20
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, loss = train_step(state, x, y)
+    float(jax.device_get(loss))  # drains the async dispatch chain
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    img_per_sec_per_chip = steps * batch / dt / n_chips
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_per_sec_per_chip, 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -31,6 +31,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="override config (smoke runs at low res)")
     p.add_argument("--mesh", default=None,
                    help="mesh spec like 'data=8' or 'data=4,model=2'")
+    p.add_argument("--num-workers", type=int, default=16,
+                   help="decode/augment worker processes (ImageNet path)")
     p.add_argument("--list", action="store_true", help="list configs and exit")
     return p
 
@@ -71,6 +73,8 @@ def main(argv=None):
     mesh = parse_mesh_spec(args.mesh)
     print(f"devices: {mesh.devices.ravel().tolist()} mesh={dict(mesh.shape)}")
 
+    if cfg.task == "detection":
+        return _main_detection(args, cfg, mesh)
     if cfg.task != "classification":
         raise NotImplementedError(
             f"task '{cfg.task}' CLI wiring lands with its stack")
@@ -86,19 +90,71 @@ def main(argv=None):
         val_data = synthetic_classification(
             max(args.synthetic_size // 4, cfg.batch_size), cfg.image_size,
             cfg.channels, cfg.num_classes, seed=2)
+        train_loader = ArrayLoader(train_data, cfg.batch_size, seed=cfg.seed)
+        val_loader = ArrayLoader(val_data, cfg.eval_batch_size, shuffle=False,
+                                 drop_last=False, pad_last=True)
     elif args.model == "lenet5":
         from deep_vision_tpu.data.mnist import load_mnist
 
         assert args.data_root, "--data-root required without --synthetic"
         train_data = load_mnist(args.data_root, "train")
         val_data = load_mnist(args.data_root, "test")
+        train_loader = ArrayLoader(train_data, cfg.batch_size, seed=cfg.seed)
+        val_loader = ArrayLoader(val_data, cfg.eval_batch_size, shuffle=False,
+                                 drop_last=False, pad_last=True)
     else:
-        raise NotImplementedError("ImageNet pipeline lands in the next slice")
+        # ImageNet flattened-dir layout (Datasets/ILSVRC2012 prep output):
+        # <root>/train/, <root>/val/, <root>/imagenet_2012_metadata.txt
+        import os
 
-    train_loader = ArrayLoader(train_data, cfg.batch_size, seed=cfg.seed)
-    val_loader = ArrayLoader(val_data, cfg.eval_batch_size, shuffle=False,
-                             drop_last=False, pad_last=True)
+        from deep_vision_tpu.data.imagenet import ImageNetLoader
 
+        assert args.data_root, "--data-root required without --synthetic"
+        labels = os.path.join(args.data_root, "imagenet_2012_metadata.txt")
+        resize = max(cfg.image_size * 256 // 224, cfg.image_size + 8)
+        train_loader = ImageNetLoader(
+            os.path.join(args.data_root, "train"), labels, cfg.batch_size,
+            train=True, image_size=cfg.image_size, resize=resize,
+            num_workers=args.num_workers, seed=cfg.seed)
+        val_loader = ImageNetLoader(
+            os.path.join(args.data_root, "val"), labels, cfg.eval_batch_size,
+            train=False, image_size=cfg.image_size, resize=resize,
+            num_workers=args.num_workers)
+
+    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir)
+    state = trainer.fit(train_loader, val_loader, resume=args.resume)
+    final = trainer.evaluate(state, val_loader)
+    print("final:", " ".join(f"{k}={v:.4f}" for k, v in final.items()))
+    return 0
+
+
+def _main_detection(args, cfg, mesh):
+    from deep_vision_tpu.core.trainer import Trainer
+    from deep_vision_tpu.data.detection import (
+        DetectionLoader,
+        synthetic_detection_dataset,
+    )
+    from deep_vision_tpu.tasks.detection import YoloTask
+
+    task = YoloTask(cfg.num_classes)
+    if args.synthetic:
+        train_samples = synthetic_detection_dataset(
+            args.synthetic_size, cfg.image_size,
+            min(cfg.num_classes, 3), seed=1)
+        val_samples = synthetic_detection_dataset(
+            max(args.synthetic_size // 4, cfg.batch_size), cfg.image_size,
+            min(cfg.num_classes, 3), seed=2)
+    else:
+        from deep_vision_tpu.data.records import load_detection_records
+
+        assert args.data_root, "--data-root required without --synthetic"
+        train_samples = load_detection_records(args.data_root, "train")
+        val_samples = load_detection_records(args.data_root, "val")
+    train_loader = DetectionLoader(train_samples, cfg.batch_size,
+                                   cfg.num_classes, cfg.image_size,
+                                   train=True, seed=cfg.seed)
+    val_loader = DetectionLoader(val_samples, cfg.batch_size,
+                                 cfg.num_classes, cfg.image_size, train=False)
     trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir)
     state = trainer.fit(train_loader, val_loader, resume=args.resume)
     final = trainer.evaluate(state, val_loader)

@@ -31,6 +31,7 @@ class OptimizerConfig:
     b1: float = 0.9
     b2: float = 0.999
     eps: float = 1e-8
+    rms_decay: float = 0.9  # torch RMSprop 'alpha' (MobileNet config uses 0.9)
     grad_clip_norm: float | None = None
 
 
@@ -67,7 +68,8 @@ def build_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
             else:
                 txs.append(optax.adam(learning_rate, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps))
         elif cfg.name == "rmsprop":
-            txs.append(optax.rmsprop(learning_rate, momentum=cfg.momentum, eps=cfg.eps))
+            txs.append(optax.rmsprop(learning_rate, decay=cfg.rms_decay,
+                                     momentum=cfg.momentum, eps=cfg.eps))
         else:
             raise ValueError(f"unknown optimizer {cfg.name}")
         return optax.chain(*txs)
@@ -220,12 +222,49 @@ class WarmupCosine(Scheduler):
         return self.lr
 
 
+class StepDecay(Scheduler):
+    """torch ``StepLR``: lr = base·gamma^(epoch//step_size) — the reference's
+    VGG (step 10, γ=0.5) and MobileNet (step 2, γ=0.94, the Inception-V3
+    policy) configs (VGG/pytorch/train.py scheduler_params)."""
+
+    def __init__(self, base_lr, step_size: int, gamma: float):
+        super().__init__(base_lr)
+        self.step_size, self.gamma = step_size, gamma
+
+    def epoch_begin(self, epoch):
+        self.lr = self.base_lr * self.gamma ** ((epoch - 1) // self.step_size)
+        return self.lr
+
+
+class SqrtPolyDecay(Scheduler):
+    """The reference's Inception V1 LambdaLR policy
+    (Inception/pytorch/train.py scheduler_params): base·(1-e/horizon)^0.5
+    until ``horizon``, then fixed small multipliers."""
+
+    def __init__(self, base_lr, horizon: int = 60):
+        super().__init__(base_lr)
+        self.horizon = horizon
+
+    def epoch_begin(self, epoch):
+        e = epoch - 1
+        if e < self.horizon:
+            mult = (1 - e / self.horizon) ** 0.5
+        elif e < self.horizon + 15:
+            mult = 0.01
+        else:
+            mult = 0.001
+        self.lr = self.base_lr * mult
+        return self.lr
+
+
 SCHEDULERS = {
     "constant": ConstantSchedule,
     "plateau": ReduceLROnPlateau,
     "epoch_table": EpochTableSchedule,
     "linear_decay": LinearDecay,
     "warmup_cosine": WarmupCosine,
+    "step": StepDecay,
+    "sqrt_poly": SqrtPolyDecay,
 }
 
 

@@ -164,10 +164,14 @@ class Trainer:
 
     def train_epoch(self, state: TrainState, train_data: Iterable,
                     epoch: int) -> TrainState:
+        from deep_vision_tpu.data.loader import prefetch_to_device
+
         cfg = self.config
         meter = ThroughputMeter()
         pending = None  # async metric fetch: log step N-1 while N runs
-        for i, batch in enumerate(train_data):
+        # H2D double buffer: batch N+1 transfers while step N computes
+        # (shard_batch in train_step is a no-op on already-placed arrays)
+        for i, batch in enumerate(prefetch_to_device(train_data, self.mesh)):
             bs = len(jax.tree_util.tree_leaves(batch)[0])
             state, metrics = self.train_step(state, batch)
             meter.update(bs)

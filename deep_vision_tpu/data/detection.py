@@ -1,0 +1,155 @@
+"""Detection input pipeline — parity with YOLO/tensorflow/preprocess.py:
+bbox-preserving random flip (:37-50) and random crop (:52-119), resize to the
+model input size, then 3-scale grid label encoding
+(``tasks.detection.encode_labels``, the vectorized port of :137-224).
+
+Samples are dicts {"image": HWC uint8, "boxes": (N,4) normalized corner
+boxes, "classes": (N,) int}.  The loader emits static-shape batches:
+{"image": (B,S,S,3) f32, "y_true_0..2", "boxes", "boxes_mask"}.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from deep_vision_tpu.data.transforms import rescale
+from deep_vision_tpu.tasks.detection import encode_labels
+
+
+def flip_boxes_lr(boxes: np.ndarray) -> np.ndarray:
+    """(N,4) normalized corners (x1,y1,x2,y2) under horizontal flip."""
+    out = boxes.copy()
+    out[:, 0] = 1.0 - boxes[:, 2]
+    out[:, 2] = 1.0 - boxes[:, 0]
+    return out
+
+
+def random_crop_with_boxes(img: np.ndarray, boxes: np.ndarray,
+                           rng: np.random.Generator,
+                           min_keep: float = 0.3):
+    """Random crop keeping ≥1 box; boxes clipped into the crop, boxes whose
+    remaining area fraction < min_keep are dropped (preprocess.py:52-119
+    semantics without the tf.while retry loop: we sample a crop containing
+    all box centers)."""
+    h, w = img.shape[:2]
+    if len(boxes) == 0:
+        return img, boxes, np.zeros((0,), bool)
+    centers_x = (boxes[:, 0] + boxes[:, 2]) / 2 * w
+    centers_y = (boxes[:, 1] + boxes[:, 3]) / 2 * h
+    # crop bounds must include every center: sample within the slack
+    x1 = int(rng.integers(0, max(1, int(centers_x.min()) + 1)))
+    y1 = int(rng.integers(0, max(1, int(centers_y.min()) + 1)))
+    x2 = int(rng.integers(min(w - 1, int(np.ceil(centers_x.max()))), w)) + 1
+    y2 = int(rng.integers(min(h - 1, int(np.ceil(centers_y.max()))), h)) + 1
+    crop = img[y1:y2, x1:x2]
+    ch, cw = crop.shape[:2]
+    abs_boxes = boxes * [w, h, w, h]
+    shifted = abs_boxes - [x1, y1, x1, y1]
+    clipped = np.clip(shifted, 0, [cw, ch, cw, ch])
+    area = np.maximum(clipped[:, 2] - clipped[:, 0], 0) * \
+        np.maximum(clipped[:, 3] - clipped[:, 1], 0)
+    orig = (abs_boxes[:, 2] - abs_boxes[:, 0]) * (abs_boxes[:, 3] - abs_boxes[:, 1])
+    keep = area / np.maximum(orig, 1e-9) >= min_keep
+    return crop, (clipped / [cw, ch, cw, ch])[keep].astype(np.float32), keep
+
+
+def resize_square(img: np.ndarray, size: int) -> np.ndarray:
+    """Plain square resize (the reference resizes to 416² after crop)."""
+    from PIL import Image
+
+    return np.asarray(Image.fromarray(img).resize((size, size),
+                                                  Image.BILINEAR))
+
+
+def corners_to_xywh(boxes: np.ndarray) -> np.ndarray:
+    xy = (boxes[:, :2] + boxes[:, 2:4]) / 2
+    wh = boxes[:, 2:4] - boxes[:, :2]
+    return np.concatenate([xy, wh], axis=1)
+
+
+class DetectionLoader:
+    """Batch iterator over an in-memory/detection-record dataset.
+
+    ``samples``: sequence of dicts (see module docstring) or a callable
+    ``index -> sample`` plus ``length``.
+    """
+
+    def __init__(self, samples: Sequence[dict], batch_size: int,
+                 num_classes: int, image_size: int = 416,
+                 grids: Sequence[int] | None = None,
+                 train: bool = True, seed: int = 0, augment: bool = True):
+        self.samples = samples
+        self.batch_size = batch_size
+        self.num_classes = num_classes
+        self.image_size = image_size
+        self.grids = tuple(grids) if grids else (
+            image_size // 8, image_size // 16, image_size // 32)
+        self.train = train
+        self.seed = seed
+        self.augment = augment and train
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return len(self.samples) // self.batch_size
+
+    def _prepare(self, sample: dict, rng: np.random.Generator) -> dict:
+        img = sample["image"]
+        boxes = np.asarray(sample["boxes"], np.float32).reshape(-1, 4)
+        classes = np.asarray(sample["classes"], np.int64).reshape(-1)
+        if self.augment and len(boxes):
+            if rng.random() < 0.5:
+                img = img[:, ::-1]
+                boxes = flip_boxes_lr(boxes)
+            if rng.random() < 0.5:
+                img, boxes, keep = random_crop_with_boxes(img, boxes, rng)
+                classes = classes[keep]
+        img = resize_square(img, self.image_size)
+        x = img.astype(np.float32) / 255.0  # yolo uses [0,1] inputs
+        enc = encode_labels(corners_to_xywh(boxes), classes,
+                            self.num_classes, grids=self.grids)
+        return {"image": x, **enc}
+
+    def __iter__(self) -> Iterator[dict]:
+        rng = np.random.default_rng((self.seed, self.epoch))
+        idx = np.arange(len(self.samples))
+        if self.train:
+            rng.shuffle(idx)
+        for b in range(len(self)):
+            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            items = [self._prepare(self.samples[i], rng) for i in sel]
+            yield {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+
+def synthetic_detection_dataset(n: int, image_size: int = 416,
+                                num_classes: int = 3, seed: int = 0
+                                ) -> list[dict]:
+    """Learnable synthetic scenes: colored rectangles on noise, class =
+    color; the detection analog of ``synthetic_classification``."""
+    rng = np.random.default_rng(seed)
+    palette = rng.integers(64, 255, size=(num_classes, 3))
+    samples = []
+    for _ in range(n):
+        img = rng.integers(0, 64, size=(image_size, image_size, 3),
+                           dtype=np.uint8)
+        k = int(rng.integers(1, 4))
+        boxes, classes = [], []
+        for _ in range(k):
+            w = rng.uniform(0.15, 0.5)
+            h = rng.uniform(0.15, 0.5)
+            x1 = rng.uniform(0, 1 - w)
+            y1 = rng.uniform(0, 1 - h)
+            c = int(rng.integers(0, num_classes))
+            px = [int(x1 * image_size), int(y1 * image_size),
+                  int((x1 + w) * image_size), int((y1 + h) * image_size)]
+            img[px[1]:px[3], px[0]:px[2]] = palette[c]
+            boxes.append([x1, y1, x1 + w, y1 + h])
+            classes.append(c)
+        samples.append({"image": img,
+                        "boxes": np.asarray(boxes, np.float32),
+                        "classes": np.asarray(classes, np.int64)})
+    return samples

@@ -1,0 +1,169 @@
+"""ImageNet (ILSVRC2012) input pipeline.
+
+Dataset semantics mirror ``ImageNet2012Dataset``
+(ResNet/pytorch/data_load.py:14-69): a FLAT directory of JPEGs whose label is
+the synset prefix of the filename ("n02708093_7537.JPEG"), mapped to an index
+via the metadata file (one "synset name..." line per class —
+Datasets/ILSVRC2012/imagenet_2012_metadata.txt).
+
+TPU-first loader design (SURVEY §7 hard-part 1 — keep the chips fed from
+host Python):
+- files are sharded per HOST (``jax.process_index``) so a multi-host pod
+  never reads the same image twice per epoch;
+- a multiprocess worker pool decodes+augments (the torch
+  ``DataLoader(num_workers=16)`` role, ResNet/pytorch/train.py:229-234);
+- batches flow through ``prefetch_to_device`` for double-buffered H2D.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator
+
+import numpy as np
+
+from deep_vision_tpu.data import transforms as T
+
+
+def load_synset_index(labels_file: str) -> dict[str, int]:
+    """synset → class index, line order = index (reference :33-44)."""
+    mapping: dict[str, int] = {}
+    with open(labels_file) as f:
+        for idx, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            mapping[line.split(" ")[0]] = idx
+    return mapping
+
+
+def _decode(path: str) -> np.ndarray:
+    from PIL import Image
+
+    with Image.open(path) as im:
+        return np.asarray(im.convert("RGB"))  # drops alpha, CMYK→RGB
+
+
+class ImageNetFolder:
+    """Flat-folder dataset: index → (decoded RGB uint8 HWC, label)."""
+
+    def __init__(self, root_dir: str, labels_file: str):
+        self.root_dir = root_dir
+        self.files = sorted(
+            f for f in os.listdir(root_dir)
+            if os.path.isfile(os.path.join(root_dir, f)))
+        label_to_idx = load_synset_index(labels_file)
+        # filename prefix before the first '_' is the synset (reference :60-63)
+        self.labels = np.array(
+            [label_to_idx[f.split("_")[0]] for f in self.files], np.int32)
+
+    def __len__(self) -> int:
+        return len(self.files)
+
+    def read(self, i: int) -> tuple[np.ndarray, int]:
+        return _decode(os.path.join(self.root_dir, self.files[i])), int(self.labels[i])
+
+
+# -- worker-side state (initialized once per worker PROCESS; never shared
+# between loaders in-process — the 0-worker path passes cfg explicitly) -----
+_WORKER: dict = {}
+
+
+def _worker_init(cfg: dict):
+    _WORKER.update(cfg)
+
+
+def _load_one(cfg: dict, i: int, seed: int) -> tuple[np.ndarray, np.int32]:
+    img = _decode(os.path.join(cfg["root_dir"], cfg["files"][i]))
+    if cfg["train"]:
+        rng = np.random.default_rng(seed)
+        x = T.train_transform(img, rng, cfg["image_size"], cfg["resize"])
+    else:
+        x = T.eval_transform(img, cfg["image_size"], cfg["resize"])
+    return x.astype(np.float32), cfg["labels"][i]
+
+
+def _worker_load(args) -> tuple[np.ndarray, np.int32]:
+    i, seed = args
+    return _load_one(_WORKER, i, seed)
+
+
+class ImageNetLoader:
+    """Sharded, multiprocess, epoch-reshuffled batch iterator.
+
+    Yields {"image": (B,H,W,3) f32, "label": (B,) i32} host batches; compose
+    with ``prefetch_to_device`` for the H2D double buffer.
+    """
+
+    def __init__(self, root_dir: str, labels_file: str, batch_size: int,
+                 train: bool = True, image_size: int = 224, resize: int = 256,
+                 num_workers: int = 16, seed: int = 0,
+                 process_index: int | None = None,
+                 process_count: int | None = None):
+        import jax
+
+        self.ds = ImageNetFolder(root_dir, labels_file)
+        pi = jax.process_index() if process_index is None else process_index
+        pc = jax.process_count() if process_count is None else process_count
+        # per-host shard: every host sees a disjoint 1/pc slice per epoch
+        self.host_indices = np.arange(pi, len(self.ds), pc)
+        self.batch_size = batch_size
+        self.train = train
+        self.image_size, self.resize = image_size, resize
+        self.num_workers = num_workers
+        self.seed = seed
+        self.epoch = 0
+        self._cfg = dict(root_dir=self.ds.root_dir, files=self.ds.files,
+                         labels=self.ds.labels, train=train,
+                         image_size=image_size, resize=resize)
+        self._pool = None
+        # create the pool EAGERLY on the main thread: forking lazily from the
+        # prefetch producer thread can inherit held locks and deadlock
+        if self.num_workers > 0:
+            import multiprocessing as mp
+
+            ctx = mp.get_context("fork")
+            self._pool = ctx.Pool(self.num_workers, initializer=_worker_init,
+                                  initargs=(self._cfg,))
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return len(self.host_indices) // self.batch_size
+
+    def __iter__(self) -> Iterator[dict]:
+        rng = np.random.default_rng((self.seed, self.epoch))
+        idx = self.host_indices.copy()
+        if self.train:
+            rng.shuffle(idx)
+        full = len(idx) // self.batch_size
+        # eval covers the FULL set: the last partial batch is padded to the
+        # static batch size with weight-0 fillers (pad_last semantics)
+        partial = (not self.train) and (len(idx) % self.batch_size != 0)
+        seeds = rng.integers(0, 2**63 - 1, size=len(idx) + self.batch_size)
+        for b in range(full + int(partial)):
+            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            n_real = len(sel)
+            if n_real < self.batch_size:
+                sel = np.concatenate(
+                    [sel, np.repeat(idx[:1], self.batch_size - n_real)])
+            args = [(int(i), int(s)) for i, s in
+                    zip(sel, seeds[b * self.batch_size:
+                                   b * self.batch_size + self.batch_size])]
+            if self._pool is not None:
+                out = self._pool.map(_worker_load, args, chunksize=8)
+            else:
+                out = [_load_one(self._cfg, *a) for a in args]
+            batch = {"image": np.stack([o[0] for o in out]),
+                     "label": np.asarray([o[1] for o in out], np.int32)}
+            if not self.train:
+                weight = np.zeros(self.batch_size, np.float32)
+                weight[:n_real] = 1.0
+                batch["weight"] = weight
+            yield batch
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool = None

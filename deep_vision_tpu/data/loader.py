@@ -73,15 +73,21 @@ class ArrayLoader:
 
 
 def prefetch_to_device(iterable: Iterable, mesh, depth: int = 2) -> Iterator:
-    """Background-thread device_put pipeline (the double-buffer)."""
+    """Background-thread device_put pipeline (the double-buffer).
+
+    Producer exceptions (decode errors, shard divisibility) re-raise in the
+    consumer — a dead producer must abort the epoch, not truncate it."""
     q: queue.Queue = queue.Queue(maxsize=depth)
     _END = object()
+    _ERR = object()
 
     def producer():
         try:
             for item in iterable:
                 q.put(shard_batch(item, mesh))
-        finally:
+        except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+            q.put((_ERR, e))
+        else:
             q.put(_END)
 
     t = threading.Thread(target=producer, daemon=True)
@@ -90,4 +96,6 @@ def prefetch_to_device(iterable: Iterable, mesh, depth: int = 2) -> Iterator:
         item = q.get()
         if item is _END:
             break
+        if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
+            raise item[1]
         yield item

@@ -1,0 +1,158 @@
+"""dvrec: the framework's packed record format + sharded builders.
+
+Replaces the reference's TFRecord layer (SURVEY §2.4: ImageNet builder
+Datasets/ILSVRC2012/build_imagenet_tfrecord.py, VOC builders
+Datasets/VOC2007/tfrecords.py, COCO Datasets/MSCOCO/tfrecords.py, MPII
+Datasets/MPII/tfrecords_mpii.py) with a TF-free container:
+
+    shard file = repeat[ u32 header_len | header JSON | u32 payload_len | payload ]
+
+- header: arbitrary JSON metadata (labels, boxes, keypoints, shapes)
+- payload: raw bytes (typically the encoded JPEG)
+- shards are named ``{split}-{i:05d}-of-{n:05d}.dvrec``; writers fan out
+  over a process pool (the reference used ``ray.remote``/thread pools —
+  VOC2007/tfrecords.py:98-121, build_imagenet_tfrecord.py:420-469).
+"""
+
+from __future__ import annotations
+
+import glob
+import io
+import json
+import os
+import struct
+from typing import Callable, Iterable, Iterator, Sequence
+
+import numpy as np
+
+_U32 = struct.Struct("<I")
+
+
+class RecordWriter:
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._f = open(path, "wb")
+
+    def write(self, header: dict, payload: bytes = b""):
+        hb = json.dumps(header).encode()
+        self._f.write(_U32.pack(len(hb)))
+        self._f.write(hb)
+        self._f.write(_U32.pack(len(payload)))
+        self._f.write(payload)
+
+    def close(self):
+        self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def read_records(path: str) -> Iterator[tuple[dict, bytes]]:
+    with open(path, "rb") as f:
+        while True:
+            raw = f.read(4)
+            if len(raw) < 4:
+                return
+            (hlen,) = _U32.unpack(raw)
+            header = json.loads(f.read(hlen))
+            (plen,) = _U32.unpack(f.read(4))
+            payload = f.read(plen)
+            yield header, payload
+
+
+def shard_name(out_dir: str, split: str, i: int, n: int) -> str:
+    return os.path.join(out_dir, f"{split}-{i:05d}-of-{n:05d}.dvrec")
+
+
+def list_shards(root: str, split: str) -> list[str]:
+    return sorted(glob.glob(os.path.join(root, f"{split}-*.dvrec")))
+
+
+def _write_shard(args):
+    path, items, encode = args
+    with RecordWriter(path) as w:
+        for item in items:
+            header, payload = encode(item)
+            w.write(header, payload)
+    return path
+
+
+def write_sharded(items: Sequence, out_dir: str, split: str, num_shards: int,
+                  encode: Callable, num_workers: int = 8) -> list[str]:
+    """Fan items out to ``num_shards`` files, ``num_workers`` processes —
+    the ray.remote/Coordinator role from the reference prep scripts."""
+    os.makedirs(out_dir, exist_ok=True)
+    chunks = [list(items[i::num_shards]) for i in range(num_shards)]
+    jobs = [(shard_name(out_dir, split, i, num_shards), chunk, encode)
+            for i, chunk in enumerate(chunks)]
+    if num_workers <= 1:
+        return [_write_shard(j) for j in jobs]
+    import multiprocessing as mp
+
+    with mp.get_context("fork").Pool(min(num_workers, num_shards)) as pool:
+        return pool.map(_write_shard, jobs)
+
+
+# ---------------------------------------------------------------------------
+# Detection records (VOC/COCO layout)
+# ---------------------------------------------------------------------------
+
+
+def encode_detection_sample(sample: dict) -> tuple[dict, bytes]:
+    """sample: {"image": HWC uint8 | "image_bytes": jpeg, "boxes": (N,4)
+    normalized corners, "classes": (N,)} → (header, jpeg payload)."""
+    if "image_bytes" in sample:
+        payload = sample["image_bytes"]
+    else:
+        from PIL import Image
+
+        buf = io.BytesIO()
+        Image.fromarray(sample["image"]).save(buf, format="JPEG", quality=95)
+        payload = buf.getvalue()
+    header = {
+        "boxes": np.asarray(sample["boxes"], np.float32).reshape(-1, 4).tolist(),
+        "classes": np.asarray(sample["classes"], np.int64).reshape(-1).tolist(),
+    }
+    return header, payload
+
+
+class _LazyDetectionSample(dict):
+    """Dict-like sample that decodes its JPEG on first image access."""
+
+    def __init__(self, header: dict, payload: bytes):
+        super().__init__()
+        self._payload = payload
+        self["boxes"] = np.asarray(header["boxes"], np.float32).reshape(-1, 4)
+        self["classes"] = np.asarray(header["classes"], np.int64)
+
+    def __getitem__(self, key):
+        if key == "image" and not dict.__contains__(self, "image"):
+            from PIL import Image
+
+            img = np.asarray(Image.open(io.BytesIO(self._payload)).convert("RGB"))
+            dict.__setitem__(self, "image", img)
+        return dict.__getitem__(self, key)
+
+    def __contains__(self, key):
+        return key == "image" or dict.__contains__(self, key)
+
+
+def write_detection_records(samples: Sequence[dict], out_dir: str, split: str,
+                            num_shards: int = 8, num_workers: int = 8):
+    return write_sharded(samples, out_dir, split, num_shards,
+                         encode_detection_sample, num_workers)
+
+
+def load_detection_records(root: str, split: str) -> list[dict]:
+    """All shards → list of lazy samples (JPEGs decode on access)."""
+    shards = list_shards(root, split)
+    if not shards:
+        raise FileNotFoundError(f"no {split}-*.dvrec under {root}")
+    out: list[dict] = []
+    for s in shards:
+        for header, payload in read_records(s):
+            out.append(_LazyDetectionSample(header, payload))
+    return out

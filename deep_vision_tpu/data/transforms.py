@@ -1,0 +1,114 @@
+"""Image transforms — numpy ports of the reference's cv2/torch pipeline
+(ResNet/pytorch/data_load.py:72-296: Rescale :72-101, RandomHorizontalFlip
+:104-113, RandomCrop :116-143, CenterCrop :146-173, ToTensor :176-194,
+Normalize :197-210, ColorJitter :213-296) — the pipeline that produced the
+published accuracy numbers (SURVEY §7 hard-part 4 picks this over the TF one).
+
+All functions take/return HWC uint8 or float32 numpy arrays on the HOST —
+augmentation is host-side work feeding ``device_put``, never traced by XLA.
+Randomness comes from an explicit ``np.random.Generator`` (seedable per
+epoch/worker, unlike the reference's global ``random``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+try:  # PIL ships with the baked-in torch/torchvision stack
+    from PIL import Image
+except ImportError:  # pragma: no cover
+    Image = None
+
+IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
+IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
+
+
+def rescale(img: np.ndarray, size: int) -> np.ndarray:
+    """Resize so the SHORTER side == size, preserving aspect ratio
+    (reference Rescale :72-101)."""
+    h, w = img.shape[:2]
+    if h < w:
+        nh, nw = size, max(1, int(round(w * size / h)))
+    else:
+        nh, nw = max(1, int(round(h * size / w))), size
+    if (nh, nw) == (h, w):
+        return img
+    pil = Image.fromarray(img.astype(np.uint8) if img.dtype != np.uint8 else img)
+    return np.asarray(pil.resize((nw, nh), Image.BILINEAR))
+
+
+def random_horizontal_flip(img: np.ndarray, rng: np.random.Generator,
+                           p: float = 0.5) -> np.ndarray:
+    if rng.random() < p:
+        return img[:, ::-1]
+    return img
+
+
+def random_crop(img: np.ndarray, size: int, rng: np.random.Generator) -> np.ndarray:
+    h, w = img.shape[:2]
+    top = int(rng.integers(0, h - size + 1))
+    left = int(rng.integers(0, w - size + 1))
+    return img[top:top + size, left:left + size]
+
+
+def center_crop(img: np.ndarray, size: int) -> np.ndarray:
+    h, w = img.shape[:2]
+    top, left = (h - size) // 2, (w - size) // 2
+    return img[top:top + size, left:left + size]
+
+
+def color_jitter(img: np.ndarray, rng: np.random.Generator,
+                 brightness: float = 0.2, contrast: float = 0.2,
+                 saturation: float = 0.2) -> np.ndarray:
+    """Brightness/contrast/saturation jitter in random order, factors
+    uniform in [1-x, 1+x] (reference ColorJitter :213-296; hue=0 there,
+    so hue is omitted).  Operates on float32 [0,1]."""
+    x = img.astype(np.float32) / 255.0 if img.dtype == np.uint8 else img
+    ops = []
+    if brightness > 0:
+        f = rng.uniform(max(0, 1 - brightness), 1 + brightness)
+        ops.append(lambda a, f=f: a * f)
+    if contrast > 0:
+        f = rng.uniform(max(0, 1 - contrast), 1 + contrast)
+        ops.append(lambda a, f=f: (a - a.mean()) * f + a.mean())
+    if saturation > 0:
+        f = rng.uniform(max(0, 1 - saturation), 1 + saturation)
+
+        def sat(a, f=f):
+            gray = a @ np.array([0.299, 0.587, 0.114], np.float32)
+            return gray[..., None] + (a - gray[..., None]) * f
+
+        ops.append(sat)
+    rng.shuffle(ops)
+    for op in ops:
+        x = op(x)
+    return np.clip(x, 0.0, 1.0)
+
+
+def normalize(img: np.ndarray, mean=IMAGENET_MEAN, std=IMAGENET_STD) -> np.ndarray:
+    """[0,1] float32 HWC → standardized (reference Normalize :197-210)."""
+    x = img.astype(np.float32)
+    if x.max() > 1.5:  # still uint8-range
+        x = x / 255.0
+    return (x - mean) / std
+
+
+def train_transform(img: np.ndarray, rng: np.random.Generator,
+                    size: int = 224, resize: int = 256,
+                    jitter: bool = True) -> np.ndarray:
+    """The reference's imagenet_train_transform (ResNet/pytorch/train.py:315-324):
+    Rescale(256) → flip → RandomCrop(224) → ColorJitter(.2,.2,.2) → Normalize."""
+    img = rescale(img, resize)
+    img = random_horizontal_flip(img, rng)
+    img = random_crop(img, size, rng)
+    if jitter:
+        img = color_jitter(img, rng)
+    return normalize(img)
+
+
+def eval_transform(img: np.ndarray, size: int = 224, resize: int = 256
+                   ) -> np.ndarray:
+    """imagenet_val_transform (train.py:326-331): Rescale → CenterCrop → Normalize."""
+    img = rescale(img, resize)
+    img = center_crop(img, size)
+    return normalize(img)

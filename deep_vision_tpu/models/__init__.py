@@ -1,6 +1,21 @@
 """Flax model zoo — one module per family, NHWC, dtype-polymorphic (bf16
 compute on TPU, f32 params)."""
 
+from deep_vision_tpu.models.alexnet import AlexNetV1, AlexNetV2
+from deep_vision_tpu.models.inception import InceptionV1, InceptionV3
 from deep_vision_tpu.models.lenet import LeNet5
+from deep_vision_tpu.models.mobilenet import MobileNetV1
+from deep_vision_tpu.models.resnet import (
+    ResNet34,
+    ResNet50,
+    ResNet50V2,
+    ResNet152,
+)
+from deep_vision_tpu.models.shufflenet import ShuffleNetV1
+from deep_vision_tpu.models.vgg import VGG16, VGG19
 
-__all__ = ["LeNet5"]
+__all__ = [
+    "AlexNetV1", "AlexNetV2", "InceptionV1", "InceptionV3", "LeNet5",
+    "MobileNetV1", "ResNet34", "ResNet50", "ResNet50V2", "ResNet152",
+    "ShuffleNetV1", "VGG16", "VGG19",
+]

@@ -1,0 +1,68 @@
+"""AlexNet V1/V2 — parity with AlexNet/pytorch/models/alexnet_v1.py:11-125
+(one-tower original: 96/256/384/384/256 filters, LRN after conv1-2) and
+alexnet_v2.py:12-75 ("one weird trick" single-column: 64/192/384/384/256);
+the TF variant's custom LRN layer (AlexNet/tensorflow/models/alexnet_v2.py:9-70)
+is ``common.local_response_norm``.
+
+Both share the classifier: dropout(0.5) → 4096 → 4096 → 1000.
+TPU note: LRN is one reduce-window over the channel axis (NHWC) — XLA fuses
+the square/divide epilogues; convs stay on the MXU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from deep_vision_tpu.models.common import local_response_norm
+
+
+class AlexNet(nn.Module):
+    filters: Sequence[int] = (96, 256, 384, 384, 256)  # V1; V2 overrides
+    use_lrn: bool = True
+    num_classes: int = 1000
+    dropout: float = 0.5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        f = self.filters
+        x = x.astype(self.dtype)
+        x = nn.relu(nn.Conv(f[0], (11, 11), (4, 4),
+                            padding=[(2, 2), (2, 2)], dtype=self.dtype)(x))
+        if self.use_lrn:
+            # reference passes the FULL channel count as the window
+            # (nn.LocalResponseNorm(96/64), alexnet_v1.py:41, alexnet_v2.py)
+            x = local_response_norm(x, size=f[0])
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(nn.Conv(f[1], (5, 5), padding=[(2, 2), (2, 2)],
+                            dtype=self.dtype)(x))
+        if self.use_lrn:
+            x = local_response_norm(x, size=f[1])
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = nn.relu(nn.Conv(f[2], (3, 3), padding=[(1, 1), (1, 1)],
+                            dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(f[3], (3, 3), padding=[(1, 1), (1, 1)],
+                            dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(f[4], (3, 3), padding=[(1, 1), (1, 1)],
+                            dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), (2, 2))
+        x = x.reshape((x.shape[0], -1))  # 6×6×256 at 224² input
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def AlexNetV1(num_classes: int = 1000, dtype: Any = jnp.float32) -> AlexNet:
+    return AlexNet(filters=(96, 256, 384, 384, 256), num_classes=num_classes,
+                   dtype=dtype)
+
+
+def AlexNetV2(num_classes: int = 1000, dtype: Any = jnp.float32) -> AlexNet:
+    return AlexNet(filters=(64, 192, 384, 384, 256), num_classes=num_classes,
+                   dtype=dtype)

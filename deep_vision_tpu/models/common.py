@@ -54,6 +54,27 @@ class ConvBN(nn.Module):
         return x
 
 
+def local_response_norm(x, size: int, alpha: float = 1e-4,
+                        beta: float = 0.75, k: float = 1.0):
+    """Cross-channel LRN with torch ``nn.LocalResponseNorm`` semantics
+    (the reference applies it in AlexNet — AlexNet/pytorch/models/alexnet_v1.py
+    and the custom TF layer alexnet_v2.py:9-70):
+
+        x / (k + alpha/size * Σ_{window} x²)^beta   over a channel window.
+
+    Implemented as an NHWC channel-axis average pool over squares — one fused
+    XLA reduce-window, no transposes (TPU-friendly; torch does NCHW)."""
+    sq = jnp.square(x)
+    half = size // 2
+    # pad channels and sum a sliding window along the last axis
+    window = jax.lax.reduce_window(
+        sq, 0.0, jax.lax.add,
+        window_dimensions=(1, 1, 1, size),
+        window_strides=(1, 1, 1, 1),
+        padding=((0, 0), (0, 0), (0, 0), (half, size - 1 - half)))
+    return x / jnp.power(k + alpha / size * window, beta)
+
+
 def count_params(params) -> int:
     return sum(x.size for x in jax.tree_util.tree_leaves(params))
 

@@ -1,0 +1,61 @@
+"""MobileNet V1 — parity with MobileNet/pytorch/models/mobilenet_v1.py:10-155
+(``DepthwiseSeparableConv`` = depthwise 3×3 + pointwise 1×1, each with
+BN+ReLU; width multiplier ``alpha``; the TF variant's custom SeparableConv2D
+layer is MobileNet/tensorflow/models/mobilenet_v1.py:7-74).
+
+TPU note: depthwise convs don't use the MXU (they're VPU work) but XLA fuses
+BN+ReLU into them; the pointwise 1×1s are pure MXU matmuls and dominate the
+FLOPs, which is exactly where we want them.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from deep_vision_tpu.models.common import ConvBN, global_avg_pool
+
+# (pointwise-out, stride) plan after the stem, before the ×5 512 block
+_PLAN = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+         (512, 1), (512, 1), (512, 1), (512, 1), (512, 1),
+         (1024, 2), (1024, 1)]
+
+
+class DepthwiseSeparable(nn.Module):
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        # depthwise: groups == channels
+        x = ConvBN(in_ch, (3, 3), (self.strides, self.strides),
+                   groups=in_ch, dtype=self.dtype)(x, train)
+        # pointwise
+        x = ConvBN(self.features, (1, 1), dtype=self.dtype)(x, train)
+        return x
+
+
+class MobileNetV1(nn.Module):
+    alpha: float = 1.0  # width multiplier
+    num_classes: int = 1000
+    dropout: float = 0.001  # reference TF config uses ~0 dropout
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def w(c):
+            return max(8, int(c * self.alpha))
+
+        x = x.astype(self.dtype)
+        x = ConvBN(w(32), (3, 3), (2, 2), dtype=self.dtype)(x, train)  # 224→112
+        for features, stride in _PLAN:
+            x = DepthwiseSeparable(w(features), stride,
+                                   dtype=self.dtype)(x, train)
+        x = global_avg_pool(x)
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

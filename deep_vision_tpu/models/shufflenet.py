@@ -1,0 +1,78 @@
+"""ShuffleNet V1 — the reference's file is EMPTY
+(ShuffleNet/pytorch/models/shufflenet_v1.py, 0 bytes, README says WIP —
+SURVEY §2.2 #15).  Implemented properly here (Zhang et al. 2017): grouped
+1×1 convs + channel shuffle + depthwise 3×3, three stages (4/8/4 units),
+groups=3 channel plan 240/480/960.
+
+TPU note: the channel shuffle is a reshape-transpose-reshape — pure layout,
+free under XLA; grouped 1×1 convs map to batched MXU matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from deep_vision_tpu.models.common import ConvBN, global_avg_pool
+
+_STAGE_CHANNELS = {1: (144, 288, 576), 2: (200, 400, 800), 3: (240, 480, 960),
+                   4: (272, 544, 1088), 8: (384, 768, 1536)}
+_STAGE_REPEATS = (4, 8, 4)
+
+
+def channel_shuffle(x, groups: int):
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, groups, c // groups)
+    x = jnp.swapaxes(x, -2, -1)
+    return x.reshape(n, h, w, c)
+
+
+class ShuffleUnit(nn.Module):
+    features: int
+    groups: int = 3
+    strides: int = 1
+    first_group: bool = True  # stage2's first gconv is ungrouped (paper §3.2)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        bottleneck = self.features // 4
+        out_features = self.features
+        if self.strides > 1:
+            # concat shortcut: unit emits features - in_channels new channels
+            out_features = self.features - x.shape[-1]
+        g = self.groups if self.first_group else 1
+        y = ConvBN(bottleneck, (1, 1), groups=g, dtype=self.dtype)(x, train)
+        y = channel_shuffle(y, self.groups)
+        y = ConvBN(bottleneck, (3, 3), (self.strides, self.strides),
+                   groups=bottleneck, act=None, dtype=self.dtype)(y, train)
+        y = ConvBN(out_features, (1, 1), groups=self.groups, act=None,
+                   dtype=self.dtype)(y, train)
+        if self.strides > 1:
+            shortcut = nn.avg_pool(x, (3, 3), (2, 2), padding="SAME")
+            return nn.relu(jnp.concatenate([shortcut, y], axis=-1))
+        return nn.relu(x + y)
+
+
+class ShuffleNetV1(nn.Module):
+    groups: int = 3
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        channels = _STAGE_CHANNELS[self.groups]
+        x = x.astype(self.dtype)
+        x = ConvBN(24, (3, 3), (2, 2), dtype=self.dtype)(x, train)   # 224→112
+        x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")           # →56
+        for stage, (c, reps) in enumerate(zip(channels, _STAGE_REPEATS)):
+            for i in range(reps):
+                x = ShuffleUnit(
+                    c, self.groups, strides=2 if i == 0 else 1,
+                    first_group=not (stage == 0 and i == 0),
+                    dtype=self.dtype)(x, train)
+        x = global_avg_pool(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

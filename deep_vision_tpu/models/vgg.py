@@ -1,0 +1,52 @@
+"""VGG-16/19 — parity with VGG/pytorch/models/vgg16.py:8-127 and vgg19.py
+(plain 3×3 stacks; the reference writes every layer out by hand, here the
+stack is data-driven).  Classifier: dropout → 4096 → 4096 → num_classes.
+
+TPU note: all convs are 3×3 SAME — uniform shapes XLA tiles perfectly; the
+two 4096-wide FC layers are pure MXU matmuls.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+# channel plan per stage; M = maxpool
+_VGG16 = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+          512, 512, 512, "M", 512, 512, 512, "M")
+_VGG19 = (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+          512, 512, 512, 512, "M", 512, 512, 512, 512, "M")
+
+
+class VGG(nn.Module):
+    plan: Sequence = _VGG16
+    num_classes: int = 1000
+    dropout: float = 0.5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for item in self.plan:
+            if item == "M":
+                x = nn.max_pool(x, (2, 2), (2, 2))
+            else:
+                x = nn.relu(nn.Conv(item, (3, 3), padding="SAME",
+                                    dtype=self.dtype)(x))
+        x = x.reshape((x.shape[0], -1))  # 7×7×512 at 224²
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def VGG16(num_classes: int = 1000, dtype: Any = jnp.float32) -> VGG:
+    return VGG(plan=_VGG16, num_classes=num_classes, dtype=dtype)
+
+
+def VGG19(num_classes: int = 1000, dtype: Any = jnp.float32) -> VGG:
+    return VGG(plan=_VGG19, num_classes=num_classes, dtype=dtype)

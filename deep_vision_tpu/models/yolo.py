@@ -1,0 +1,157 @@
+"""Darknet-53 + YOLOv3 3-scale detector — parity with
+YOLO/tensorflow/yolov3.py: DarknetConv (conv-BN-LeakyReLU) :23-41,
+DarknetResidual :44-51, 3-output backbone :54-92, FPN-style head :95-235,
+COCO anchor table :18-20.
+
+TPU-first notes:
+- raw head outputs stay in "t-space" (tx,ty,tw,th,obj,classes); decoding
+  (sigmoid + grid offsets + anchor scaling) lives in
+  ``tasks.detection.decode_boxes`` so the train graph and the eval graph
+  share one codec;
+- upsample is ``jnp.repeat`` ×2 (nearest) — a layout op XLA folds into the
+  following conv;
+- all three scales come from ONE trace; no dynamic shapes anywhere.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+# (w, h) anchor priors normalized by 416 (yolov3.py:18-20), grouped
+# small→large; scale 0 = 52×52 grid gets the small anchors.
+YOLO_ANCHORS = np.array(
+    [(10, 13), (16, 30), (33, 23),
+     (30, 61), (62, 45), (59, 119),
+     (116, 90), (156, 198), (373, 326)], np.float32) / 416.0
+ANCHOR_MASKS = np.array([[0, 1, 2], [3, 4, 5], [6, 7, 8]])
+
+
+class DarknetConv(nn.Module):
+    features: int
+    kernel_size: int = 3
+    strides: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.strides == 2:
+            # darknet pads top-left for stride-2 convs
+            x = jnp.pad(x, ((0, 0), (1, 0), (1, 0), (0, 0)))
+            padding = "VALID"
+        else:
+            padding = "SAME"
+        x = nn.Conv(self.features, (self.kernel_size, self.kernel_size),
+                    (self.strides, self.strides), padding=padding,
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype)(x)
+        return nn.leaky_relu(x, 0.1)
+
+
+class DarknetResidual(nn.Module):
+    features: int  # block input channels; bottleneck is features//2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = DarknetConv(self.features // 2, 1, dtype=self.dtype)(x, train)
+        y = DarknetConv(self.features, 3, dtype=self.dtype)(y, train)
+        return x + y
+
+
+class Darknet53(nn.Module):
+    """Backbone emitting (52², 26², 13²) feature maps at 416² input."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(DarknetConv, dtype=self.dtype)
+        x = conv(32, 3)(x, train)
+        x = conv(64, 3, 2)(x, train)                      # /2
+        x = DarknetResidual(64, self.dtype)(x, train)
+        x = conv(128, 3, 2)(x, train)                     # /4
+        for _ in range(2):
+            x = DarknetResidual(128, self.dtype)(x, train)
+        x = conv(256, 3, 2)(x, train)                     # /8
+        for _ in range(8):
+            x = DarknetResidual(256, self.dtype)(x, train)
+        route_small = x                                   # 52²×256
+        x = conv(512, 3, 2)(x, train)                     # /16
+        for _ in range(8):
+            x = DarknetResidual(512, self.dtype)(x, train)
+        route_medium = x                                  # 26²×512
+        x = conv(1024, 3, 2)(x, train)                    # /32
+        for _ in range(4):
+            x = DarknetResidual(1024, self.dtype)(x, train)
+        return route_small, route_medium, x               # 13²×1024
+
+
+def _upsample2(x):
+    return jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)
+
+
+class YoloConvBlock(nn.Module):
+    """5-conv 1-3-1-3-1 neck block (yolov3.py head)."""
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(DarknetConv, dtype=self.dtype)
+        x = conv(self.features, 1)(x, train)
+        x = conv(self.features * 2, 3)(x, train)
+        x = conv(self.features, 1)(x, train)
+        x = conv(self.features * 2, 3)(x, train)
+        x = conv(self.features, 1)(x, train)
+        return x
+
+
+class YoloHead(nn.Module):
+    """3×3 conv + 1×1 projection to 3·(5+C) raw channels."""
+
+    features: int
+    num_classes: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = DarknetConv(self.features * 2, 3, dtype=self.dtype)(x, train)
+        x = nn.Conv(3 * (5 + self.num_classes), (1, 1), dtype=self.dtype)(x)
+        n, h, w, _ = x.shape
+        x = x.reshape(n, h, w, 3, 5 + self.num_classes)
+        return x.astype(jnp.float32)  # raw t-space, f32 for the loss
+
+
+class YoloV3(nn.Module):
+    """Returns raw outputs for the three scales, LARGE grid first
+    (52²: small objects) to match the anchor-mask order."""
+
+    num_classes: int = 80
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        small, medium, large = Darknet53(self.dtype)(x, train)
+
+        x13 = YoloConvBlock(512, self.dtype)(large, train)
+        out13 = YoloHead(512, self.num_classes, self.dtype)(x13, train)
+
+        x = DarknetConv(256, 1, dtype=self.dtype)(x13, train)
+        x = jnp.concatenate([_upsample2(x), medium], axis=-1)
+        x26 = YoloConvBlock(256, self.dtype)(x, train)
+        out26 = YoloHead(256, self.num_classes, self.dtype)(x26, train)
+
+        x = DarknetConv(128, 1, dtype=self.dtype)(x26, train)
+        x = jnp.concatenate([_upsample2(x), small], axis=-1)
+        x52 = YoloConvBlock(128, self.dtype)(x, train)
+        out52 = YoloHead(128, self.num_classes, self.dtype)(x52, train)
+
+        return out52, out26, out13  # scale order matches ANCHOR_MASKS rows

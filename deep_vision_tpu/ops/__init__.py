@@ -1,0 +1,9 @@
+"""TPU-friendly ops: static-shape box/NMS/heatmap primitives."""
+
+from deep_vision_tpu.ops.boxes import (
+    batched_nms,
+    broadcast_iou,
+    xywh_to_corners,
+)
+
+__all__ = ["batched_nms", "broadcast_iou", "xywh_to_corners"]

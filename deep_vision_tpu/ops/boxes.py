@@ -1,0 +1,75 @@
+"""Box utilities + batched NMS — parity with YOLO/tensorflow/utils.py
+(``xywh_to_x1x2y1y2`` :4-12, ``broadcast_iou`` :31-74) and
+postprocess.py's greedy NMS (:38-96).
+
+The reference's NMS is a per-image python-style ``tf.while_loop`` picking
+argmax and suppressing by IoU, mapped over the batch with ``tf.map_fn`` —
+dynamic control flow that cannot batch on TPU.  Here NMS is a fixed-size,
+fully-batched ``lax.while_loop``-free formulation: K rounds of
+(argmax → record → suppress) expressed with ``lax.scan``, identical results
+for the top-K boxes, static shapes throughout (SURVEY §7 hard-part 2).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def xywh_to_corners(box):
+    """(cx, cy, w, h) → (x1, y1, x2, y2), any leading dims."""
+    xy, wh = box[..., :2], box[..., 2:4]
+    return jnp.concatenate([xy - wh / 2.0, xy + wh / 2.0], axis=-1)
+
+
+def broadcast_iou(box_a, box_b, eps: float = 1e-9):
+    """IoU of every a-box against every b-box.
+
+    box_a: (..., N, 4) corners; box_b: (..., M, 4) corners → (..., N, M).
+    """
+    a = box_a[..., :, None, :]
+    b = box_b[..., None, :, :]
+    inter_lo = jnp.maximum(a[..., :2], b[..., :2])
+    inter_hi = jnp.minimum(a[..., 2:], b[..., 2:])
+    inter_wh = jnp.maximum(inter_hi - inter_lo, 0.0)
+    inter = inter_wh[..., 0] * inter_wh[..., 1]
+    area_a = jnp.maximum(box_a[..., 2] - box_a[..., 0], 0.0) * \
+        jnp.maximum(box_a[..., 3] - box_a[..., 1], 0.0)
+    area_b = jnp.maximum(box_b[..., 2] - box_b[..., 0], 0.0) * \
+        jnp.maximum(box_b[..., 3] - box_b[..., 1], 0.0)
+    union = area_a[..., :, None] + area_b[..., None, :] - inter
+    return inter / (union + eps)
+
+
+def nms_single(boxes, scores, max_outputs: int, iou_threshold: float = 0.5,
+               score_threshold: float = 0.0):
+    """Greedy NMS for one image, static output size.
+
+    boxes: (N, 4) corners; scores: (N,).  Returns (idx, sel_scores, valid):
+    (K,) selected indices, their scores, and a 0/1 validity mask.
+    """
+    scores = jnp.where(scores >= score_threshold, scores, -jnp.inf)
+    iou = broadcast_iou(boxes, boxes)  # (N, N)
+
+    def step(live_scores, _):
+        i = jnp.argmax(live_scores)
+        best = live_scores[i]
+        valid = jnp.isfinite(best)
+        # suppress neighbours of the chosen box + the box itself
+        suppress = (iou[i] > iou_threshold) | (
+            jnp.arange(scores.shape[0]) == i)
+        live_scores = jnp.where(valid & suppress, -jnp.inf, live_scores)
+        return live_scores, (i, jnp.where(valid, best, 0.0),
+                             valid.astype(jnp.float32))
+
+    _, (idx, sel, valid) = lax.scan(step, scores, None, length=max_outputs)
+    return idx, sel, valid
+
+
+def batched_nms(boxes, scores, max_outputs: int, iou_threshold: float = 0.5,
+                score_threshold: float = 0.0):
+    """vmap of nms_single over the batch: (B,N,4),(B,N) → (B,K) each."""
+    return jax.vmap(
+        lambda b, s: nms_single(b, s, max_outputs, iou_threshold,
+                                score_threshold))(boxes, scores)

@@ -1,0 +1,47 @@
+"""Parameter partitioning rules for the ``model`` mesh axis (tensor
+parallelism).
+
+The reference has no tensor parallelism at all (SURVEY §2.5 — its only
+strategy is single-host data parallelism), so this is TPU-native headroom,
+not a port: wide trailing dimensions (the ImageNet classifier head, late-stage
+2048-channel convs, GAN projection layers) shard over ``model``; everything
+else replicates.  GSPMD then inserts the all-gathers/reduce-scatters over ICI.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deep_vision_tpu.parallel.mesh import MODEL_AXIS
+
+
+def param_partition_spec(params: Any, mesh: Mesh, min_shard_dim: int = 1024
+                         ) -> Any:
+    """PartitionSpec pytree: shard a kernel's trailing (output-feature) dim
+    over ``model`` when it is large and divisible; replicate the rest."""
+    n_model = mesh.shape.get(MODEL_AXIS, 1)
+
+    def spec(x):
+        if (n_model > 1 and hasattr(x, "ndim") and x.ndim >= 2
+                and x.shape[-1] >= min_shard_dim
+                and x.shape[-1] % n_model == 0):
+            return P(*([None] * (x.ndim - 1)), MODEL_AXIS)
+        return P()
+
+    return jax.tree_util.tree_map(spec, params)
+
+
+def param_shardings(params: Any, mesh: Mesh, min_shard_dim: int = 1024) -> Any:
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        param_partition_spec(params, mesh, min_shard_dim),
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_params(params: Any, mesh: Mesh, min_shard_dim: int = 1024) -> Any:
+    """device_put params according to the partition rules."""
+    return jax.tree_util.tree_map(
+        jax.device_put, params, param_shardings(params, mesh, min_shard_dim))

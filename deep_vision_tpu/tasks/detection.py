@@ -1,0 +1,239 @@
+"""Detection task: YOLOv3 box codecs, loss, label encoding, postprocess.
+
+Parity map (all in /root/reference/YOLO/tensorflow/):
+- decode/encode: ``get_absolute_yolo_box`` yolov3.py:238-326,
+  ``get_relative_yolo_box`` :329-349
+- loss: ``YoloLoss`` :352-552 (xy/wh L2 in t-space ×(2-w·h)×λ_coord=5,
+  obj/noobj BCE with ignore-mask IoU>0.5, λ_noobj=0.5, per-anchor class BCE)
+- label encoding: preprocess.py:137-269 — reimplemented as one vectorized
+  scatter over boxes instead of the reference's per-box Python loop
+- postprocess: postprocess.py:12-96 → ops.boxes.batched_nms
+
+TPU notes: the ignore mask compares pred boxes against a FIXED-SIZE padded
+list of ground-truth boxes per image (batch["boxes"], mask in
+batch["boxes_mask"]) — the reference's ``tf.boolean_mask`` is dynamic-shaped
+(and mixes images across the batch); this formulation is static, per-image
+correct, and vmap-free.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deep_vision_tpu.models.yolo import ANCHOR_MASKS, YOLO_ANCHORS
+from deep_vision_tpu.ops.boxes import batched_nms, broadcast_iou, xywh_to_corners
+
+MAX_BOXES = 100  # static per-image ground-truth capacity
+
+
+def decode_boxes(raw, anchors_wh):
+    """t-space raw head output → (normalized xywh boxes, obj, classes).
+
+    raw: (B, G, G, A, 5+C).  bx = (σ(tx)+Cx)/G;  bwh = anchor·e^t  —
+    yolov3.py:238-326.
+    """
+    grid = raw.shape[1]
+    t_xy, t_wh, obj, cls = jnp.split(raw, (2, 4, 5), axis=-1)
+    cy, cx = jnp.meshgrid(jnp.arange(grid, dtype=jnp.float32),
+                          jnp.arange(grid, dtype=jnp.float32), indexing="ij")
+    c_xy = jnp.stack([cx, cy], axis=-1)[None, :, :, None, :]  # (1,G,G,1,2)
+    b_xy = (jax.nn.sigmoid(t_xy) + c_xy) / grid
+    b_wh = jnp.exp(jnp.clip(t_wh, -9.0, 9.0)) * anchors_wh
+    return (jnp.concatenate([b_xy, b_wh], -1),
+            jax.nn.sigmoid(obj), jax.nn.sigmoid(cls))
+
+
+def encode_boxes(xywh, anchors_wh, eps: float = 1e-9):
+    """normalized xywh → t-space targets (inverse of decode; :329-349)."""
+    grid = xywh.shape[1]
+    xy, wh = xywh[..., :2], xywh[..., 2:4]
+    t_xy = xy * grid - jnp.floor(xy * grid)  # σ(tx) value, cell offset
+    t_wh = jnp.log(jnp.maximum(wh, eps) / anchors_wh)
+    t_wh = jnp.where(wh <= eps, 0.0, t_wh)  # empty cells → 0 target
+    return t_xy, t_wh
+
+
+def _bce(logit_or_prob, target, from_probs: bool, eps: float = 1e-7):
+    if from_probs:
+        p = jnp.clip(logit_or_prob, eps, 1 - eps)
+        return -(target * jnp.log(p) + (1 - target) * jnp.log(1 - p))
+    return jnp.maximum(logit_or_prob, 0) - logit_or_prob * target + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit_or_prob)))
+
+
+def yolo_scale_loss(raw, y_true, gt_boxes, gt_mask, anchors_wh,
+                    ignore_thresh: float = 0.5, lambda_coord: float = 5.0,
+                    lambda_noobj: float = 0.5):
+    """Loss for ONE scale.
+
+    raw: (B,G,G,A,5+C) head output; y_true: same shape, absolute xywh +
+    obj + one-hot; gt_boxes: (B,MAX_BOXES,4) corner boxes; gt_mask: (B,M).
+    Returns (total (B,), components dict).
+    """
+    num_classes = raw.shape[-1] - 5
+    pred_xy_rel = jax.nn.sigmoid(raw[..., 0:2])
+    pred_wh_rel = raw[..., 2:4]
+    pred_box_abs, pred_obj, _ = decode_boxes(raw, anchors_wh)
+    pred_corners = xywh_to_corners(pred_box_abs)
+
+    true_xy_abs = y_true[..., 0:2]
+    true_wh_abs = y_true[..., 2:4]
+    true_obj = y_true[..., 4:5]
+    true_class = y_true[..., 5:]
+    true_xy_rel, true_wh_rel = encode_boxes(y_true[..., 0:4], anchors_wh)
+
+    # small-box upweighting (2 - w·h), darknet yolo_layer.c:190 via :405-407
+    weight = 2.0 - true_wh_abs[..., 0] * true_wh_abs[..., 1]
+    obj = true_obj[..., 0]
+
+    xy_loss = jnp.square(true_xy_rel - pred_xy_rel).sum(-1)
+    xy_loss = (obj * weight * xy_loss).sum((1, 2, 3)) * lambda_coord
+    wh_loss = jnp.square(true_wh_rel - pred_wh_rel).sum(-1)
+    wh_loss = (obj * weight * wh_loss).sum((1, 2, 3)) * lambda_coord
+
+    # ignore mask: preds overlapping ANY same-image gt > thresh are not
+    # penalized as background (yolov3.py:438-459, static-shape version)
+    B, G = raw.shape[0], raw.shape[1]
+    flat_pred = pred_corners.reshape(B, -1, 4)
+    iou = broadcast_iou(flat_pred, gt_boxes)               # (B, N, M)
+    iou = jnp.where(gt_mask[:, None, :] > 0, iou, 0.0)
+    best_iou = iou.max(-1).reshape(obj.shape)
+    ignore = (best_iou < ignore_thresh).astype(jnp.float32)
+
+    obj_entropy = _bce(raw[..., 4:5], true_obj, from_probs=False)[..., 0]
+    obj_loss = (obj * obj_entropy).sum((1, 2, 3))
+    noobj_loss = ((1 - obj) * obj_entropy * ignore).sum((1, 2, 3)) * lambda_noobj
+
+    class_entropy = _bce(raw[..., 5:], true_class, from_probs=False)
+    class_loss = (true_obj * class_entropy).sum((1, 2, 3, 4))
+
+    total = xy_loss + wh_loss + obj_loss + noobj_loss + class_loss
+    return total, {"xy": xy_loss, "wh": wh_loss,
+                   "obj": obj_loss + noobj_loss, "class": class_loss}
+
+
+class YoloTask:
+    """Task bundle for the Trainer: multi-scale loss + eval."""
+
+    monitor = "neg_loss"
+
+    def __init__(self, num_classes: int,
+                 anchors: np.ndarray = YOLO_ANCHORS,
+                 masks: np.ndarray = ANCHOR_MASKS):
+        self.num_classes = num_classes
+        self.anchors = jnp.asarray(anchors)
+        self.masks = masks
+
+    def _scale_anchors(self, scale: int):
+        return self.anchors[self.masks[scale]]
+
+    def loss(self, outputs, batch):
+        totals, comps = 0.0, {}
+        for s, raw in enumerate(outputs):
+            t, c = yolo_scale_loss(
+                raw, batch[f"y_true_{s}"], batch["boxes"],
+                batch["boxes_mask"], self._scale_anchors(s))
+            totals = totals + t.mean()
+            for k, v in c.items():
+                comps[f"{k}_{s}"] = v.mean()
+        return totals, comps
+
+    def eval_metrics(self, outputs, batch):
+        loss, _ = self.loss(outputs, batch)
+        n = batch["boxes"].shape[0]
+        return {"loss": loss * n, "neg_loss": -loss * n,
+                "count": jnp.asarray(n, jnp.float32)}
+
+
+# ---------------------------------------------------------------------------
+# Label encoding (host-side, numpy): preprocess.py:137-269 vectorized
+# ---------------------------------------------------------------------------
+
+
+def find_best_anchor(wh: np.ndarray, anchors: np.ndarray = YOLO_ANCHORS
+                     ) -> np.ndarray:
+    """Best of the 9 anchors by centered IoU (preprocess.py:226-269).
+
+    wh: (N, 2) normalized → (N,) anchor index.
+    """
+    inter = np.minimum(wh[:, None, 0], anchors[None, :, 0]) * \
+        np.minimum(wh[:, None, 1], anchors[None, :, 1])
+    union = wh[:, None, 0] * wh[:, None, 1] + \
+        anchors[None, :, 0] * anchors[None, :, 1] - inter
+    return np.argmax(inter / np.maximum(union, 1e-9), axis=1)
+
+
+def encode_labels(boxes_xywh: np.ndarray, classes: np.ndarray,
+                  num_classes: int, grids: Sequence[int] = (52, 26, 13),
+                  anchors: np.ndarray = YOLO_ANCHORS,
+                  masks: np.ndarray = ANCHOR_MASKS):
+    """One image's gt boxes → the 3 y_true grids + padded box list.
+
+    boxes_xywh: (N, 4) normalized centroids; classes: (N,) int.
+    Returns dict {y_true_0..2: (G,G,3,5+C), boxes: (MAX_BOXES,4) corners,
+    boxes_mask: (MAX_BOXES,)}.
+    Vectorized scatter (no per-box Python loop over grid ops): one
+    best-anchor lookup, one np index-assign per scale.
+    """
+    n = len(boxes_xywh)
+    out = {f"y_true_{s}": np.zeros((g, g, 3, 5 + num_classes), np.float32)
+           for s, g in enumerate(grids)}
+    boxes_list = np.zeros((MAX_BOXES, 4), np.float32)
+    boxes_mask = np.zeros((MAX_BOXES,), np.float32)
+    if n:
+        m = min(n, MAX_BOXES)
+        corners = np.concatenate([boxes_xywh[:m, :2] - boxes_xywh[:m, 2:4] / 2,
+                                  boxes_xywh[:m, :2] + boxes_xywh[:m, 2:4] / 2], 1)
+        boxes_list[:m] = corners
+        boxes_mask[:m] = 1.0
+        best = find_best_anchor(boxes_xywh[:, 2:4], anchors)
+        for s, g in enumerate(grids):
+            sel = np.isin(best, masks[s])
+            if not sel.any():
+                continue
+            b = boxes_xywh[sel]
+            cls = classes[sel]
+            a_idx = np.searchsorted(masks[s], best[sel])
+            gx = np.clip((b[:, 0] * g).astype(int), 0, g - 1)
+            gy = np.clip((b[:, 1] * g).astype(int), 0, g - 1)
+            y = out[f"y_true_{s}"]
+            y[gy, gx, a_idx, 0:4] = b[:, 0:4]
+            y[gy, gx, a_idx, 4] = 1.0
+            y[gy, gx, a_idx, 5 + cls] = 1.0
+    return {**out, "boxes": boxes_list, "boxes_mask": boxes_mask}
+
+
+# ---------------------------------------------------------------------------
+# Postprocess: decode all scales → NMS (postprocess.py:12-96, batched)
+# ---------------------------------------------------------------------------
+
+
+def postprocess(outputs, num_classes: int, max_outputs: int = 100,
+                iou_threshold: float = 0.5, score_threshold: float = 0.1,
+                anchors: np.ndarray = YOLO_ANCHORS,
+                masks: np.ndarray = ANCHOR_MASKS):
+    """raw 3-scale outputs → (boxes (B,K,4) corners, scores (B,K),
+    classes (B,K), valid (B,K))."""
+    all_boxes, all_scores, all_cls = [], [], []
+    anchors = jnp.asarray(anchors)
+    for s, raw in enumerate(outputs):
+        box, obj, cls = decode_boxes(raw, anchors[masks[s]])
+        B = raw.shape[0]
+        scores = obj * cls  # per-class confidence
+        best_cls = jnp.argmax(scores, -1)
+        best_score = jnp.max(scores, -1)
+        all_boxes.append(xywh_to_corners(box).reshape(B, -1, 4))
+        all_scores.append(best_score.reshape(B, -1))
+        all_cls.append(best_cls.reshape(B, -1))
+    boxes = jnp.concatenate(all_boxes, 1)
+    scores = jnp.concatenate(all_scores, 1)
+    classes = jnp.concatenate(all_cls, 1)
+    idx, sel_scores, valid = batched_nms(
+        boxes, scores, max_outputs, iou_threshold, score_threshold)
+    sel_boxes = jnp.take_along_axis(boxes, idx[..., None], axis=1)
+    sel_classes = jnp.take_along_axis(classes, idx, axis=1)
+    return sel_boxes, sel_scores, sel_classes, valid

@@ -1,0 +1,100 @@
+"""mAP evaluation — the reference admits this is unfinished
+("Evaluation ... working in progress", YOLO/tensorflow/README.md; SURVEY §7
+step 8 says finish it).  Host-side numpy, VOC-style AP with both the
+VOC2007 11-point and the continuous (area-under-PR) interpolation.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _iou_matrix(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(N,4)×(M,4) corner boxes → (N,M) IoU."""
+    lo = np.maximum(a[:, None, :2], b[None, :, :2])
+    hi = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.maximum(hi - lo, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = np.maximum(a[:, 2] - a[:, 0], 0) * np.maximum(a[:, 3] - a[:, 1], 0)
+    area_b = np.maximum(b[:, 2] - b[:, 0], 0) * np.maximum(b[:, 3] - b[:, 1], 0)
+    return inter / np.maximum(area_a[:, None] + area_b[None, :] - inter, 1e-9)
+
+
+def average_precision(recall: np.ndarray, precision: np.ndarray,
+                      use_07_metric: bool = False) -> float:
+    if use_07_metric:
+        ap = 0.0
+        for t in np.arange(0.0, 1.01, 0.1):
+            p = precision[recall >= t].max() if (recall >= t).any() else 0.0
+            ap += p / 11.0
+        return float(ap)
+    # continuous: envelope + area under PR
+    mrec = np.concatenate([[0.0], recall, [1.0]])
+    mpre = np.concatenate([[0.0], precision, [0.0]])
+    for i in range(len(mpre) - 2, -1, -1):
+        mpre[i] = max(mpre[i], mpre[i + 1])
+    idx = np.where(mrec[1:] != mrec[:-1])[0]
+    return float(((mrec[idx + 1] - mrec[idx]) * mpre[idx + 1]).sum())
+
+
+class MeanAPEvaluator:
+    """Accumulate per-image detections + ground truth, then compute mAP.
+
+    ``add(dets, gts)`` per image:
+      dets: (boxes (K,4), scores (K,), classes (K,)) — corner coords
+      gts:  (boxes (M,4), classes (M,))
+    """
+
+    def __init__(self, num_classes: int, iou_threshold: float = 0.5,
+                 use_07_metric: bool = False):
+        self.num_classes = num_classes
+        self.iou_threshold = iou_threshold
+        self.use_07 = use_07_metric
+        self._dets: list[list] = [[] for _ in range(num_classes)]
+        self._n_gt = np.zeros(num_classes, np.int64)
+        self._img = 0
+
+    def add(self, det_boxes, det_scores, det_classes, gt_boxes, gt_classes):
+        img = self._img
+        self._img += 1
+        gt_boxes = np.asarray(gt_boxes, np.float32).reshape(-1, 4)
+        gt_classes = np.asarray(gt_classes, np.int64).reshape(-1)
+        for c in np.unique(gt_classes):
+            self._n_gt[c] += int((gt_classes == c).sum())
+        for b, s, c in zip(np.asarray(det_boxes).reshape(-1, 4),
+                           np.asarray(det_scores).reshape(-1),
+                           np.asarray(det_classes, np.int64).reshape(-1)):
+            self._dets[c].append(
+                (float(s), b, img,
+                 gt_boxes[gt_classes == c]))
+
+    def compute(self) -> dict:
+        aps = {}
+        for c in range(self.num_classes):
+            if self._n_gt[c] == 0:
+                continue
+            dets = sorted(self._dets[c], key=lambda d: -d[0])
+            if not dets:
+                aps[c] = 0.0
+                continue
+            matched: dict[int, set] = {}
+            tp = np.zeros(len(dets))
+            fp = np.zeros(len(dets))
+            for i, (score, box, img, gts) in enumerate(dets):
+                if len(gts) == 0:
+                    fp[i] = 1
+                    continue
+                ious = _iou_matrix(box[None], gts)[0]
+                j = int(np.argmax(ious))
+                if ious[j] >= self.iou_threshold and \
+                        j not in matched.setdefault(img, set()):
+                    tp[i] = 1
+                    matched[img].add(j)
+                else:
+                    fp[i] = 1
+            ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+            recall = ctp / self._n_gt[c]
+            precision = ctp / np.maximum(ctp + cfp, 1e-9)
+            aps[c] = average_precision(recall, precision, self.use_07)
+        mean_ap = float(np.mean(list(aps.values()))) if aps else 0.0
+        return {"mAP": mean_ap, "per_class": aps}

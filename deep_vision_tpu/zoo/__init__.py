@@ -1,5 +1,7 @@
 """Experiment zoo: registers a TrainConfig per model, replacing the
 reference's per-directory ``training_config`` dicts."""
 
+import deep_vision_tpu.zoo.classifiers  # noqa: F401
+import deep_vision_tpu.zoo.detection  # noqa: F401
 import deep_vision_tpu.zoo.lenet  # noqa: F401
 import deep_vision_tpu.zoo.resnet  # noqa: F401

@@ -1,0 +1,41 @@
+"""Detection experiments — parity with YOLO/tensorflow/train.py:13-17
+(batch 16/replica, 416², 300 epochs, COCO 80 classes) and its hand-rolled
+epoch-table LR decay (:56-68)."""
+
+import jax.numpy as jnp
+
+from deep_vision_tpu.core.config import (
+    OptimizerConfig,
+    SchedulerConfig,
+    TrainConfig,
+    register_config,
+)
+from deep_vision_tpu.models.yolo import YoloV3
+
+
+def _yolo(name, num_classes, batch):
+    return TrainConfig(
+        name=name,
+        model=lambda: YoloV3(num_classes=num_classes, dtype=jnp.bfloat16),
+        task="detection",
+        batch_size=batch,
+        total_epochs=300,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3,
+                                  grad_clip_norm=10.0),
+        scheduler=SchedulerConfig(
+            name="epoch_table",
+            kwargs=dict(table={1: 1e-3, 40: 1e-4, 60: 1e-5})),
+        image_size=416,
+        num_classes=num_classes,
+    )
+
+
+@register_config("yolov3_coco")
+def yolov3_coco():
+    # 8×V100 reference ran global batch 8×16 (train.py:281-296)
+    return _yolo("yolov3_coco", 80, 128)
+
+
+@register_config("yolov3_voc")
+def yolov3_voc():
+    return _yolo("yolov3_voc", 20, 16)

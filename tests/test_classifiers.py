@@ -1,0 +1,115 @@
+"""Shape/param/behavior tests for the classifier zoo (SURVEY §4a: the
+reference's model.summary()/torchsummary printouts are the spec)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deep_vision_tpu.models import (
+    AlexNetV1,
+    AlexNetV2,
+    InceptionV1,
+    InceptionV3,
+    MobileNetV1,
+    ShuffleNetV1,
+    VGG16,
+    VGG19,
+)
+from deep_vision_tpu.models.common import count_params, local_response_norm
+
+
+def _init_apply(model, size, train=False, num_out=10):
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, size, size, 3))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    rngs = {"dropout": jax.random.PRNGKey(2)} if train else None
+    kwargs = dict(rngs=rngs) if train else {}
+    mutable = ["batch_stats"] if "batch_stats" in variables else False
+    out = model.apply(variables, x, train=train, mutable=mutable, **kwargs)
+    if mutable:
+        out, _ = out
+    return variables, out
+
+
+def _shape_count(model, size):
+    # eval_shape: param arithmetic without compiling the init program
+    v = jax.eval_shape(
+        lambda x: model.init({"params": jax.random.PRNGKey(0)}, x,
+                             train=False),
+        jnp.zeros((1, size, size, 3)))
+    return count_params(v["params"])
+
+
+# goldens: VGG/MobileNet/InceptionV3 match the canonical models exactly;
+# AlexNets follow the reference's filter plans (V1 one-tower 96/256/...,
+# V2 "one weird trick" 64/192/384/384/256 — NOT torchvision's 256-conv4)
+@pytest.mark.parametrize("ctor,size,expected", [
+    (VGG16, 224, 138_357_544),
+    (VGG19, 224, 143_667_240),
+    (AlexNetV1, 224, 62_378_344),
+    (AlexNetV2, 224, 61_838_248),
+    (InceptionV1, 224, 13_378_280),  # incl. both aux heads
+    (MobileNetV1, 224, 4_231_976),
+    (ShuffleNetV1, 224, 1_865_728),
+    (InceptionV3, 299, 27_161_264),  # == torchvision inception_v3
+])
+def test_param_counts(ctor, size, expected):
+    assert _shape_count(ctor(), size) == expected
+
+
+@pytest.mark.parametrize("ctor,size", [
+    (AlexNetV1, 96), (AlexNetV2, 96), (VGG16, 64),
+    (MobileNetV1, 64), (ShuffleNetV1, 64),
+])
+def test_eval_forward_shape(ctor, size):
+    _, out = _init_apply(ctor(num_classes=10), size)
+    assert out.shape == (2, 10)
+    assert out.dtype == jnp.float32
+
+
+def test_inception_v1_aux_heads_train_only():
+    model = InceptionV1(num_classes=10)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 128, 128, 3))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    out = model.apply(variables, x, train=False)
+    assert out.shape == (2, 10)  # eval: single head
+    outs = model.apply(variables, x, train=True,
+                       rngs={"dropout": jax.random.PRNGKey(2)})
+    assert isinstance(outs, tuple) and len(outs) == 3  # main + 2 aux
+    assert all(o.shape == (2, 10) for o in outs)
+
+
+def test_inception_v3_aux_head_train_only():
+    model = InceptionV3(num_classes=10)
+    x = jax.random.normal(jax.random.PRNGKey(1), (1, 299, 299, 3))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    out, _ = model.apply(variables, x, train=True, mutable=["batch_stats"],
+                         rngs={"dropout": jax.random.PRNGKey(2)})
+    assert isinstance(out, tuple) and len(out) == 2
+    assert out[0].shape == (1, 10) and out[1].shape == (1, 10)
+
+
+def test_mobilenet_alpha_scales_width():
+    nb = _shape_count(MobileNetV1(alpha=1.0), 64)
+    ns = _shape_count(MobileNetV1(alpha=0.5), 64)
+    assert ns < 0.45 * nb
+
+
+def test_shufflenet_channel_shuffle_is_permutation():
+    from deep_vision_tpu.models.shufflenet import channel_shuffle
+
+    x = jnp.arange(12, dtype=jnp.float32).reshape(1, 1, 1, 12)
+    y = channel_shuffle(x, 3)
+    assert sorted(np.asarray(y).ravel().tolist()) == list(range(12))
+    # groups interleave: [0,4,8, 1,5,9, ...]
+    assert np.asarray(y).ravel()[:3].tolist() == [0.0, 4.0, 8.0]
+
+
+def test_lrn_matches_torch():
+    torch = pytest.importorskip("torch")
+
+    x = np.random.default_rng(0).normal(size=(2, 7, 7, 6)).astype(np.float32)
+    ours = np.asarray(local_response_norm(jnp.asarray(x), size=5))
+    xt = torch.from_numpy(x).permute(0, 3, 1, 2)  # NHWC→NCHW
+    ref = torch.nn.LocalResponseNorm(5)(xt).permute(0, 2, 3, 1).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,168 @@
+"""Detection stack tests: codec roundtrip, hand-computed IoU/NMS fixtures,
+label encoding, loss behavior, mAP (SURVEY §4b: numerical tests of loss and
+box codecs against hand-computed fixtures)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deep_vision_tpu.models.yolo import ANCHOR_MASKS, YOLO_ANCHORS
+from deep_vision_tpu.ops.boxes import (
+    batched_nms,
+    broadcast_iou,
+    nms_single,
+    xywh_to_corners,
+)
+from deep_vision_tpu.tasks import detection as D
+from deep_vision_tpu.tasks.map_eval import MeanAPEvaluator, average_precision
+
+
+def test_xywh_to_corners():
+    box = jnp.array([[0.5, 0.5, 0.2, 0.4]])
+    out = np.asarray(xywh_to_corners(box))
+    np.testing.assert_allclose(out, [[0.4, 0.3, 0.6, 0.7]], atol=1e-6)
+
+
+def test_broadcast_iou_hand_fixture():
+    a = jnp.array([[0.0, 0.0, 2.0, 2.0]])          # area 4
+    b = jnp.array([[1.0, 1.0, 3.0, 3.0],           # inter 1, union 7
+                   [0.0, 0.0, 2.0, 2.0],           # identical
+                   [5.0, 5.0, 6.0, 6.0]])          # disjoint
+    iou = np.asarray(broadcast_iou(a, b))
+    np.testing.assert_allclose(iou, [[1 / 7, 1.0, 0.0]], atol=1e-6)
+
+
+def test_decode_encode_roundtrip():
+    anchors = jnp.asarray(YOLO_ANCHORS[ANCHOR_MASKS[2]])
+    rng = np.random.default_rng(0)
+    raw = rng.normal(0, 1, size=(2, 13, 13, 3, 85)).astype(np.float32)
+    box, obj, cls = D.decode_boxes(jnp.asarray(raw), anchors)
+    t_xy, t_wh = D.encode_boxes(box, anchors)
+    # encode(decode(raw)) recovers sigmoid(txy) and twh
+    np.testing.assert_allclose(
+        np.asarray(t_xy), jax.nn.sigmoid(raw[..., 0:2]), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(t_wh), raw[..., 2:4], atol=1e-4)
+    assert float(obj.min()) >= 0 and float(obj.max()) <= 1
+
+
+def test_nms_suppresses_overlaps():
+    boxes = jnp.array([[0.0, 0.0, 1.0, 1.0],
+                       [0.05, 0.0, 1.05, 1.0],   # IoU≈0.9 with box 0
+                       [2.0, 2.0, 3.0, 3.0]])    # disjoint
+    scores = jnp.array([0.9, 0.8, 0.7])
+    idx, sel, valid = nms_single(boxes, scores, max_outputs=3,
+                                 iou_threshold=0.5)
+    assert valid.tolist() == [1.0, 1.0, 0.0]     # only 2 survive
+    assert idx.tolist()[:2] == [0, 2]
+    np.testing.assert_allclose(sel[:2], [0.9, 0.7])
+
+
+def test_batched_nms_shapes():
+    rng = np.random.default_rng(1)
+    boxes = jnp.asarray(rng.uniform(0, 1, (4, 50, 4)).astype(np.float32))
+    boxes = jnp.concatenate([boxes[..., :2],
+                             boxes[..., :2] + 0.1 + boxes[..., 2:] * 0.2], -1)
+    scores = jnp.asarray(rng.uniform(0, 1, (4, 50)).astype(np.float32))
+    idx, sel, valid = batched_nms(boxes, scores, max_outputs=10)
+    assert idx.shape == (4, 10) and valid.shape == (4, 10)
+
+
+def test_find_best_anchor():
+    # exactly the largest anchor → index 8; tiny box → index 0
+    wh = np.array([[373 / 416, 326 / 416], [8 / 416, 10 / 416]])
+    best = D.find_best_anchor(wh)
+    assert best.tolist() == [8, 0]
+
+
+def test_encode_labels_places_box():
+    # one box at center, size matching anchor 8 (large) → scale 2, cell (6,6)
+    boxes = np.array([[0.5, 0.5, 373 / 416, 326 / 416]], np.float32)
+    classes = np.array([3])
+    enc = D.encode_labels(boxes, classes, num_classes=20)
+    y2 = enc["y_true_2"]  # 13×13 grid
+    assert y2[6, 6, 2, 4] == 1.0          # obj at anchor slot 2 (idx 8)
+    assert y2[6, 6, 2, 5 + 3] == 1.0      # one-hot class
+    np.testing.assert_allclose(y2[6, 6, 2, 0:4], boxes[0], atol=1e-6)
+    assert enc["y_true_0"].sum() == 0 and enc["y_true_1"].sum() == 0
+    assert enc["boxes_mask"].sum() == 1
+
+
+def test_yolo_loss_zero_for_perfect_prediction():
+    """If raw predictions exactly re-encode the ground truth, coordinate and
+    class losses vanish and obj loss is small (finite BCE saturation)."""
+    num_classes = 4
+    enc = D.encode_labels(
+        np.array([[0.48, 0.52, 116 / 416, 90 / 416]], np.float32),
+        np.array([1]), num_classes, grids=(13,),
+        masks=np.array([[6, 7, 8]]))
+    y_true = jnp.asarray(enc["y_true_0"])[None]
+    anchors = jnp.asarray(YOLO_ANCHORS[[6, 7, 8]])
+    # build raw that decodes to the truth: logit-space inversion
+    t_xy, t_wh = D.encode_boxes(y_true[..., 0:4], anchors)
+    eps = 1e-6
+    raw_xy = jnp.log(t_xy + eps) - jnp.log(1 - t_xy + eps)  # σ⁻¹
+    obj_logit = jnp.where(y_true[..., 4:5] > 0, 20.0, -20.0)
+    cls_logit = jnp.where(y_true[..., 5:] > 0, 20.0, -20.0)
+    raw = jnp.concatenate([raw_xy, t_wh, obj_logit, cls_logit], -1)
+    total, comps = D.yolo_scale_loss(
+        raw, y_true, jnp.asarray(enc["boxes"])[None],
+        jnp.asarray(enc["boxes_mask"])[None], anchors)
+    assert float(comps["xy"].sum()) < 1e-4
+    assert float(comps["wh"].sum()) < 1e-4
+    assert float(comps["class"].sum()) < 1e-3
+    assert float(comps["obj"].sum()) < 1e-3
+    assert float(total.sum()) < 2e-3
+
+
+def test_yolo_loss_penalizes_wrong_prediction():
+    num_classes = 4
+    enc = D.encode_labels(
+        np.array([[0.5, 0.5, 116 / 416, 90 / 416]], np.float32),
+        np.array([1]), num_classes, grids=(13,), masks=np.array([[6, 7, 8]]))
+    y_true = jnp.asarray(enc["y_true_0"])[None]
+    anchors = jnp.asarray(YOLO_ANCHORS[[6, 7, 8]])
+    raw = jnp.zeros((1, 13, 13, 3, 5 + num_classes))
+    total, _ = D.yolo_scale_loss(
+        raw, y_true, jnp.asarray(enc["boxes"])[None],
+        jnp.asarray(enc["boxes_mask"])[None], anchors)
+    assert float(total.sum()) > 1.0
+
+
+def test_average_precision_perfect():
+    r = np.array([0.5, 1.0])
+    p = np.array([1.0, 1.0])
+    assert average_precision(r, p) == pytest.approx(1.0)
+    assert average_precision(r, p, use_07_metric=True) == pytest.approx(1.0, abs=0.1)
+
+
+def test_map_evaluator_perfect_and_miss():
+    ev = MeanAPEvaluator(num_classes=2)
+    gt = np.array([[0.0, 0.0, 1.0, 1.0]])
+    ev.add(gt, np.array([0.9]), np.array([0]), gt, np.array([0]))
+    # second image: class 1 gt, detection misses (disjoint box)
+    ev.add(np.array([[5, 5, 6, 6.0]]), np.array([0.8]), np.array([1]),
+           np.array([[0.0, 0.0, 1.0, 1.0]]), np.array([1]))
+    res = ev.compute()
+    assert res["per_class"][0] == pytest.approx(1.0)
+    assert res["per_class"][1] == pytest.approx(0.0)
+    assert res["mAP"] == pytest.approx(0.5)
+
+
+def test_yolov3_model_shapes():
+    from deep_vision_tpu.models.yolo import YoloV3
+
+    model = YoloV3(num_classes=20)
+    x = jnp.zeros((1, 128, 128, 3))
+    variables = jax.eval_shape(
+        lambda a: model.init({"params": jax.random.PRNGKey(0)}, a,
+                             train=False), x)
+    outs = jax.eval_shape(
+        lambda v, a: model.apply(v, a, train=False), variables, x)
+    assert outs[0].shape == (1, 16, 16, 3, 25)   # large grid (÷8)
+    assert outs[1].shape == (1, 8, 8, 3, 25)
+    assert outs[2].shape == (1, 4, 4, 3, 25)
+    from deep_vision_tpu.models.common import count_params
+
+    n = sum(x.size for x in jax.tree_util.tree_leaves(variables["params"]))
+    assert 61_000_000 < n < 63_000_000  # canonical yolov3-coco≈62M (here C=20)

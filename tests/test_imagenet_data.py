@@ -1,0 +1,157 @@
+"""ImageNet pipeline tests on synthetic JPEGs (no dataset download)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.data import transforms as T
+from deep_vision_tpu.data.imagenet import ImageNetFolder, ImageNetLoader
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def fake_imagenet(tmp_path_factory):
+    root = tmp_path_factory.mktemp("imagenet")
+    img_dir = root / "train"
+    img_dir.mkdir()
+    synsets = ["n01440764", "n01443537", "n01484850"]
+    rng = np.random.default_rng(0)
+    for s_i, syn in enumerate(synsets):
+        for j in range(6):
+            arr = rng.integers(0, 255, size=(40 + 8 * s_i, 64, 3),
+                               dtype=np.uint8)
+            Image.fromarray(arr).save(img_dir / f"{syn}_{j}.JPEG")
+    labels_file = root / "metadata.txt"
+    labels_file.write_text(
+        "\n".join(f"{s} class_{i}" for i, s in enumerate(synsets)))
+    return str(img_dir), str(labels_file)
+
+
+def test_folder_labels_from_filename_prefix(fake_imagenet):
+    root, labels = fake_imagenet
+    ds = ImageNetFolder(root, labels)
+    assert len(ds) == 18
+    img, label = ds.read(0)
+    assert img.ndim == 3 and img.shape[2] == 3
+    assert 0 <= label < 3
+
+
+def test_transforms_shapes_and_ranges():
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, size=(300, 400, 3), dtype=np.uint8)
+    out = T.train_transform(img, rng, size=224, resize=256)
+    assert out.shape == (224, 224, 3) and out.dtype == np.float32
+    ev = T.eval_transform(img, size=224, resize=256)
+    assert ev.shape == (224, 224, 3)
+    # rescale puts the SHORTER side at the target
+    r = T.rescale(img, 256)
+    assert min(r.shape[:2]) == 256 and max(r.shape[:2]) == 341
+
+
+def test_rescale_no_op_and_portrait():
+    img = np.zeros((500, 250, 3), np.uint8)
+    r = T.rescale(img, 100)
+    assert r.shape == (200, 100, 3)
+
+
+def test_center_crop_is_deterministic():
+    img = np.arange(10 * 10 * 3, dtype=np.uint8).reshape(10, 10, 3)
+    a = T.center_crop(img, 4)
+    b = T.center_crop(img, 4)
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (4, 4, 3)
+
+
+def test_loader_batches_and_reshuffle(fake_imagenet):
+    root, labels = fake_imagenet
+    loader = ImageNetLoader(root, labels, batch_size=4, train=True,
+                            image_size=32, resize=36, num_workers=0,
+                            process_index=0, process_count=1)
+    batches = list(loader)
+    assert len(batches) == 4  # 18 // 4
+    b = batches[0]
+    assert b["image"].shape == (4, 32, 32, 3)
+    assert b["label"].dtype == np.int32
+    loader.set_epoch(1)
+    batches2 = list(loader)
+    # different epoch ⇒ different order (labels differ somewhere)
+    l1 = np.concatenate([b["label"] for b in batches])
+    l2 = np.concatenate([b["label"] for b in batches2])
+    assert not np.array_equal(l1, l2)
+
+
+def test_loader_host_sharding(fake_imagenet):
+    root, labels = fake_imagenet
+    l0 = ImageNetLoader(root, labels, batch_size=2, train=False,
+                        image_size=32, resize=36, num_workers=0,
+                        process_index=0, process_count=2)
+    l1 = ImageNetLoader(root, labels, batch_size=2, train=False,
+                        image_size=32, resize=36, num_workers=0,
+                        process_index=1, process_count=2)
+    assert len(set(l0.host_indices) & set(l1.host_indices)) == 0
+    assert len(l0.host_indices) + len(l1.host_indices) == 18
+
+
+def test_multiprocess_workers(fake_imagenet):
+    root, labels = fake_imagenet
+    loader = ImageNetLoader(root, labels, batch_size=4, train=True,
+                            image_size=32, resize=36, num_workers=2,
+                            process_index=0, process_count=1)
+    try:
+        b = next(iter(loader))
+        assert b["image"].shape == (4, 32, 32, 3)
+        assert np.isfinite(b["image"]).all()
+    finally:
+        loader.close()
+
+
+def test_val_loader_isolated_from_train_with_zero_workers(fake_imagenet):
+    """Regression: two 0-worker loaders must not share decode state —
+    val must read val files with eval transforms."""
+    root, labels = fake_imagenet
+    tr = ImageNetLoader(root, labels, batch_size=4, train=True,
+                        image_size=32, resize=36, num_workers=0,
+                        process_index=0, process_count=1)
+    va = ImageNetLoader(root, labels, batch_size=4, train=False,
+                        image_size=32, resize=36, num_workers=0,
+                        process_index=0, process_count=1)
+    _ = next(iter(tr))  # train first, as fit() does
+    b1 = next(iter(va))
+    b2 = next(iter(va))
+    # eval transform is deterministic ⇒ identical batches across epochs
+    np.testing.assert_array_equal(b1["image"], b2["image"])
+    np.testing.assert_array_equal(b1["label"], b2["label"])
+
+
+def test_eval_pads_final_partial_batch(fake_imagenet):
+    root, labels = fake_imagenet
+    va = ImageNetLoader(root, labels, batch_size=4, train=False,
+                        image_size=32, resize=36, num_workers=0,
+                        process_index=0, process_count=1)
+    batches = list(va)
+    assert len(batches) == 5  # 18 imgs → 4 full + 1 padded
+    w = np.concatenate([b["weight"] for b in batches])
+    assert w.sum() == 18.0  # every real image counted exactly once
+    assert batches[-1]["image"].shape == (4, 32, 32, 3)  # static shape
+
+
+def test_prefetch_propagates_producer_errors():
+    import jax
+    import pytest as _pytest
+
+    from deep_vision_tpu.data.loader import prefetch_to_device
+    from deep_vision_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+
+    def bad_iter():
+        yield {"image": np.zeros((2, 4, 4, 1), np.float32)}
+        raise RuntimeError("decode failed")
+
+    it = prefetch_to_device(bad_iter(), mesh)
+    next(it)
+    with _pytest.raises(RuntimeError, match="decode failed"):
+        next(it)

@@ -13,7 +13,9 @@ from deep_vision_tpu.models.common import count_params
 
 def _init(model, size=64):
     x = jnp.zeros((1, size, size, 3), jnp.float32)
-    return model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    return jax.eval_shape(
+        lambda a: model.init({"params": jax.random.PRNGKey(0)}, a,
+                             train=False), x)
 
 
 @pytest.mark.parametrize("ctor,expected", [
