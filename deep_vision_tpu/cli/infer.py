@@ -1,0 +1,214 @@
+"""Inference CLI — the reference's per-model demo paths in one place:
+classification notebooks (ResNet/pytorch/notebooks/*), YOLO demo + NMS
+(YOLO/tensorflow/postprocess.py via demo_mscoco.ipynb), CycleGAN sample
+generation (CycleGAN/tensorflow/inference.py:11-77), DCGAN sampling
+(DCGAN/tensorflow/inference.py:7-32), plus StableHLO export
+(the TFLite path, CycleGAN/tensorflow/convert.py:7-16).
+
+    python -m deep_vision_tpu.cli.infer classify -m resnet50 --workdir runs/x \\
+        --images a.jpg b.jpg
+    python -m deep_vision_tpu.cli.infer detect -m yolov3_voc --workdir ... \\
+        --images street.jpg --score-threshold 0.3
+    python -m deep_vision_tpu.cli.infer sample -m dcgan --workdir ... -n 16 \\
+        --out samples.png
+    python -m deep_vision_tpu.cli.infer export -m resnet50 --workdir ... \\
+        --out model.stablehlo
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def _load_state(cfg, workdir):
+    import jax
+
+    from deep_vision_tpu.core import checkpoint as ckpt_lib
+    from deep_vision_tpu.core.optim import build_optimizer
+    from deep_vision_tpu.core.state import TrainState
+    import functools
+    import jax.numpy as jnp
+
+    model = cfg.model()
+    x = jnp.zeros((1, cfg.image_size, cfg.image_size, cfg.channels))
+    variables = jax.jit(functools.partial(model.init, train=False))(
+        {"params": jax.random.PRNGKey(0)}, x)
+    state = TrainState.create(
+        apply_fn=model.apply, params=variables["params"],
+        tx=build_optimizer(cfg.optimizer),
+        batch_stats=variables.get("batch_stats", {}))
+    for sub in ("checkpoints_best", "checkpoints"):
+        d = os.path.join(workdir, sub)
+        if os.path.isdir(d):
+            ckpt = ckpt_lib.Checkpointer(d)
+            if ckpt.latest_step() is not None:
+                state, _ = ckpt.restore(state)
+                print(f"[infer] restored from {d} step {ckpt.latest_step()}")
+                break
+    else:
+        print("[infer] WARNING: no checkpoint found, using random init")
+    return model, state
+
+
+def _read_image(path, size, channels=3):
+    import numpy as np
+    from PIL import Image
+
+    if channels == 1:  # grayscale models (LeNet): MNIST-style preprocessing
+        from deep_vision_tpu.data.mnist import preprocess
+
+        img = np.asarray(Image.open(path).convert("L").resize((size - 4,
+                                                               size - 4)))
+        return preprocess(img[None])[0][:size, :size]
+    img = np.asarray(Image.open(path).convert("RGB"))
+    from deep_vision_tpu.data.transforms import eval_transform
+
+    return eval_transform(img, size, max(size * 256 // 224, size + 8))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="deep_vision_tpu inference")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    for name in ("classify", "detect", "pose", "sample", "translate",
+                 "export"):
+        s = sub.add_parser(name)
+        s.add_argument("-m", "--model", required=True)
+        s.add_argument("--workdir", required=True)
+        if name in ("classify", "detect", "pose", "translate"):
+            s.add_argument("--images", nargs="+", required=True)
+        if name == "detect":
+            s.add_argument("--score-threshold", type=float, default=0.3)
+        if name == "sample":
+            s.add_argument("-n", type=int, default=16)
+            s.add_argument("--out", default="samples.png")
+        if name == "translate":
+            s.add_argument("--direction", default="a2b")
+            s.add_argument("--out-dir", default="translated")
+        if name == "export":
+            s.add_argument("--out", default="model.stablehlo")
+    args = p.parse_args(argv)
+
+    from deep_vision_tpu.core.config import get_config
+
+    cfg = get_config(args.model)
+    import jax.numpy as jnp
+    import numpy as np
+
+    if args.cmd == "classify":
+        model, state = _load_state(cfg, args.workdir)
+        x = jnp.asarray(np.stack([_read_image(f, cfg.image_size,
+                                              cfg.channels)
+                                  for f in args.images]))
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        logits = model.apply(variables, x, train=False)
+        top5 = np.argsort(np.asarray(logits), -1)[:, -5:][:, ::-1]
+        for f, t in zip(args.images, top5):
+            print(f"{f}: top-5 classes {t.tolist()}")
+    elif args.cmd == "detect":
+        from deep_vision_tpu.tasks.detection import postprocess
+
+        model, state = _load_state(cfg, args.workdir)
+        imgs = [np.asarray(_read_image(f, cfg.image_size))
+                for f in args.images]
+        # detection uses [0,1] inputs, not imagenet-normalized
+        from PIL import Image
+
+        from deep_vision_tpu.data.detection import resize_square
+
+        raw = [resize_square(np.asarray(Image.open(f).convert("RGB")),
+                             cfg.image_size).astype(np.float32) / 255.0
+               for f in args.images]
+        x = jnp.asarray(np.stack(raw))
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        outs = model.apply(variables, x, train=False)
+        boxes, scores, classes, valid = postprocess(
+            outs, cfg.num_classes, score_threshold=args.score_threshold)
+        for i, f in enumerate(args.images):
+            n = int(np.asarray(valid[i]).sum())
+            print(f"{f}: {n} detections")
+            for j in range(n):
+                b = np.asarray(boxes[i, j]).round(3).tolist()
+                print(f"  class={int(classes[i, j])} "
+                      f"score={float(scores[i, j]):.3f} box={b}")
+    elif args.cmd == "sample":
+        import jax
+
+        from deep_vision_tpu.core.adversarial import AdversarialTrainer
+        from deep_vision_tpu.models import gan as gan_models
+        from deep_vision_tpu.tasks.gan import DCGANTask
+
+        task = DCGANTask(gan_models.DCGANGenerator(),
+                         gan_models.DCGANDiscriminator(), opt=cfg.optimizer)
+        trainer = AdversarialTrainer(cfg, task, workdir=args.workdir)
+        states = task.init_states(
+            jax.random.PRNGKey(0),
+            {"image": np.zeros((1, cfg.image_size, cfg.image_size,
+                                cfg.channels), np.float32)})
+        states, _ = trainer.checkpointer.restore_tree(states)
+        imgs = task.sample(states, args.n, jax.random.PRNGKey(1))
+        _save_grid(imgs, args.out)
+        print(f"wrote {args.n} samples to {args.out}")
+    elif args.cmd == "translate":
+        import jax
+
+        from deep_vision_tpu.core.adversarial import AdversarialTrainer
+        from deep_vision_tpu.models import gan as gan_models
+        from deep_vision_tpu.tasks.gan import CycleGANTask
+        from deep_vision_tpu.data.detection import resize_square
+        from PIL import Image
+
+        task = CycleGANTask(lambda: gan_models.CycleGANGenerator(),
+                            lambda: gan_models.PatchGANDiscriminator(),
+                            opt=cfg.optimizer)
+        trainer = AdversarialTrainer(cfg, task, workdir=args.workdir)
+        sample = np.zeros((1, cfg.image_size, cfg.image_size, 3), np.float32)
+        states = task.init_states(jax.random.PRNGKey(0),
+                                  {"image_a": sample, "image_b": sample})
+        states, _ = trainer.checkpointer.restore_tree(states)
+        os.makedirs(args.out_dir, exist_ok=True)
+        for f in args.images:
+            img = resize_square(np.asarray(Image.open(f).convert("RGB")),
+                                cfg.image_size)
+            x = img.astype(np.float32) / 127.5 - 1.0
+            out = task.translate(states, x[None], args.direction)[0]
+            out8 = ((out + 1) * 127.5).clip(0, 255).astype(np.uint8)
+            dst = os.path.join(args.out_dir, os.path.basename(f))
+            Image.fromarray(out8).save(dst)
+            print(f"{f} -> {dst}")
+    elif args.cmd == "export":
+        from deep_vision_tpu.core.export import export_forward
+
+        model, state = _load_state(cfg, args.workdir)
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        n = export_forward(model, variables,
+                           (1, cfg.image_size, cfg.image_size, cfg.channels),
+                           args.out)
+        print(f"exported {n} bytes of StableHLO to {args.out}")
+    return 0
+
+
+def _save_grid(imgs, path, cols: int = 4):
+    import numpy as np
+    from PIL import Image
+
+    imgs = ((np.asarray(imgs) + 1) * 127.5).clip(0, 255).astype(np.uint8)
+    n, h, w, c = imgs.shape
+    rows = (n + cols - 1) // cols
+    grid = np.zeros((rows * h, cols * w, c), np.uint8)
+    for i, im in enumerate(imgs):
+        r, col = divmod(i, cols)
+        grid[r * h:(r + 1) * h, col * w:(col + 1) * w] = im
+    if c == 1:
+        grid = grid[..., 0]
+    Image.fromarray(grid).save(path)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,89 @@
+"""Dataset preparation CLI — replaces the reference's per-dataset scripts
+(Datasets/*/tfrecords*.py, build_imagenet_tfrecord.py, CycleGAN
+tfrecords.py/celeba.py) with one entry point:
+
+    python -m deep_vision_tpu.cli.prepare_data voc --voc-root VOCdevkit \\
+        --out ./records --split train
+    python -m deep_vision_tpu.cli.prepare_data coco \\
+        --annotations instances_train2017.json --images train2017 --out ...
+    python -m deep_vision_tpu.cli.prepare_data mpii --annotations train.json \\
+        --images images --out ...
+    python -m deep_vision_tpu.cli.prepare_data imagenet --src train_flat \\
+        --labels imagenet_2012_metadata.txt --out ...
+    python -m deep_vision_tpu.cli.prepare_data unpaired --dir-a trainA \\
+        --dir-b trainB --out ...
+    python -m deep_vision_tpu.cli.prepare_data celeba --attr list_attr.txt \\
+        --images img_align_celeba --out-a male --out-b female
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description="deep_vision_tpu data prep")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    voc = sub.add_parser("voc")
+    voc.add_argument("--voc-root", required=True)
+    voc.add_argument("--year", default="2007")
+    voc.add_argument("--names", default=None)
+
+    coco = sub.add_parser("coco")
+    coco.add_argument("--annotations", required=True)
+    coco.add_argument("--images", required=True)
+
+    mpii = sub.add_parser("mpii")
+    mpii.add_argument("--annotations", required=True)
+    mpii.add_argument("--images", required=True)
+
+    imagenet = sub.add_parser("imagenet")
+    imagenet.add_argument("--src", required=True)
+    imagenet.add_argument("--labels", required=True)
+
+    unpaired = sub.add_parser("unpaired")
+    unpaired.add_argument("--dir-a", required=True)
+    unpaired.add_argument("--dir-b", required=True)
+
+    celeba = sub.add_parser("celeba")
+    celeba.add_argument("--attr", required=True)
+    celeba.add_argument("--images", required=True)
+    celeba.add_argument("--out-a", required=True)
+    celeba.add_argument("--out-b", required=True)
+    celeba.add_argument("--attribute", default="Male")
+
+    for s in (voc, coco, mpii, imagenet, unpaired):
+        s.add_argument("--out", required=True)
+        s.add_argument("--split", default="train")
+        s.add_argument("--num-shards", type=int, default=8)
+        s.add_argument("--num-workers", type=int, default=8)
+
+    args = p.parse_args(argv)
+    from deep_vision_tpu.data import prep
+
+    if args.cmd == "voc":
+        n = prep.prepare_voc(args.voc_root, args.out, args.split, args.names,
+                             args.num_shards, args.num_workers, args.year)
+    elif args.cmd == "coco":
+        n = prep.prepare_coco(args.annotations, args.images, args.out,
+                              args.split, args.num_shards, args.num_workers)
+    elif args.cmd == "mpii":
+        n = prep.prepare_mpii(args.annotations, args.images, args.out,
+                              args.split, args.num_shards, args.num_workers)
+    elif args.cmd == "imagenet":
+        n = prep.prepare_imagenet(args.src, args.labels, args.out, args.split,
+                                  args.num_shards, args.num_workers)
+    elif args.cmd == "unpaired":
+        n = prep.prepare_unpaired(args.dir_a, args.dir_b, args.out,
+                                  args.split, args.num_shards,
+                                  args.num_workers)
+    else:
+        n = prep.split_celeba_by_attribute(args.attr, args.images, args.out_a,
+                                           args.out_b, args.attribute)
+    print(f"prepared: {n}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -33,6 +33,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="mesh spec like 'data=8' or 'data=4,model=2'")
     p.add_argument("--num-workers", type=int, default=16,
                    help="decode/augment worker processes (ImageNet path)")
+    p.add_argument("--profile", action="store_true",
+                   help="jax.profiler trace of steps 10-20 → workdir/profile")
     p.add_argument("--list", action="store_true", help="list configs and exit")
     return p
 
@@ -73,8 +75,12 @@ def main(argv=None):
     mesh = parse_mesh_spec(args.mesh)
     print(f"devices: {mesh.devices.ravel().tolist()} mesh={dict(mesh.shape)}")
 
-    if cfg.task == "detection":
+    if cfg.task in ("detection", "centernet"):
         return _main_detection(args, cfg, mesh)
+    if cfg.task == "pose":
+        return _main_pose(args, cfg, mesh)
+    if cfg.task.startswith("gan_"):
+        return _main_gan(args, cfg, mesh)
     if cfg.task != "classification":
         raise NotImplementedError(
             f"task '{cfg.task}' CLI wiring lands with its stack")
@@ -122,6 +128,8 @@ def main(argv=None):
             num_workers=args.num_workers)
 
     trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir)
+    if args.profile:
+        trainer.profile_steps = (10, 20)
     state = trainer.fit(train_loader, val_loader, resume=args.resume)
     final = trainer.evaluate(state, val_loader)
     print("final:", " ".join(f"{k}={v:.4f}" for k, v in final.items()))
@@ -130,13 +138,17 @@ def main(argv=None):
 
 def _main_detection(args, cfg, mesh):
     from deep_vision_tpu.core.trainer import Trainer
-    from deep_vision_tpu.data.detection import (
-        DetectionLoader,
-        synthetic_detection_dataset,
-    )
-    from deep_vision_tpu.tasks.detection import YoloTask
+    from deep_vision_tpu.data.detection import synthetic_detection_dataset
+    if cfg.task == "centernet":
+        from deep_vision_tpu.data.detection import CenterNetLoader as LoaderCls
+        from deep_vision_tpu.tasks.centernet import CenterNetTask
 
-    task = YoloTask(cfg.num_classes)
+        task = CenterNetTask(cfg.num_classes)
+    else:
+        from deep_vision_tpu.data.detection import DetectionLoader as LoaderCls
+        from deep_vision_tpu.tasks.detection import YoloTask
+
+        task = YoloTask(cfg.num_classes)
     if args.synthetic:
         train_samples = synthetic_detection_dataset(
             args.synthetic_size, cfg.image_size,
@@ -150,16 +162,113 @@ def _main_detection(args, cfg, mesh):
         assert args.data_root, "--data-root required without --synthetic"
         train_samples = load_detection_records(args.data_root, "train")
         val_samples = load_detection_records(args.data_root, "val")
-    train_loader = DetectionLoader(train_samples, cfg.batch_size,
-                                   cfg.num_classes, cfg.image_size,
-                                   train=True, seed=cfg.seed)
-    val_loader = DetectionLoader(val_samples, cfg.batch_size,
-                                 cfg.num_classes, cfg.image_size, train=False)
+    train_loader = LoaderCls(train_samples, cfg.batch_size,
+                             cfg.num_classes, cfg.image_size,
+                             train=True, seed=cfg.seed)
+    val_loader = LoaderCls(val_samples, cfg.batch_size,
+                           cfg.num_classes, cfg.image_size, train=False)
     trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir)
     state = trainer.fit(train_loader, val_loader, resume=args.resume)
     final = trainer.evaluate(state, val_loader)
     print("final:", " ".join(f"{k}={v:.4f}" for k, v in final.items()))
     return 0
+
+
+def _main_pose(args, cfg, mesh):
+    from deep_vision_tpu.core.trainer import Trainer
+    from deep_vision_tpu.data.pose import PoseLoader, synthetic_pose_dataset
+    from deep_vision_tpu.tasks.pose import PoseTask
+
+    task = PoseTask()
+    heatmap_size = cfg.image_size // 4
+    if args.synthetic:
+        train_samples = synthetic_pose_dataset(
+            args.synthetic_size, cfg.image_size, cfg.num_classes, seed=1)
+        val_samples = synthetic_pose_dataset(
+            max(args.synthetic_size // 4, cfg.batch_size), cfg.image_size,
+            cfg.num_classes, seed=2)
+    else:
+        from deep_vision_tpu.data.records import load_pose_records
+
+        assert args.data_root, "--data-root required without --synthetic"
+        train_samples = load_pose_records(args.data_root, "train")
+        val_samples = load_pose_records(args.data_root, "val")
+    train_loader = PoseLoader(train_samples, cfg.batch_size, cfg.image_size,
+                              heatmap_size, cfg.num_classes, train=True,
+                              seed=cfg.seed)
+    val_loader = PoseLoader(val_samples, cfg.batch_size, cfg.image_size,
+                            heatmap_size, cfg.num_classes, train=False)
+    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir)
+    state = trainer.fit(train_loader, val_loader, resume=args.resume)
+    final = trainer.evaluate(state, val_loader)
+    print("final:", " ".join(f"{k}={v:.4f}" for k, v in final.items()))
+    return 0
+
+
+def _main_gan(args, cfg, mesh):
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.core.adversarial import AdversarialTrainer
+    from deep_vision_tpu.models import gan as gan_models
+    from deep_vision_tpu.tasks.gan import CycleGANTask, DCGANTask
+
+    dtype = jnp.bfloat16 if cfg.half_precision else jnp.float32
+    if cfg.task == "gan_dcgan":
+        from deep_vision_tpu.data.gan import GANLoader, mnist_gan_data
+
+        images = mnist_gan_data(None if args.synthetic else args.data_root,
+                                n_synthetic=args.synthetic_size)
+        loader = GANLoader(images, cfg.batch_size, seed=cfg.seed)
+        task = DCGANTask(gan_models.DCGANGenerator(dtype=dtype),
+                         gan_models.DCGANDiscriminator(dtype=dtype),
+                         opt=cfg.optimizer)
+    else:
+        from deep_vision_tpu.data.gan import UnpairedLoader, synthetic_unpaired
+
+        if args.synthetic:
+            a, b = synthetic_unpaired(args.synthetic_size, cfg.image_size)
+        else:
+            a, b = _load_unpaired_records(args.data_root, cfg.image_size)
+        loader = UnpairedLoader(a, b, cfg.batch_size, seed=cfg.seed)
+        task = CycleGANTask(
+            lambda: gan_models.CycleGANGenerator(dtype=dtype),
+            lambda: gan_models.PatchGANDiscriminator(dtype=dtype),
+            opt=cfg.optimizer)
+
+    trainer = AdversarialTrainer(cfg, task, mesh=mesh, workdir=args.workdir)
+    states = trainer.fit(loader, epochs=cfg.total_epochs, resume=args.resume)
+    print("done: trained", ", ".join(states))
+    return 0
+
+
+def _load_unpaired_records(data_root, image_size):
+    """train_a/train_b dvrec shards (cli.prepare_data unpaired) →
+    two [-1,1] float arrays."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    from deep_vision_tpu.data.detection import resize_square
+    from deep_vision_tpu.data.records import list_shards, read_records
+
+    assert data_root, "--data-root required without --synthetic"
+    out = []
+    for tag in ("a", "b"):
+        shards = list_shards(data_root, f"train_{tag}")
+        if not shards:
+            raise FileNotFoundError(
+                f"no train_{tag}-*.dvrec under {data_root} "
+                "(run cli.prepare_data unpaired)")
+        imgs = []
+        for sh in shards:
+            for _, payload in read_records(sh):
+                img = np.asarray(Image.open(io.BytesIO(payload))
+                                 .convert("RGB"))
+                imgs.append(resize_square(img, image_size)
+                            .astype(np.float32) / 127.5 - 1.0)
+        out.append(np.stack(imgs))
+    return out[0], out[1]
 
 
 if __name__ == "__main__":

@@ -1,0 +1,117 @@
+"""AdversarialTrainer: multi-model / multi-optimizer training.
+
+Generalizes the Trainer to the reference's GAN loops — DCGAN's twin-tape
+simultaneous G/D step (DCGAN/tensorflow/main.py:55-71) and CycleGAN's
+generator-step → ImagePool → discriminator-step sequence
+(CycleGAN/tensorflow/train.py:150-265).
+
+Design: the GAN *task* owns the math as a pure function
+``task.train_step(states: dict[str, TrainState], batch, rng) ->
+(new_states, host_outputs, metrics)`` which is jitted whole (donated states).
+Host-side state between steps (the ImagePool, kept outside ``@tf.function``
+in the reference, utils.py:31) lives in ``task.host_update(outputs)`` which
+runs between jitted steps and can rewrite the next batch.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Iterable
+
+import jax
+
+from deep_vision_tpu.core import checkpoint as ckpt_lib
+from deep_vision_tpu.core.config import TrainConfig
+from deep_vision_tpu.core.metrics import MetricLogger, ThroughputMeter
+from deep_vision_tpu.core.optim import build_scheduler, set_learning_rate
+from deep_vision_tpu.parallel import make_mesh, replicate, shard_batch
+
+
+class AdversarialTrainer:
+    def __init__(self, config: TrainConfig, task, mesh=None,
+                 workdir: str | None = None):
+        self.config = config
+        self.task = task  # owns models, optimizers, and the step math
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.workdir = workdir or os.path.join("runs", config.name)
+        self.logger = MetricLogger(self.workdir)
+        self.scheduler = build_scheduler(
+            config.scheduler.name, config.optimizer.learning_rate,
+            **config.scheduler.kwargs)
+        self.checkpointer = ckpt_lib.Checkpointer(
+            os.path.join(self.workdir, "checkpoints"),
+            max_to_keep=config.keep_checkpoints)
+        self._jit_step = None
+        self.start_epoch = 1
+        self.start_step = 0
+
+    def init_states(self, sample_batch: dict) -> dict:
+        states = self.task.init_states(
+            jax.random.PRNGKey(self.config.seed), sample_batch)
+        return {k: replicate(v, self.mesh) for k, v in states.items()}
+
+    def maybe_resume(self, states: dict) -> dict:
+        if self.checkpointer.latest_step() is None:
+            return states
+        states, extras = self.checkpointer.restore_tree(states)
+        self.start_epoch = int(extras.get("epoch", 0)) + 1
+        self.start_step = int(self.checkpointer.latest_step() or 0)
+        if "scheduler" in extras:
+            self.scheduler.load_state_dict(extras["scheduler"])
+        print(f"[resume] adversarial start_epoch={self.start_epoch} "
+              f"step={self.start_step}")
+        return {k: replicate(v, self.mesh) for k, v in states.items()}
+
+    def train_step(self, states, batch, rng):
+        if self._jit_step is None:
+            self._jit_step = jax.jit(self.task.train_step, donate_argnums=0)
+        return self._jit_step(states, shard_batch(batch, self.mesh), rng)
+
+    def fit(self, train_data: Iterable, epochs: int | None = None,
+            states: dict | None = None, resume: bool = False,
+            sample_hook=None) -> dict:
+        cfg = self.config
+        epochs = epochs or cfg.total_epochs
+        if states is None:
+            states = self.init_states(next(iter(train_data)))
+        if resume:
+            states = self.maybe_resume(states)
+        rng = jax.random.PRNGKey(cfg.seed + 17)
+        step = self.start_step  # continues past-resume step numbering
+        for epoch in range(self.start_epoch, epochs + 1):
+            lr = self.scheduler.epoch_begin(epoch)
+            states = {k: v.replace(
+                opt_state=set_learning_rate(v.opt_state, lr))
+                for k, v in states.items()}
+            if hasattr(train_data, "set_epoch"):
+                train_data.set_epoch(epoch)
+            meter = ThroughputMeter()
+            t0 = time.time()
+            metrics = {}
+            for batch in train_data:
+                rng, step_rng = jax.random.split(rng)
+                batch = self.task.host_prepare(batch)
+                states, outputs, metrics = self.train_step(
+                    states, batch, step_rng)
+                self.task.host_update(outputs)
+                bs = len(next(iter(batch.values())))
+                meter.update(bs)
+                step += 1
+                if step % cfg.log_every_steps == 0:
+                    m = {k: float(v) for k, v in
+                         jax.device_get(metrics).items()}
+                    self.logger.log_dict(step, m)
+                    print(f"Epoch {epoch} Step {step} "
+                          + " ".join(f"{k}={v:.4f}" for k, v in m.items())
+                          + f" {meter.images_per_sec:.1f} img/s", flush=True)
+            self.scheduler.step(epoch, None)
+            print(f"Epoch {epoch} done in {time.time() - t0:.1f}s", flush=True)
+            if epoch % cfg.checkpoint_every_epochs == 0:
+                self.checkpointer.save_tree(
+                    step, states,
+                    extras={"epoch": epoch,
+                            "scheduler": self.scheduler.state_dict()})
+            if sample_hook is not None:
+                sample_hook(epoch, states)
+        return states

@@ -66,5 +66,38 @@ class Checkpointer:
         new_state = state.load_dict(restored["state"]["state"])
         return new_state, dict(restored["extras"] or {})
 
+    # -- multi-state trees (AdversarialTrainer: {name: TrainState}) --------
+
+    def save_tree(self, step: int, states: dict, extras: dict | None = None):
+        payload = {k: v.save_dict() for k, v in states.items()}
+        self._mgr.save(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(payload),
+                extras=ocp.args.JsonSave(extras or {}),
+            ),
+        )
+        self._mgr.wait_until_finished()
+
+    def restore_tree(self, states: dict, step: int | None = None
+                     ) -> tuple[dict, dict]:
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        abstract = jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct,
+            {k: v.save_dict() for k, v in states.items()})
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(abstract),
+                extras=ocp.args.JsonRestore(),
+            ),
+        )
+        new_states = {k: v.load_dict(restored["state"][k])
+                      for k, v in states.items()}
+        return new_states, dict(restored["extras"] or {})
+
     def close(self):
         self._mgr.close()

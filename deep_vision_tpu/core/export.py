@@ -1,0 +1,45 @@
+"""Model export — the reference's deployment path is TFLite conversion
+(CycleGAN/tensorflow/convert.py:7-16: SavedModel → TFLiteConverter →
+OPTIMIZE_FOR_SIZE).  The JAX-native equivalent is ``jax.export``: serialize
+the jitted forward to portable StableHLO bytes, reloadable on any XLA
+backend (CPU/GPU/TPU) without Python model code.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def export_forward(model, variables, input_shape, path: str,
+                   train: bool = False) -> int:
+    """Serialize model.apply(variables, x) to StableHLO at ``path``.
+
+    Returns the serialized byte count.  ``input_shape`` includes batch.
+    """
+    from jax import export as jexport
+
+    def forward(variables, x):
+        return model.apply(variables, x, train=train)
+
+    x_spec = jax.ShapeDtypeStruct(tuple(input_shape), jnp.float32)
+    v_spec = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), variables)
+    exported = jexport.export(jax.jit(forward))(v_spec, x_spec)
+    blob = exported.serialize()
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(blob)
+    return len(blob)
+
+
+def load_exported(path: str):
+    """Deserialize; returns a callable (variables, x) -> outputs."""
+    from jax import export as jexport
+
+    with open(path, "rb") as f:
+        exported = jexport.deserialize(f.read())
+    return exported.call

@@ -68,6 +68,10 @@ class Trainer:
         self._jit_train_step = None
         self._jit_eval_step = None
         self.start_epoch = 1
+        # profiling: trace steps [start, stop) of epoch 1 to
+        # workdir/profile (the reference had only throughput prints —
+        # SURVEY §5 tracing; TPU-native answer is a jax.profiler trace)
+        self.profile_steps: tuple[int, int] | None = None
 
     # ------------------------------------------------------------------ init
 
@@ -169,9 +173,22 @@ class Trainer:
         cfg = self.config
         meter = ThroughputMeter()
         pending = None  # async metric fetch: log step N-1 while N runs
+        profiling = self.profile_steps if epoch == self.start_epoch else None
+        trace_active = False
         # H2D double buffer: batch N+1 transfers while step N computes
         # (shard_batch in train_step is a no-op on already-placed arrays)
         for i, batch in enumerate(prefetch_to_device(train_data, self.mesh)):
+            if profiling is not None:
+                if i == profiling[0]:
+                    jax.profiler.start_trace(
+                        os.path.join(self.workdir, "profile"))
+                    trace_active = True
+                elif i == profiling[1]:
+                    jax.profiler.stop_trace()
+                    trace_active = False
+                    print(f"[profile] trace written to "
+                          f"{self.workdir}/profile", flush=True)
+                    profiling = None
             bs = len(jax.tree_util.tree_leaves(batch)[0])
             state, metrics = self.train_step(state, batch)
             meter.update(bs)
@@ -183,6 +200,11 @@ class Trainer:
                       f"lr {self.scheduler.lr:.2e} "
                       f"{meter.images_per_sec:.1f} img/s", flush=True)
             pending = metrics
+        if trace_active:
+            # epoch ended inside the trace window: flush what we have
+            jax.profiler.stop_trace()
+            print(f"[profile] short-epoch trace written to "
+                  f"{self.workdir}/profile", flush=True)
         if pending is not None:
             m = {k: float(v) for k, v in jax.device_get(pending).items()}
             self.logger.log_dict(int(state.step),

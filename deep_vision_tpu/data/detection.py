@@ -125,6 +125,28 @@ class DetectionLoader:
             yield {k: np.stack([it[k] for it in items]) for k in items[0]}
 
 
+class CenterNetLoader(DetectionLoader):
+    """Same sample format/augmentation, CenterNet target encoding
+    (tasks.centernet.encode_centernet_labels) at stride-4 resolution."""
+
+    def _prepare(self, sample: dict, rng: np.random.Generator) -> dict:
+        from deep_vision_tpu.tasks.centernet import encode_centernet_labels
+
+        img = sample["image"]
+        boxes = np.asarray(sample["boxes"], np.float32).reshape(-1, 4)
+        classes = np.asarray(sample["classes"], np.int64).reshape(-1)
+        if self.augment and len(boxes):
+            if rng.random() < 0.5:
+                img = img[:, ::-1]
+                boxes = flip_boxes_lr(boxes)
+        img = resize_square(img, self.image_size)
+        x = img.astype(np.float32) / 255.0
+        enc = encode_centernet_labels(
+            corners_to_xywh(boxes), classes, self.num_classes,
+            grid=self.image_size // 4)
+        return {"image": x, **enc}
+
+
 def synthetic_detection_dataset(n: int, image_size: int = 416,
                                 num_classes: int = 3, seed: int = 0
                                 ) -> list[dict]:

@@ -1,0 +1,100 @@
+"""GAN input pipelines.
+
+- DCGAN: MNIST in-memory, scaled to [-1, 1] (DCGAN/tensorflow/main.py:21-26
+  loads Keras MNIST and normalizes (x-127.5)/127.5).
+- CycleGAN: unpaired A/B iterator — the zip-of-two-shuffled-datasets from
+  CycleGAN/tensorflow/train.py:74-118; pairing is random per epoch.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+
+def mnist_gan_data(root: str | None = None, n_synthetic: int = 2048,
+                   seed: int = 0) -> np.ndarray:
+    """(N, 28, 28, 1) float32 in [-1, 1]; falls back to synthetic digits
+    when no MNIST directory is given."""
+    if root:
+        from deep_vision_tpu.data.mnist import load_idx_images
+
+        import os
+
+        for cand in ("train-images-idx3-ubyte", "train-images-idx3-ubyte.gz"):
+            p = os.path.join(root, cand)
+            if os.path.exists(p):
+                images = load_idx_images(p)
+                break
+        else:
+            raise FileNotFoundError(f"no MNIST idx images under {root}")
+    else:
+        from deep_vision_tpu.data.synthetic import synthetic_classification
+
+        images = synthetic_classification(n_synthetic, 28, 1, 10, seed)["image"]
+        images = (images - images.min()) / (np.ptp(images) + 1e-9) * 255.0
+        images = images[..., 0]
+    x = images.astype(np.float32)[..., None] if images.ndim == 3 else images
+    return (x - 127.5) / 127.5
+
+
+class GANLoader:
+    """Single-domain loader: {"image": (B,H,W,C) in [-1,1]}."""
+
+    def __init__(self, images: np.ndarray, batch_size: int, seed: int = 0):
+        self.images = images
+        self.batch_size = batch_size
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return len(self.images) // self.batch_size
+
+    def __iter__(self) -> Iterator[dict]:
+        rng = np.random.default_rng((self.seed, self.epoch))
+        idx = rng.permutation(len(self.images))
+        for b in range(len(self)):
+            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            yield {"image": self.images[sel]}
+
+
+class UnpairedLoader:
+    """Two-domain loader: {"image_a", "image_b"}, independently shuffled
+    (the tf.data zip of shuffled A and B, train.py:74-118)."""
+
+    def __init__(self, images_a: np.ndarray, images_b: np.ndarray,
+                 batch_size: int, seed: int = 0):
+        self.a, self.b = images_a, images_b
+        self.batch_size = batch_size
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        return min(len(self.a), len(self.b)) // self.batch_size
+
+    def __iter__(self) -> Iterator[dict]:
+        rng = np.random.default_rng((self.seed, self.epoch))
+        ia = rng.permutation(len(self.a))
+        ib = rng.permutation(len(self.b))
+        for k in range(len(self)):
+            s = slice(k * self.batch_size, (k + 1) * self.batch_size)
+            yield {"image_a": self.a[ia[s]], "image_b": self.b[ib[s]]}
+
+
+def synthetic_unpaired(n: int, image_size: int = 64, seed: int = 0
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """Two translatable domains: same shapes, opposite color casts."""
+    rng = np.random.default_rng(seed)
+    base = rng.uniform(-0.2, 0.2, size=(2 * n, image_size, image_size, 3))
+    ys, xs = np.mgrid[0:image_size, 0:image_size] / image_size
+    pattern = np.sin(6.28 * ys)[..., None] * np.array([1.0, -1.0, 0.5])
+    a = np.clip(base[:n] + pattern * 0.6 + [0.3, -0.3, 0.0], -1, 1)
+    b = np.clip(base[n:] - pattern * 0.6 + [-0.3, 0.3, 0.0], -1, 1)
+    return a.astype(np.float32), b.astype(np.float32)

@@ -1,0 +1,126 @@
+"""Pose input pipeline — parity with Hourglass/tensorflow/preprocess.py:
+keypoint-driven ``crop_roi`` with body-scale margin (:43-88), resize to 256²,
+16-channel 64² heatmap targets (:158-173 via ``tasks.pose.make_heatmaps``).
+
+Samples: {"image": HWC uint8, "keypoints": (K,3) [x_px, y_px, visibility],
+"center": (2,), "scale": float (MPII person scale, body height = scale·200)}.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Sequence
+
+import numpy as np
+
+from deep_vision_tpu.data.detection import resize_square
+from deep_vision_tpu.tasks.pose import make_heatmaps
+
+MPII_NUM_KEYPOINTS = 16
+# symmetric joints swapped under horizontal flip (MPII order:
+# 0-5 r/l ankle-knee-hip, 10-15 r/l wrist-elbow-shoulder)
+MPII_FLIP_PAIRS = ((0, 5), (1, 4), (2, 3), (10, 15), (11, 14), (12, 13))
+
+
+def crop_roi(img: np.ndarray, keypoints: np.ndarray, scale: float,
+             margin: float = 0.2) -> tuple[np.ndarray, np.ndarray]:
+    """Crop around visible keypoints with body-height margin; returns the
+    crop + keypoints in normalized crop coords (preprocess.py:43-88)."""
+    h, w = img.shape[:2]
+    kp = np.asarray(keypoints, np.float32)
+    vis = kp[:, 0] >= 0
+    if not vis.any():
+        norm = np.concatenate([kp[:, :2] / [w, h], kp[:, 2:3]], 1)
+        return img, norm
+    body = scale * 200.0
+    x1 = int(max(0, kp[vis, 0].min() - body * margin))
+    x2 = int(min(w, kp[vis, 0].max() + body * margin))
+    y1 = int(max(0, kp[vis, 1].min() - body * margin))
+    y2 = int(min(h, kp[vis, 1].max() + body * margin))
+    crop = img[y1:y2, x1:x2]
+    ch, cw = max(crop.shape[0], 1), max(crop.shape[1], 1)
+    out = kp.copy()
+    out[:, 0] = (kp[:, 0] - x1) / cw
+    out[:, 1] = (kp[:, 1] - y1) / ch
+    return crop, out
+
+
+class PoseLoader:
+    """Batch iterator: crop → resize 256² → [0,1] floats + 64² heatmaps."""
+
+    def __init__(self, samples: Sequence[dict], batch_size: int,
+                 image_size: int = 256, heatmap_size: int = 64,
+                 num_keypoints: int = MPII_NUM_KEYPOINTS,
+                 train: bool = True, seed: int = 0,
+                 flip_pairs: Sequence[tuple[int, int]] | None = MPII_FLIP_PAIRS):
+        self.samples = samples
+        # channel permutation applied on horizontal flip (left/right swap)
+        perm = np.arange(num_keypoints)
+        if flip_pairs:
+            for a, b in flip_pairs:
+                if a < num_keypoints and b < num_keypoints:
+                    perm[a], perm[b] = perm[b], perm[a]
+        self.flip_perm = perm
+        self.batch_size = batch_size
+        self.image_size = image_size
+        self.heatmap_size = heatmap_size
+        self.num_keypoints = num_keypoints
+        self.train = train
+        self.seed = seed
+        self.epoch = 0
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return len(self.samples) // self.batch_size
+
+    def _prepare(self, sample: dict, rng: np.random.Generator) -> dict:
+        img = sample["image"]
+        kp = np.asarray(sample["keypoints"], np.float32)
+        crop, norm_kp = crop_roi(img, kp, float(sample.get("scale", 1.0)))
+        if self.train and rng.random() < 0.5:
+            crop = crop[:, ::-1]
+            # mirror x AND swap symmetric joints (left wrist ↔ right wrist)
+            norm_kp = norm_kp[self.flip_perm].copy()
+            norm_kp[:, 0] = 1.0 - norm_kp[:, 0]
+        x = resize_square(crop, self.image_size).astype(np.float32) / 255.0
+        hm_kp = np.concatenate(
+            [norm_kp[:, :2] * self.heatmap_size, norm_kp[:, 2:3]], 1)
+        heat = make_heatmaps(hm_kp, self.heatmap_size, self.heatmap_size)
+        return {"image": x, "heatmaps": heat,
+                "keypoints": hm_kp.astype(np.float32)}
+
+    def __iter__(self) -> Iterator[dict]:
+        rng = np.random.default_rng((self.seed, self.epoch))
+        idx = np.arange(len(self.samples))
+        if self.train:
+            rng.shuffle(idx)
+        for b in range(len(self)):
+            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            items = [self._prepare(self.samples[i], rng) for i in sel]
+            yield {k: np.stack([it[k] for it in items]) for k in items[0]}
+
+
+def synthetic_pose_dataset(n: int, image_size: int = 256,
+                           num_keypoints: int = MPII_NUM_KEYPOINTS,
+                           seed: int = 0) -> list[dict]:
+    """Learnable synthetic poses: bright dots at keypoint locations."""
+    rng = np.random.default_rng(seed)
+    samples = []
+    for _ in range(n):
+        img = rng.integers(0, 48, size=(image_size, image_size, 3),
+                           dtype=np.uint8)
+        kp = np.zeros((num_keypoints, 3), np.float32)
+        for k in range(num_keypoints):
+            x = rng.uniform(0.15, 0.85) * image_size
+            y = rng.uniform(0.15, 0.85) * image_size
+            vis = 1.0 if rng.random() > 0.1 else 0.0
+            kp[k] = (x, y, vis)
+            if vis:
+                xi, yi = int(x), int(y)
+                img[max(0, yi - 3):yi + 3, max(0, xi - 3):xi + 3] = \
+                    [255, 40 + 12 * k, 220 - 12 * k]
+        samples.append({"image": img, "keypoints": kp,
+                        "center": np.array([image_size / 2] * 2, np.float32),
+                        "scale": image_size / 250.0})
+    return samples

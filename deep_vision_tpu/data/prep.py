@@ -1,0 +1,223 @@
+"""Dataset preparation: raw downloads → dvrec shards.
+
+Ports of the reference's prep layer (SURVEY §2.4), TF/ray-free:
+
+- VOC:  XML annotation parse (Datasets/VOC2007/tfrecords.py:124-155),
+  normalized corner boxes with the same bounds asserts (:61-64); the 2012
+  builder differs only in paths (SURVEY #33).
+- COCO: JSON → per-image grouped annotations (Datasets/MSCOCO/tfrecords.py:
+  115-133), category re-index from 1-based (:135-158), xywh→corners.
+- MPII: pose JSON → normalized keypoints + visibility remap 0/2
+  (Datasets/MPII/tfrecords_mpii.py:54-84).
+- ImageNet: flat synset-prefixed dir → classification shards (the
+  build_imagenet_tfrecord.py role; PNG/CMYK handling is PIL ``convert("RGB")``
+  at read time instead of a TF session, :236-270).
+- CycleGAN: pair-less two-dir builder (CycleGAN/tensorflow/tfrecords.py:9-73)
+  and CelebA attribute split (celeba.py:1-24).
+
+Shard fan-out uses ``records.write_sharded`` (process pool — the reference's
+ray.remote / threading.Coordinator role).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import xml.etree.ElementTree as ET
+
+import numpy as np
+
+from deep_vision_tpu.data import records as R
+
+# encoders must be MODULE-LEVEL: they are pickled into the shard-writer
+# process pool (local closures are not picklable)
+def _encode_labeled_file(item):
+    path, label = item
+    with open(path, "rb") as f:
+        return {"label": int(label), "filename": os.path.basename(path)}, \
+            f.read()
+
+
+def _encode_file(path):
+    with open(path, "rb") as f:
+        return {"filename": os.path.basename(path)}, f.read()
+
+
+VOC_CLASSES = (
+    "aeroplane", "bicycle", "bird", "boat", "bottle", "bus", "car", "cat",
+    "chair", "cow", "diningtable", "dog", "horse", "motorbike", "person",
+    "pottedplant", "sheep", "sofa", "train", "tvmonitor")
+
+
+def load_class_names(path: str | None, default=VOC_CLASSES) -> dict[str, int]:
+    """names file (one class per line — voc_2007_names.txt style) → map."""
+    if path is None:
+        return {n: i for i, n in enumerate(default)}
+    with open(path) as f:
+        return {line.strip(): i for i, line in enumerate(f) if line.strip()}
+
+
+def parse_voc_xml(xml_path: str, names_map: dict[str, int]) -> dict:
+    """One VOC annotation → sample dict with NORMALIZED corner boxes
+    (reference parse_one_xml + normalization asserts tfrecords.py:61-64)."""
+    root = ET.parse(xml_path).getroot()
+    filename = root.find(".//filename").text
+    size = root.find("size")
+    w = float(size.find("width").text)
+    h = float(size.find("height").text)
+    boxes, classes = [], []
+    for obj in root.findall(".//object"):
+        name = obj.find("name").text
+        bb = obj.find("bndbox")
+        x1 = float(bb.find("xmin").text) / w
+        y1 = float(bb.find("ymin").text) / h
+        x2 = float(bb.find("xmax").text) / w
+        y2 = float(bb.find("ymax").text) / h
+        assert 0 <= x1 <= 1 and 0 <= y1 <= 1, f"bad bbox in {xml_path}"
+        assert x1 <= x2 <= 1.001 and y1 <= y2 <= 1.001
+        boxes.append([x1, y1, min(x2, 1.0), min(y2, 1.0)])
+        classes.append(names_map[name])
+    return {"filename": filename,
+            "boxes": np.asarray(boxes, np.float32).reshape(-1, 4),
+            "classes": np.asarray(classes, np.int64)}
+
+
+def prepare_voc(voc_root: str, out_dir: str, split: str = "train",
+                names_file: str | None = None, num_shards: int = 8,
+                num_workers: int = 8, year: str = "2007") -> int:
+    """VOCdevkit/VOC{year}/{Annotations,JPEGImages} → dvrec shards."""
+    base = os.path.join(voc_root, f"VOC{year}")
+    anno_dir = os.path.join(base, "Annotations")
+    img_dir = os.path.join(base, "JPEGImages")
+    names_map = load_class_names(names_file)
+    samples = []
+    for xml_file in sorted(os.listdir(anno_dir)):
+        if not xml_file.endswith(".xml"):
+            continue
+        s = parse_voc_xml(os.path.join(anno_dir, xml_file), names_map)
+        img_path = os.path.join(img_dir, s["filename"])
+        with open(img_path, "rb") as f:
+            s["image_bytes"] = f.read()
+        samples.append(s)
+    R.write_detection_records(samples, out_dir, split, num_shards, num_workers)
+    return len(samples)
+
+
+def prepare_coco(annotation_json: str, image_dir: str, out_dir: str,
+                 split: str = "train", num_shards: int = 16,
+                 num_workers: int = 8) -> int:
+    """COCO instances JSON → dvrec (per-image grouping + 0-based classes)."""
+    with open(annotation_json) as f:
+        coco = json.load(f)
+    # re-index 1-based, possibly sparse, category ids → dense 0-based
+    cat_ids = sorted(c["id"] for c in coco["categories"])
+    cat_map = {cid: i for i, cid in enumerate(cat_ids)}
+    images = {im["id"]: im for im in coco["images"]}
+    by_image: dict[int, list] = {}
+    for anno in coco.get("annotations", []):
+        by_image.setdefault(anno["image_id"], []).append(anno)
+    samples = []
+    for image_id, annos in sorted(by_image.items()):
+        im = images[image_id]
+        w, h = float(im["width"]), float(im["height"])
+        boxes, classes = [], []
+        for a in annos:
+            x, y, bw, bh = a["bbox"]  # xywh corner-origin (COCO format)
+            boxes.append([x / w, y / h, (x + bw) / w, (y + bh) / h])
+            classes.append(cat_map[int(a["category_id"])])
+        path = os.path.join(image_dir, im["file_name"])
+        with open(path, "rb") as f:
+            payload = f.read()
+        samples.append({"image_bytes": payload,
+                        "boxes": np.clip(np.asarray(boxes, np.float32)
+                                         .reshape(-1, 4), 0, 1),
+                        "classes": np.asarray(classes, np.int64)})
+    R.write_detection_records(samples, out_dir, split, num_shards, num_workers)
+    return len(samples)
+
+
+def prepare_mpii(annotation_json: str, image_dir: str, out_dir: str,
+                 split: str = "train", num_shards: int = 8,
+                 num_workers: int = 8) -> int:
+    """MPII pose JSON (list of {image, joints, joints_visibility, center,
+    scale}) → pose dvrec.  Visibility remap 0→0, else→2 (reference :63)."""
+    with open(annotation_json) as f:
+        annos = json.load(f)
+    samples = []
+    for a in annos:
+        path = os.path.join(image_dir, a["image"])
+        if not os.path.exists(path):
+            continue
+        with open(path, "rb") as f:
+            payload = f.read()
+        joints = np.asarray(a["joints"], np.float32)
+        vis = np.asarray([0 if v == 0 else 2
+                          for v in a["joints_visibility"]], np.float32)
+        kp = np.concatenate([joints, vis[:, None]], axis=1)
+        samples.append({"image_bytes": payload, "keypoints": kp,
+                        "center": np.asarray(a.get("center", (0, 0)),
+                                             np.float32),
+                        "scale": float(a.get("scale", 1.0))})
+    R.write_pose_records(samples, out_dir, split, num_shards, num_workers)
+    return len(samples)
+
+
+def prepare_imagenet(src_dir: str, labels_file: str, out_dir: str,
+                     split: str = "train", num_shards: int = 64,
+                     num_workers: int = 8) -> int:
+    """Flattened synset-prefixed JPEG dir → classification dvrec shards
+    (the 1024/128-shard layout of build_imagenet_tfrecord.py, scaled by
+    ``num_shards``)."""
+    from deep_vision_tpu.data.imagenet import load_synset_index
+
+    label_map = load_synset_index(labels_file)
+    files = sorted(f for f in os.listdir(src_dir)
+                   if os.path.isfile(os.path.join(src_dir, f)))
+    items = [(os.path.join(src_dir, f), label_map[f.split("_")[0]])
+             for f in files]
+    R.write_sharded(items, out_dir, split, num_shards, _encode_labeled_file,
+                    num_workers)
+    return len(items)
+
+
+def prepare_unpaired(dir_a: str, dir_b: str, out_dir: str,
+                     split: str = "train", num_shards: int = 4,
+                     num_workers: int = 4) -> tuple[int, int]:
+    """CycleGAN pair-less builder: domain dirs → '<split>_a' / '<split>_b'
+    shards (CycleGAN/tensorflow/tfrecords.py:9-73)."""
+    counts = []
+    for tag, d in (("a", dir_a), ("b", dir_b)):
+        files = sorted(f for f in os.listdir(d)
+                       if f.lower().endswith((".jpg", ".jpeg", ".png")))
+        items = [os.path.join(d, f) for f in files]
+        R.write_sharded(items, out_dir, f"{split}_{tag}", num_shards,
+                        _encode_file, num_workers)
+        counts.append(len(items))
+    return tuple(counts)
+
+
+def split_celeba_by_attribute(attr_file: str, image_dir: str, out_a: str,
+                              out_b: str, attribute: str = "Male") -> tuple[int, int]:
+    """CelebA list_attr_celeba.txt split (celeba.py:1-24): symlink images
+    into two domain dirs by one binary attribute."""
+    os.makedirs(out_a, exist_ok=True)
+    os.makedirs(out_b, exist_ok=True)
+    with open(attr_file) as f:
+        lines = f.read().splitlines()
+    header = lines[1].split()
+    col = header.index(attribute)
+    na = nb = 0
+    for line in lines[2:]:
+        parts = line.split()
+        fname, val = parts[0], int(parts[1 + col])
+        src = os.path.join(image_dir, fname)
+        if not os.path.exists(src):
+            continue
+        dst = os.path.join(out_a if val > 0 else out_b, fname)
+        if not os.path.exists(dst):
+            os.symlink(os.path.abspath(src), dst)
+        if val > 0:
+            na += 1
+        else:
+            nb += 1
+    return na, nb

@@ -119,14 +119,17 @@ def encode_detection_sample(sample: dict) -> tuple[dict, bytes]:
     return header, payload
 
 
-class _LazyDetectionSample(dict):
-    """Dict-like sample that decodes its JPEG on first image access."""
+class _LazySample(dict):
+    """Dict-like sample that decodes its JPEG payload on first "image"
+    access; subclasses parse their eager header fields in ``_parse``."""
 
     def __init__(self, header: dict, payload: bytes):
         super().__init__()
         self._payload = payload
-        self["boxes"] = np.asarray(header["boxes"], np.float32).reshape(-1, 4)
-        self["classes"] = np.asarray(header["classes"], np.int64)
+        self._parse(header)
+
+    def _parse(self, header: dict):
+        raise NotImplementedError
 
     def __getitem__(self, key):
         if key == "image" and not dict.__contains__(self, "image"):
@@ -140,19 +143,68 @@ class _LazyDetectionSample(dict):
         return key == "image" or dict.__contains__(self, key)
 
 
+def _load_lazy_records(root: str, split: str, sample_cls) -> list[dict]:
+    shards = list_shards(root, split)
+    if not shards:
+        raise FileNotFoundError(f"no {split}-*.dvrec under {root}")
+    return [sample_cls(header, payload)
+            for s in shards for header, payload in read_records(s)]
+
+
+class _LazyDetectionSample(_LazySample):
+    def _parse(self, header: dict):
+        self["boxes"] = np.asarray(header["boxes"], np.float32).reshape(-1, 4)
+        self["classes"] = np.asarray(header["classes"], np.int64)
+
+
 def write_detection_records(samples: Sequence[dict], out_dir: str, split: str,
                             num_shards: int = 8, num_workers: int = 8):
     return write_sharded(samples, out_dir, split, num_shards,
                          encode_detection_sample, num_workers)
 
 
+# ---------------------------------------------------------------------------
+# Pose records (MPII layout: keypoints + center + scale —
+# Datasets/MPII/tfrecords_mpii.py:54-84 feature semantics)
+# ---------------------------------------------------------------------------
+
+
+def encode_pose_sample(sample: dict) -> tuple[dict, bytes]:
+    if "image_bytes" in sample:
+        payload = sample["image_bytes"]
+    else:
+        from PIL import Image
+
+        buf = io.BytesIO()
+        Image.fromarray(sample["image"]).save(buf, format="JPEG", quality=95)
+        payload = buf.getvalue()
+    header = {
+        "keypoints": np.asarray(sample["keypoints"],
+                                np.float32).reshape(-1, 3).tolist(),
+        "center": np.asarray(sample.get("center", (0, 0)),
+                             np.float32).tolist(),
+        "scale": float(sample.get("scale", 1.0)),
+    }
+    return header, payload
+
+
+class _LazyPoseSample(_LazySample):
+    def _parse(self, header: dict):
+        self["keypoints"] = np.asarray(header["keypoints"], np.float32)
+        self["center"] = np.asarray(header["center"], np.float32)
+        self["scale"] = header["scale"]
+
+
+def write_pose_records(samples: Sequence[dict], out_dir: str, split: str,
+                       num_shards: int = 8, num_workers: int = 8):
+    return write_sharded(samples, out_dir, split, num_shards,
+                         encode_pose_sample, num_workers)
+
+
+def load_pose_records(root: str, split: str) -> list[dict]:
+    return _load_lazy_records(root, split, _LazyPoseSample)
+
+
 def load_detection_records(root: str, split: str) -> list[dict]:
     """All shards → list of lazy samples (JPEGs decode on access)."""
-    shards = list_shards(root, split)
-    if not shards:
-        raise FileNotFoundError(f"no {split}-*.dvrec under {root}")
-    out: list[dict] = []
-    for s in shards:
-        for header, payload in read_records(s):
-            out.append(_LazyDetectionSample(header, payload))
-    return out
+    return _load_lazy_records(root, split, _LazyDetectionSample)

@@ -1,0 +1,78 @@
+"""CenterNet ("Objects as Points") hourglass detector — completes the
+reference's UNFINISHED ObjectsAsPoints stack (SURVEY §2.2 #18: empty
+``loss_objects`` ObjectsAsPoints/tensorflow/train.py:35, trainer never run
+:248, label gen stubbed to zeros preprocess.py:129-131).
+
+Parity with the model that DOES exist (ObjectsAsPoints/tensorflow/model.py):
+per-order filter tables :17-32 (order-5: 256,256,384,384,384,512),
+BN-free ``DetectionHead`` 3-head (class heatmap / wh / offset) :72-91,
+2-stack with re-injection :130-179.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from deep_vision_tpu.models.common import conv_kernel_init
+from deep_vision_tpu.models.hourglass import HourglassModule, PreActBottleneck
+
+# depth-indexed filters for the order-5 module (model.py:17-23)
+CENTERNET_FILTERS = (256, 256, 384, 384, 384, 512)
+
+
+class DetectionHead(nn.Module):
+    """3×3 conv256+ReLU → 3×3 conv out, NO BatchNorm (model.py:72-78)."""
+
+    out_features: int
+    bias_init_value: float = 0.0  # heatmap head: -2.19 focal prior
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(256, (3, 3), padding="SAME",
+                    kernel_init=conv_kernel_init, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.out_features, (3, 3), padding="SAME",
+                    bias_init=nn.initializers.constant(self.bias_init_value),
+                    dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class CenterNet(nn.Module):
+    """256²×3 → per-stack (heatmap_logits (64²,C), wh (64²,2), offset)."""
+
+    num_classes: int = 80
+    num_stack: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def bn():
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                dtype=self.dtype)
+
+        x = x.astype(self.dtype)
+        x = nn.Conv(128, (7, 7), (2, 2), padding="SAME",
+                    kernel_init=conv_kernel_init, dtype=self.dtype)(x)  # /2
+        x = nn.relu(bn()(x))
+        x = PreActBottleneck(256, self.dtype)(x, train)
+        x = nn.max_pool(x, (2, 2), (2, 2))                              # /4
+
+        outputs = []
+        for s in range(self.num_stack):
+            y = HourglassModule(5, list(CENTERNET_FILTERS),
+                                num_residual=1, dtype=self.dtype)(x, train)
+            y = nn.Conv(256, (3, 3), padding="SAME",
+                        kernel_init=conv_kernel_init, dtype=self.dtype)(y)
+            y = nn.relu(bn()(y))
+            # -2.19 bias prior: σ(-2.19)≈0.1 initial heatmap (CenterNet)
+            heat = DetectionHead(self.num_classes, -2.19, self.dtype)(y)
+            wh = DetectionHead(2, 0.0, self.dtype)(y)
+            offset = DetectionHead(2, 0.0, self.dtype)(y)
+            outputs.append((heat, wh, offset))
+            if s < self.num_stack - 1:
+                x = x + nn.Conv(256, (1, 1), dtype=self.dtype)(y)
+        return tuple(outputs)

@@ -1,0 +1,152 @@
+"""GAN generators/discriminators — parity with
+DCGAN/tensorflow/models.py (ConvTranspose generator from 100-d noise :30-65,
+conv discriminator :8-27) and CycleGAN/tensorflow/models.py (ResNet-block
+generator with reflection padding :8-78, PatchGAN discriminator :81-104).
+
+TPU notes: ConvTranspose maps to MXU like a conv; reflection padding is
+jnp.pad(mode="reflect") — a gather XLA fuses; tanh outputs stay f32.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+# ---------------------------------------------------------------------------
+# DCGAN (MNIST 28×28×1)
+# ---------------------------------------------------------------------------
+
+
+class DCGANGenerator(nn.Module):
+    """100-d noise → 28²×1 tanh image."""
+
+    latent_dim: int = 100
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        def bn():
+            return nn.BatchNorm(use_running_average=not train, dtype=self.dtype)
+
+        z = z.astype(self.dtype)
+        x = nn.Dense(7 * 7 * 256, use_bias=False, dtype=self.dtype)(z)
+        x = nn.leaky_relu(bn()(x), 0.3)
+        x = x.reshape((-1, 7, 7, 256))
+        x = nn.ConvTranspose(128, (5, 5), (1, 1), padding="SAME",
+                             use_bias=False, dtype=self.dtype)(x)
+        x = nn.leaky_relu(bn()(x), 0.3)
+        x = nn.ConvTranspose(64, (5, 5), (2, 2), padding="SAME",
+                             use_bias=False, dtype=self.dtype)(x)   # 14²
+        x = nn.leaky_relu(bn()(x), 0.3)
+        x = nn.ConvTranspose(1, (5, 5), (2, 2), padding="SAME",
+                             use_bias=False, dtype=self.dtype)(x)   # 28²
+        return jnp.tanh(x).astype(jnp.float32)
+
+
+class DCGANDiscriminator(nn.Module):
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (5, 5), (2, 2), padding="SAME", dtype=self.dtype)(x)
+        x = nn.leaky_relu(x, 0.3)
+        x = nn.Dropout(0.3, deterministic=not train)(x)
+        x = nn.Conv(128, (5, 5), (2, 2), padding="SAME", dtype=self.dtype)(x)
+        x = nn.leaky_relu(x, 0.3)
+        x = nn.Dropout(0.3, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(1, dtype=self.dtype)(x).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# CycleGAN (256×256×3)
+# ---------------------------------------------------------------------------
+
+
+def reflect_pad(x, p: int):
+    return jnp.pad(x, ((0, 0), (p, p), (p, p), (0, 0)), mode="reflect")
+
+
+class ResNetBlock(nn.Module):
+    """reflection-pad 3×3 conv ×2 + identity (models.py:17-38)."""
+
+    dim: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def bn():
+            return nn.BatchNorm(use_running_average=not train, dtype=self.dtype)
+
+        y = reflect_pad(x, 1)
+        y = nn.Conv(self.dim, (3, 3), padding="VALID", use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.relu(bn()(y))
+        y = reflect_pad(y, 1)
+        y = nn.Conv(self.dim, (3, 3), padding="VALID", use_bias=False,
+                    dtype=self.dtype)(y)
+        y = bn()(y)
+        return x + y
+
+
+class CycleGANGenerator(nn.Module):
+    """c7s1-64, d128, d256, R256×n, u128, u64, c7s1-3 (models.py:41-78)."""
+
+    n_blocks: int = 9
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def bn():
+            return nn.BatchNorm(use_running_average=not train, dtype=self.dtype)
+
+        x = x.astype(self.dtype)
+        x = reflect_pad(x, 3)
+        x = nn.Conv(64, (7, 7), padding="VALID", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.relu(bn()(x))
+        x = nn.Conv(128, (3, 3), (2, 2), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.relu(bn()(x))
+        x = nn.Conv(256, (3, 3), (2, 2), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.relu(bn()(x))
+        for _ in range(self.n_blocks):
+            x = ResNetBlock(256, self.dtype)(x, train)
+        x = nn.ConvTranspose(128, (3, 3), (2, 2), padding="SAME",
+                             use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(bn()(x))
+        x = nn.ConvTranspose(64, (3, 3), (2, 2), padding="SAME",
+                             use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(bn()(x))
+        x = reflect_pad(x, 3)
+        x = nn.Conv(3, (7, 7), padding="VALID", dtype=self.dtype)(x)
+        return jnp.tanh(x).astype(jnp.float32)
+
+
+class PatchGANDiscriminator(nn.Module):
+    """C64-C128-C256-C512 → 1-channel patch map (models.py:81-104)."""
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def bn():
+            return nn.BatchNorm(use_running_average=not train, dtype=self.dtype)
+
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (4, 4), (2, 2), padding="SAME", dtype=self.dtype)(x)
+        x = nn.leaky_relu(x, 0.2)
+        for f in (128, 256):
+            x = nn.Conv(f, (4, 4), (2, 2), padding="SAME", use_bias=False,
+                        dtype=self.dtype)(x)
+            x = nn.leaky_relu(bn()(x), 0.2)
+        x = nn.Conv(512, (4, 4), (1, 1), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.leaky_relu(bn()(x), 0.2)
+        return nn.Conv(1, (4, 4), (1, 1), padding="SAME",
+                       dtype=self.dtype)(x).astype(jnp.float32)

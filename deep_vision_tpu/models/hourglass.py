@@ -1,0 +1,139 @@
+"""Stacked Hourglass — parity with Hourglass/tensorflow/hourglass104.py:
+pre-act BottleneckBlock :19-67 (BN→ReLU→1×1→3×3→1×1, 1×1-conv shortcut when
+lifting channels), recursive order-4 HourglassModule :70-98, 4-stack network
+with intermediate supervision + re-injection :113-159.
+
+Also the CenterNet backbone variant (ObjectsAsPoints/tensorflow/model.py:17-32):
+order-5 with per-order filter tables, 2 stacks.
+
+Note: the reference's re-injection condition reuses a shadowed loop variable
+(`for i in range(num_residual)` inside `for i in range(num_stack)`,
+hourglass104.py:138-157) — implemented correctly here.
+
+TPU notes: the recursion unrolls at trace time into a static U-shaped graph;
+nearest upsample is jnp.repeat (layout-only).  All heads return f32 heatmaps
+for a stable MSE in bf16 training.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from deep_vision_tpu.models.common import conv_kernel_init
+
+
+class PreActBottleneck(nn.Module):
+    """BN→ReLU→(1×1 C/2 → 3×3 C/2 → 1×1 C); shortcut lifts channels."""
+
+    filters: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def bn():
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                dtype=self.dtype)
+
+        def conv(f, k):
+            return nn.Conv(f, (k, k), padding="SAME",
+                           kernel_init=conv_kernel_init, dtype=self.dtype)
+
+        identity = x
+        if x.shape[-1] != self.filters:
+            identity = conv(self.filters, 1)(x)
+        y = nn.relu(bn()(x))
+        y = conv(self.filters // 2, 1)(y)
+        y = nn.relu(bn()(y))
+        y = conv(self.filters // 2, 3)(y)
+        y = nn.relu(bn()(y))
+        y = conv(self.filters, 1)(y)
+        return identity + y
+
+
+def _up2(x):
+    return jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)
+
+
+class HourglassModule(nn.Module):
+    """Recursive U-module.  ``filters`` may be one int (classic hourglass)
+    or a per-order table (CenterNet: model.py:17-32)."""
+
+    order: int
+    filters: Sequence[int] | int = 256
+    num_residual: int = 1
+    dtype: Any = jnp.float32
+
+    def _f(self, depth: int) -> int:
+        if isinstance(self.filters, int):
+            return self.filters
+        return self.filters[min(depth, len(self.filters) - 1)]
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        f = self._f(0)
+        f_next = self._f(1)
+        up1 = x
+        for _ in range(self.num_residual + 1):
+            up1 = PreActBottleneck(f, self.dtype)(up1, train)
+        low = nn.max_pool(x, (2, 2), (2, 2))
+        for _ in range(self.num_residual):
+            low = PreActBottleneck(f_next, self.dtype)(low, train)
+        if self.order > 1:
+            sub_filters = self.filters if isinstance(self.filters, int) \
+                else list(self.filters[1:])
+            low = HourglassModule(self.order - 1, sub_filters,
+                                  self.num_residual, self.dtype)(low, train)
+        else:
+            for _ in range(self.num_residual):
+                low = PreActBottleneck(f_next, self.dtype)(low, train)
+        for _ in range(self.num_residual):
+            low = PreActBottleneck(f, self.dtype)(low, train)
+        return up1 + _up2(low)
+
+
+class StackedHourglass(nn.Module):
+    """256²×3 input → ``num_stack`` heatmap predictions at 64² — the full
+    Hourglass-104 when num_stack=4 (hourglass104.py:113-159)."""
+
+    num_stack: int = 4
+    num_heatmap: int = 16
+    filters: int = 256
+    num_residual: int = 1
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        def bn():
+            return nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                                dtype=self.dtype)
+
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), (2, 2), padding="SAME",
+                    kernel_init=conv_kernel_init, dtype=self.dtype)(x)  # →128
+        x = nn.relu(bn()(x))
+        x = PreActBottleneck(128, self.dtype)(x, train)
+        x = nn.max_pool(x, (2, 2), (2, 2))                              # →64
+        x = PreActBottleneck(128, self.dtype)(x, train)
+        x = PreActBottleneck(self.filters, self.dtype)(x, train)
+
+        outputs = []
+        for s in range(self.num_stack):
+            y = HourglassModule(4, self.filters, self.num_residual,
+                                self.dtype)(x, train)
+            for _ in range(self.num_residual):
+                y = PreActBottleneck(self.filters, self.dtype)(y, train)
+            # linear layer (1×1 conv + BN + ReLU)
+            y = nn.Conv(self.filters, (1, 1), kernel_init=conv_kernel_init,
+                        dtype=self.dtype)(y)
+            y = nn.relu(bn()(y))
+            heat = nn.Conv(self.num_heatmap, (1, 1),
+                           kernel_init=conv_kernel_init,
+                           dtype=self.dtype)(y)
+            outputs.append(heat.astype(jnp.float32))
+            if s < self.num_stack - 1:  # re-inject prediction (fixed bug)
+                x = x + nn.Conv(self.filters, (1, 1), dtype=self.dtype)(y) \
+                    + nn.Conv(self.filters, (1, 1), dtype=self.dtype)(heat)
+        return tuple(outputs)

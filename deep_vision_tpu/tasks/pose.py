@@ -1,0 +1,90 @@
+"""Pose task: Gaussian heatmap targets, weighted-MSE intermediate
+supervision, PCKh eval.
+
+Parity map (all in /root/reference/Hourglass/tensorflow/):
+- heatmap target: ``generate_2d_guassian`` preprocess.py:91-155 (σ=1 px,
+  ×12 scale, 7×7 support, zeros when invisible/out-of-bounds) +
+  ``make_heatmaps`` :158-173 — here vectorized over all keypoints at once
+  instead of the reference's per-pixel TensorArray scatter loop;
+- loss: ``compute_loss`` train.py:65-76 — MSE with foreground weight
+  (label>0)·81 + 1, summed over the stack's intermediate predictions;
+- eval: PCKh@0.5 (standard MPII metric; the reference publishes none).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_heatmaps(keypoints: np.ndarray, height: int = 64, width: int = 64,
+                  sigma: int = 1, scale: float = 12.0) -> np.ndarray:
+    """(K, 3) [x, y, visibility] in heatmap pixel coords → (H, W, K) f32.
+
+    Vectorized: one broadcasted Gaussian over the full grid per keypoint,
+    truncated to the reference's (6σ+1)² support window; invisible or fully
+    out-of-bounds keypoints give all-zero channels (preprocess.py:108-110).
+    """
+    kp = np.asarray(keypoints, np.float32)
+    K = kp.shape[0]
+    x0 = np.round(kp[:, 0]).astype(np.int64)
+    y0 = np.round(kp[:, 1]).astype(np.int64)
+    vis = kp[:, 2]
+    ys, xs = np.mgrid[0:height, 0:width]
+    dx = xs[None] - x0[:, None, None]
+    dy = ys[None] - y0[:, None, None]
+    g = np.exp(-(dx**2 + dy**2) / (2.0 * sigma**2)) * scale
+    # truncate to the 7×7 patch support (|d| ≤ 3σ), like the reference
+    g = np.where((np.abs(dx) <= 3 * sigma) & (np.abs(dy) <= 3 * sigma), g, 0.0)
+    inb = (x0 - 3 * sigma < width) & (y0 - 3 * sigma < height) & \
+        (x0 + 3 * sigma >= 0) & (y0 + 3 * sigma >= 0)
+    valid = (vis > 0) & inb
+    g = g * valid[:, None, None]
+    return np.transpose(g, (1, 2, 0)).astype(np.float32)
+
+
+def heatmap_argmax(heatmaps: np.ndarray) -> np.ndarray:
+    """(H, W, K) → (K, 2) [x, y] peak coordinates."""
+    h, w, k = heatmaps.shape
+    flat = heatmaps.reshape(-1, k)
+    idx = flat.argmax(0)
+    return np.stack([idx % w, idx // w], axis=1).astype(np.float32)
+
+
+def pckh(pred_xy: np.ndarray, true_xy: np.ndarray, visible: np.ndarray,
+         head_size: float, alpha: float = 0.5) -> tuple[float, int]:
+    """PCKh: fraction of visible keypoints within α·head_size of truth.
+    Returns (num correct, num visible)."""
+    d = np.linalg.norm(pred_xy - true_xy, axis=-1)
+    ok = (d <= alpha * head_size) & (visible > 0)
+    return float(ok.sum()), int((visible > 0).sum())
+
+
+class PoseTask:
+    """Trainer bundle: multi-stack weighted MSE + per-batch eval sums."""
+
+    monitor = "neg_loss"
+
+    def __init__(self, foreground_weight: float = 81.0):
+        self.fg = foreground_weight
+
+    def _stack_loss(self, outputs, labels):
+        loss = 0.0
+        for out in outputs:
+            w = (labels > 0).astype(jnp.float32) * self.fg + 1.0
+            loss = loss + (jnp.square(labels - out) * w).mean()
+        return loss
+
+    def loss(self, outputs, batch):
+        if not isinstance(outputs, (tuple, list)):
+            outputs = (outputs,)
+        loss = self._stack_loss(outputs, batch["heatmaps"])
+        return loss, {"mse_stacks": loss}
+
+    def eval_metrics(self, outputs, batch):
+        if not isinstance(outputs, (tuple, list)):
+            outputs = (outputs,)
+        loss = self._stack_loss(outputs, batch["heatmaps"])
+        n = batch["heatmaps"].shape[0]
+        return {"loss": loss * n, "neg_loss": -loss * n,
+                "count": jnp.asarray(n, jnp.float32)}

@@ -1,0 +1,31 @@
+"""CenterNet experiment — the reference's ObjectsAsPoints trainer was never
+wired (train.py:248 commented out); config follows the Objects-as-Points
+paper recipe (Adam 2.5e-4, 512²→128² in the paper; COCO 80 classes; here
+256²→64² matching the reference's Input(256) model.py:130)."""
+
+import jax.numpy as jnp
+
+from deep_vision_tpu.core.config import (
+    OptimizerConfig,
+    SchedulerConfig,
+    TrainConfig,
+    register_config,
+)
+from deep_vision_tpu.models.centernet import CenterNet
+
+
+@register_config("centernet")
+def centernet():
+    return TrainConfig(
+        name="centernet",
+        model=lambda: CenterNet(num_classes=80, dtype=jnp.bfloat16),
+        task="centernet",
+        batch_size=32,
+        total_epochs=140,
+        optimizer=OptimizerConfig(name="adam", learning_rate=2.5e-4),
+        scheduler=SchedulerConfig(
+            name="epoch_table",
+            kwargs=dict(table={1: 2.5e-4, 90: 2.5e-5, 120: 2.5e-6})),
+        image_size=256,
+        num_classes=80,
+    )

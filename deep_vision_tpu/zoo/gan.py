@@ -1,0 +1,49 @@
+"""GAN experiments — parity with DCGAN/tensorflow/main.py (Adam 1e-4,
+batch 256, 100 epochs, checkpoint every 2) and CycleGAN/tensorflow/train.py
+(Adam 2e-4 β1=0.5, batch 4? — reference BATCH_SIZE=1 per GPU, 200 epochs,
+LinearDecay from epoch 100 — utils.py:5-28)."""
+
+import jax.numpy as jnp
+
+from deep_vision_tpu.core.config import (
+    OptimizerConfig,
+    SchedulerConfig,
+    TrainConfig,
+    register_config,
+)
+from deep_vision_tpu.models import gan as gan_models
+
+
+@register_config("dcgan")
+def dcgan():
+    return TrainConfig(
+        name="dcgan",
+        model=lambda: gan_models.DCGANGenerator(dtype=jnp.bfloat16),
+        task="gan_dcgan",
+        batch_size=256,
+        total_epochs=100,
+        checkpoint_every_epochs=2,  # main.py:80-83
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-4),
+        scheduler=SchedulerConfig(name="constant"),
+        image_size=28,
+        channels=1,
+        num_classes=0,
+    )
+
+
+@register_config("cyclegan")
+def cyclegan():
+    return TrainConfig(
+        name="cyclegan",
+        model=lambda: gan_models.CycleGANGenerator(dtype=jnp.bfloat16),
+        task="gan_cyclegan",
+        batch_size=1,
+        total_epochs=200,
+        checkpoint_every_epochs=2,
+        optimizer=OptimizerConfig(name="adam", learning_rate=2e-4, b1=0.5),
+        scheduler=SchedulerConfig(
+            name="linear_decay",
+            kwargs=dict(total_epochs=200, decay_start=100)),
+        image_size=256,
+        num_classes=0,
+    )

@@ -1,0 +1,30 @@
+"""Pose experiments — parity with Hourglass/tensorflow/main.py:21-40
+(Adam lr 1e-3, batch 32, 100 epochs) + the trainer's
+ReduceOnPlateau-by-hand on val loss (train.py:46-58, ÷10 after patience)."""
+
+import jax.numpy as jnp
+
+from deep_vision_tpu.core.config import (
+    OptimizerConfig,
+    SchedulerConfig,
+    TrainConfig,
+    register_config,
+)
+from deep_vision_tpu.models.hourglass import StackedHourglass
+
+
+@register_config("hourglass104")
+def hourglass104():
+    return TrainConfig(
+        name="hourglass104",
+        model=lambda: StackedHourglass(num_stack=4, num_heatmap=16,
+                                       dtype=jnp.bfloat16),
+        task="pose",
+        batch_size=32,
+        total_epochs=100,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        scheduler=SchedulerConfig(
+            name="plateau", kwargs=dict(mode="max", factor=0.1, patience=5)),
+        image_size=256,
+        num_classes=16,  # heatmap channels
+    )

@@ -1,0 +1,23 @@
+"""StableHLO export roundtrip (the reference's TFLite path,
+CycleGAN/tensorflow/convert.py:7-16, done JAX-native)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deep_vision_tpu.core.export import export_forward, load_exported
+from deep_vision_tpu.models.lenet import LeNet5
+
+
+def test_export_roundtrip(tmp_path):
+    model = LeNet5()
+    x = jnp.zeros((2, 32, 32, 1))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    path = str(tmp_path / "lenet.stablehlo")
+    n = export_forward(model, variables, (2, 32, 32, 1), path)
+    assert n > 1000
+    fn = load_exported(path)
+    xin = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 1))
+    out = fn(variables, xin)
+    ref = model.apply(variables, xin, train=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

@@ -1,0 +1,99 @@
+"""Pose stack tests: heatmap codec fixtures, hourglass shapes, loss,
+crop_roi, PCKh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deep_vision_tpu.data.pose import PoseLoader, crop_roi, synthetic_pose_dataset
+from deep_vision_tpu.models.hourglass import StackedHourglass
+from deep_vision_tpu.tasks.pose import (
+    PoseTask,
+    heatmap_argmax,
+    make_heatmaps,
+    pckh,
+)
+
+
+def test_heatmap_peak_and_support():
+    hm = make_heatmaps(np.array([[10, 20, 2]]), 64, 64)
+    assert hm.shape == (64, 64, 1)
+    assert hm[20, 10, 0] == pytest.approx(12.0)       # ×12 scale at center
+    assert hm[20, 11, 0] == pytest.approx(12.0 * np.exp(-0.5), rel=1e-5)
+    assert hm[20, 14, 0] == 0.0                        # outside 7×7 support
+    assert hm[24, 10, 0] == 0.0
+
+
+def test_heatmap_invisible_and_oob_are_zero():
+    hm = make_heatmaps(np.array([[10, 20, 0], [-50, -50, 2], [5, 5, 1]]),
+                       64, 64)
+    assert hm[..., 0].sum() == 0.0    # invisible
+    assert hm[..., 1].sum() == 0.0    # out of bounds
+    assert hm[..., 2].sum() > 0.0
+
+
+def test_heatmap_edge_clipping():
+    hm = make_heatmaps(np.array([[0, 0, 2]]), 64, 64)
+    assert hm[0, 0, 0] == pytest.approx(12.0)
+    assert np.isfinite(hm).all()
+
+
+def test_heatmap_argmax_roundtrip():
+    kp = np.array([[33, 17, 2], [5, 60, 2]])
+    hm = make_heatmaps(kp, 64, 64)
+    rec = heatmap_argmax(hm)
+    np.testing.assert_allclose(rec, kp[:, :2], atol=0.5)
+
+
+def test_pckh():
+    pred = np.array([[10.0, 10.0], [50.0, 50.0]])
+    true = np.array([[11.0, 10.0], [20.0, 20.0]])
+    vis = np.array([1.0, 1.0])
+    correct, total = pckh(pred, true, vis, head_size=5.0)
+    assert (correct, total) == (1.0, 2)
+
+
+def test_crop_roi_keypoints_normalized():
+    img = np.zeros((200, 300, 3), np.uint8)
+    kp = np.array([[100, 50, 2], [200, 150, 2], [-1, -1, 0]], np.float32)
+    crop, norm = crop_roi(img, kp, scale=0.5)
+    assert crop.shape[0] <= 200 and crop.shape[1] <= 300
+    vis = norm[:2]
+    assert (vis[:, 0] >= 0).all() and (vis[:, 0] <= 1).all()
+    assert (vis[:, 1] >= 0).all() and (vis[:, 1] <= 1).all()
+
+
+def test_hourglass_shapes_and_stacks():
+    model = StackedHourglass(num_stack=2, num_heatmap=16, filters=64)
+    x = jnp.zeros((1, 64, 64, 3))
+    variables = jax.eval_shape(
+        lambda a: model.init({"params": jax.random.PRNGKey(0)}, a,
+                             train=False), x)
+    outs = jax.eval_shape(
+        lambda v, a: model.apply(v, a, train=False), variables, x)
+    assert len(outs) == 2
+    assert all(o.shape == (1, 16, 16, 16) for o in outs)   # ÷4 resolution
+    assert all(o.dtype == jnp.float32 for o in outs)
+
+
+def test_pose_loss_weights_foreground():
+    task = PoseTask()
+    labels = jnp.zeros((1, 8, 8, 2)).at[0, 3, 3, 0].set(12.0)
+    perfect = (labels,)
+    zero = (jnp.zeros_like(labels),)
+    l_perfect, _ = task.loss(perfect, {"heatmaps": labels})
+    l_zero, _ = task.loss(zero, {"heatmaps": labels})
+    assert float(l_perfect) == 0.0
+    # foreground miss is weighted 82× over a same-size background miss
+    assert float(l_zero) == pytest.approx(12.0**2 * 82 / (8 * 8 * 2))
+
+
+def test_pose_loader_shapes():
+    samples = synthetic_pose_dataset(4, image_size=64, num_keypoints=4)
+    loader = PoseLoader(samples, batch_size=2, image_size=64,
+                        heatmap_size=16, num_keypoints=4)
+    batch = next(iter(loader))
+    assert batch["image"].shape == (2, 64, 64, 3)
+    assert batch["heatmaps"].shape == (2, 16, 16, 4)
+    assert batch["heatmaps"].max() <= 12.0

@@ -1,0 +1,155 @@
+"""Dataset-prep tests on synthetic raw layouts (VOC XML, COCO JSON,
+MPII JSON, CelebA attrs) — no dataset downloads."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+from deep_vision_tpu.data import prep  # noqa: E402
+from deep_vision_tpu.data.records import (  # noqa: E402
+    load_detection_records,
+    load_pose_records,
+    read_records,
+    list_shards,
+)
+
+
+def _save_jpg(path, h=40, w=60):
+    rng = np.random.default_rng(0)
+    Image.fromarray(rng.integers(0, 255, (h, w, 3), dtype=np.uint8)).save(path)
+
+
+@pytest.fixture
+def voc_layout(tmp_path):
+    base = tmp_path / "VOC2007"
+    (base / "Annotations").mkdir(parents=True)
+    (base / "JPEGImages").mkdir()
+    for i in range(3):
+        name = f"img{i:03d}.jpg"
+        _save_jpg(base / "JPEGImages" / name, 100, 200)
+        xml = f"""<annotation>
+  <filename>{name}</filename>
+  <size><width>200</width><height>100</height><depth>3</depth></size>
+  <object><name>dog</name>
+    <bndbox><xmin>20</xmin><ymin>10</ymin><xmax>120</xmax><ymax>80</ymax></bndbox>
+  </object>
+  <object><name>person</name>
+    <bndbox><xmin>100</xmin><ymin>5</ymin><xmax>190</xmax><ymax>95</ymax></bndbox>
+  </object>
+</annotation>"""
+        (base / "Annotations" / f"img{i:03d}.xml").write_text(xml)
+    return str(tmp_path)
+
+
+def test_prepare_voc(voc_layout, tmp_path):
+    out = str(tmp_path / "recs")
+    n = prep.prepare_voc(voc_layout, out, "train", num_shards=2,
+                         num_workers=1)
+    assert n == 3
+    samples = load_detection_records(out, "train")
+    assert len(samples) == 3
+    s = samples[0]
+    assert s["boxes"].shape == (2, 4)
+    np.testing.assert_allclose(s["boxes"][0], [0.1, 0.1, 0.6, 0.8], atol=1e-6)
+    # voc class map: dog=11, person=14
+    assert s["classes"].tolist() == [11, 14]
+    assert s["image"].shape == (100, 200, 3)
+
+
+def test_prepare_coco(tmp_path):
+    img_dir = tmp_path / "images"
+    img_dir.mkdir()
+    _save_jpg(img_dir / "000000000001.jpg", 50, 100)
+    coco = {
+        "images": [{"id": 1, "file_name": "000000000001.jpg",
+                    "width": 100, "height": 50}],
+        # sparse 1-based category ids get re-indexed densely
+        "categories": [{"id": 1, "name": "person"}, {"id": 17, "name": "cat"}],
+        "annotations": [
+            {"image_id": 1, "category_id": 17, "bbox": [10, 5, 30, 20]},
+            {"image_id": 1, "category_id": 1, "bbox": [50, 25, 40, 20]},
+        ],
+    }
+    anno = tmp_path / "instances.json"
+    anno.write_text(json.dumps(coco))
+    out = str(tmp_path / "recs")
+    n = prep.prepare_coco(str(anno), str(img_dir), out, "val", num_shards=1,
+                          num_workers=1)
+    assert n == 1
+    s = load_detection_records(out, "val")[0]
+    assert s["classes"].tolist() == [1, 0]  # 17→1, 1→0
+    np.testing.assert_allclose(s["boxes"][0], [0.1, 0.1, 0.4, 0.5], atol=1e-6)
+
+
+def test_prepare_mpii(tmp_path):
+    img_dir = tmp_path / "images"
+    img_dir.mkdir()
+    _save_jpg(img_dir / "pose1.jpg", 80, 80)
+    annos = [{
+        "image": "pose1.jpg",
+        "joints": [[10, 20], [-1, -1]] + [[5, 5]] * 14,
+        "joints_visibility": [1, 0] + [1] * 14,
+        "center": [40, 40], "scale": 0.8,
+    }, {"image": "missing.jpg", "joints": [[0, 0]] * 16,
+        "joints_visibility": [0] * 16}]
+    anno = tmp_path / "mpii.json"
+    anno.write_text(json.dumps(annos))
+    out = str(tmp_path / "recs")
+    n = prep.prepare_mpii(str(anno), str(img_dir), out, "train",
+                          num_shards=1, num_workers=1)
+    assert n == 1  # missing image skipped
+    s = load_pose_records(out, "train")[0]
+    assert s["keypoints"].shape == (16, 3)
+    assert s["keypoints"][0].tolist() == [10.0, 20.0, 2.0]  # vis 1→2
+    assert s["keypoints"][1][2] == 0.0
+    assert s["scale"] == pytest.approx(0.8)
+
+
+def test_prepare_imagenet_shards(tmp_path):
+    src = tmp_path / "flat"
+    src.mkdir()
+    for syn, k in (("n01440764", 2), ("n01443537", 3)):
+        for j in range(k):
+            _save_jpg(src / f"{syn}_{j}.JPEG", 32, 32)
+    labels = tmp_path / "meta.txt"
+    labels.write_text("n01440764 tench\nn01443537 goldfish\n")
+    out = str(tmp_path / "recs")
+    n = prep.prepare_imagenet(str(src), str(labels), out, "train",
+                              num_shards=2, num_workers=1)
+    assert n == 5
+    shards = list_shards(out, "train")
+    assert len(shards) == 2
+    labels_seen = [h["label"] for sh in shards for h, _ in read_records(sh)]
+    assert sorted(labels_seen) == [0, 0, 1, 1, 1]
+
+
+def test_prepare_unpaired_and_celeba(tmp_path):
+    da, db = tmp_path / "a", tmp_path / "b"
+    da.mkdir(), db.mkdir()
+    for i in range(3):
+        _save_jpg(da / f"a{i}.jpg")
+    for i in range(2):
+        _save_jpg(db / f"b{i}.jpg")
+    out = str(tmp_path / "recs")
+    na, nb = prep.prepare_unpaired(str(da), str(db), out, "train",
+                                   num_shards=1, num_workers=1)
+    assert (na, nb) == (3, 2)
+    assert list_shards(out, "train_a") and list_shards(out, "train_b")
+
+    # celeba split
+    imgs = tmp_path / "celeba"
+    imgs.mkdir()
+    for f in ("1.jpg", "2.jpg", "3.jpg"):
+        _save_jpg(imgs / f)
+    attr = tmp_path / "attrs.txt"
+    attr.write_text("3\nSmiling Male\n1.jpg 1 1\n2.jpg 1 -1\n3.jpg -1 1\n")
+    oa, ob = str(tmp_path / "m"), str(tmp_path / "f")
+    na, nb = prep.split_celeba_by_attribute(str(attr), str(imgs), oa, ob,
+                                            "Male")
+    assert (na, nb) == (2, 1)
+    assert len(os.listdir(oa)) == 2 and len(os.listdir(ob)) == 1
