@@ -111,8 +111,6 @@ def main(argv=None):
         from deep_vision_tpu.tasks.detection import postprocess
 
         model, state = _load_state(cfg, args.workdir)
-        imgs = [np.asarray(_read_image(f, cfg.image_size))
-                for f in args.images]
         # detection uses [0,1] inputs, not imagenet-normalized
         from PIL import Image
 

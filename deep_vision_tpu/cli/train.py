@@ -145,10 +145,16 @@ def _main_detection(args, cfg, mesh):
 
         task = CenterNetTask(cfg.num_classes)
     else:
+        import jax
+
         from deep_vision_tpu.data.detection import DetectionLoader as LoaderCls
         from deep_vision_tpu.tasks.detection import YoloTask
 
-        task = YoloTask(cfg.num_classes)
+        # pallas ignore-mask kernel: single-device TPU only (pallas_call
+        # has no GSPMD partitioning rule under a sharded mesh)
+        use_pallas = (mesh.devices.size == 1
+                      and jax.default_backend() == "tpu")
+        task = YoloTask(cfg.num_classes, use_pallas=use_pallas)
     if args.synthetic:
         train_samples = synthetic_detection_dataset(
             args.synthetic_size, cfg.image_size,
@@ -216,6 +222,8 @@ def _main_gan(args, cfg, mesh):
     if cfg.task == "gan_dcgan":
         from deep_vision_tpu.data.gan import GANLoader, mnist_gan_data
 
+        if not args.synthetic:
+            assert args.data_root, "--data-root required without --synthetic"
         images = mnist_gan_data(None if args.synthetic else args.data_root,
                                 n_synthetic=args.synthetic_size)
         loader = GANLoader(images, cfg.batch_size, seed=cfg.seed)

@@ -90,9 +90,18 @@ def prepare_voc(voc_root: str, out_dir: str, split: str = "train",
     anno_dir = os.path.join(base, "Annotations")
     img_dir = os.path.join(base, "JPEGImages")
     names_map = load_class_names(names_file)
+    # honor VOC's split lists (ImageSets/Main/<split>.txt) so train and val
+    # shards hold disjoint images; fall back to everything if absent
+    split_file = os.path.join(base, "ImageSets", "Main", f"{split}.txt")
+    wanted = None
+    if os.path.exists(split_file):
+        with open(split_file) as f:
+            wanted = {line.split()[0] for line in f if line.strip()}
     samples = []
     for xml_file in sorted(os.listdir(anno_dir)):
         if not xml_file.endswith(".xml"):
+            continue
+        if wanted is not None and xml_file[:-4] not in wanted:
             continue
         s = parse_voc_xml(os.path.join(anno_dir, xml_file), names_map)
         img_path = os.path.join(img_dir, s["filename"])

@@ -1,0 +1,102 @@
+"""Multi-host initialization + pod-aware meshes.
+
+The reference's multi-node story is aspirational (README lists a
+``train_dist.py`` that does not exist — SURVEY §2.5); its real scope is
+single-host multi-GPU with NCCL hidden inside DataParallel/MirroredStrategy.
+Here multi-host is first-class and three lines:
+
+    from deep_vision_tpu.parallel import distributed
+    distributed.initialize()          # no-op single-host; JAX runtime on pods
+    mesh = distributed.make_pod_mesh({"data": -1})
+
+- ``initialize`` wires ``jax.distributed`` from standard cluster env vars
+  (auto-detected on Cloud TPU pods; explicit coordinator for DCN clusters).
+- ``make_pod_mesh`` builds hybrid ICI×DCN meshes with
+  ``mesh_utils.create_hybrid_device_mesh`` so collectives ride ICI within a
+  slice and only cross DCN on the outer (data) axis — the layout rule from
+  the scaling playbook.
+- Host-side loaders already shard per-process (data/imagenet.py uses
+  ``jax.process_index``), so the same CLI runs on 1 chip or a v4-32 pod.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_INITIALIZED = False
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Initialize jax.distributed for multi-host runs.
+
+    No-op when single-process (nothing configured and no cluster env).
+    On Cloud TPU pods jax auto-detects everything; on DCN clusters pass the
+    coordinator explicitly or set JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES
+    / JAX_PROCESS_ID.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS")
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    # auto-init only on a real TPU pod; CPU/virtual-device runs must stay
+    # single-process.  Multi-host shows up either as a multi-entry worker
+    # list (one slice, many hosts) or a megascale coordinator (multislice,
+    # possibly one worker per slice).
+    multi_worker = len([h for h in os.environ.get(
+        "TPU_WORKER_HOSTNAMES", "").split(",") if h]) > 1
+    multislice = bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+    on_tpu_pod = (multi_worker or multislice) and \
+        jax.default_backend() == "tpu"
+    if coordinator_address is None and not on_tpu_pod:
+        return  # single host
+    kwargs = {}
+    if coordinator_address:
+        kwargs["coordinator_address"] = coordinator_address
+        # pass counts only when known — jax.distributed can infer them
+        # from the cluster environment (SLURM, TPU metadata) otherwise
+        np_val = num_processes if num_processes is not None else env_np
+        pid_val = process_id if process_id is not None else env_pid
+        if np_val is not None:
+            kwargs["num_processes"] = int(np_val)
+        if pid_val is not None:
+            kwargs["process_id"] = int(pid_val)
+    jax.distributed.initialize(**kwargs)
+    _INITIALIZED = True
+
+
+def make_pod_mesh(axis_sizes: Mapping[str, int],
+                  dcn_axis: str = "data") -> Mesh:
+    """Hybrid mesh: ``dcn_axis`` spans slices over DCN, every other axis
+    stays inside a slice on ICI.  Falls back to a plain mesh on one slice.
+
+    ``-1`` sizes are resolved against the global device count.
+    """
+    from jax.experimental import mesh_utils
+
+    devices = jax.devices()
+    names = list(axis_sizes.keys())
+    sizes = list(axis_sizes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    num_slices = max(getattr(d, "slice_index", 0) for d in devices) + 1
+    if num_slices > 1 and dcn_axis in names:
+        dcn_parallelism = [1] * len(names)
+        dcn_parallelism[names.index(dcn_axis)] = num_slices
+        ici = list(sizes)
+        ici[names.index(dcn_axis)] = sizes[names.index(dcn_axis)] // num_slices
+        grid = mesh_utils.create_hybrid_device_mesh(
+            ici, dcn_parallelism, devices=devices)
+    else:
+        grid = mesh_utils.create_device_mesh(sizes, devices=devices)
+    return Mesh(grid, tuple(names))

@@ -1,0 +1,82 @@
+"""Spatial (context) parallelism: shard ACTIVATIONS over the image height
+axis with ring halo exchange.
+
+The reference has no analog — its "big activation" axis is image resolution,
+handled only by shrinking batch sizes (SURVEY §5 long-context: OOM notes
+ResNet/pytorch/train.py:141-148).  TPU-native answer: treat H like a sequence
+axis — a ``spatial`` mesh axis shards rows across chips, convolutions run on
+row shards after exchanging ``halo`` boundary rows with ring neighbours via
+``lax.ppermute`` (ICI neighbour traffic, the same pattern as ring attention's
+block exchange), so images too large for one chip's HBM train without
+changing the model.
+
+Composable with data parallelism: mesh {"data": d, "spatial": s}.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SPATIAL_AXIS = "spatial"
+
+
+def halo_exchange(x, halo: int, axis_name: str = SPATIAL_AXIS):
+    """Per-shard (B, H_shard, W, C) → (B, H_shard + 2·halo, W, C).
+
+    Neighbour rows arrive via two ring ppermutes; the outermost shards get
+    zero rows instead (SAME zero-padding semantics at the true image edge).
+    """
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    top_rows = x[:, :halo]     # my first rows → neighbour above's bottom halo
+    bot_rows = x[:, -halo:]    # my last rows → neighbour below's top halo
+    fwd = [(i, (i + 1) % n) for i in range(n)]
+    bwd = [(i, (i - 1) % n) for i in range(n)]
+    from_above = jax.lax.ppermute(bot_rows, axis_name, fwd)  # shard i-1's tail
+    from_below = jax.lax.ppermute(top_rows, axis_name, bwd)  # shard i+1's head
+    from_above = jnp.where(idx == 0, jnp.zeros_like(from_above), from_above)
+    from_below = jnp.where(idx == n - 1, jnp.zeros_like(from_below),
+                           from_below)
+    return jnp.concatenate([from_above, x, from_below], axis=1)
+
+
+def spatial_conv(x, kernel, mesh: Mesh, strides=(1, 1)):
+    """Stride-1 SAME conv2d with x row-sharded over the ``spatial`` axis.
+
+    x: GLOBAL (B, H, W, Cin) array (sharded or not — it is device_put to
+    P(None, "spatial")); kernel: (kh, kw, Cin, Cout) replicated.  Returns
+    the global result, identical to an unsharded SAME conv.
+
+    Strided convs are rejected: XLA's SAME rule pads asymmetrically under
+    stride, which a symmetric halo cannot reproduce — downsample with a
+    stride-1 halo conv followed by pooling, or reshard first.
+    """
+    if tuple(strides) != (1, 1):
+        raise ValueError(
+            f"spatial_conv supports strides=(1,1) only, got {strides}")
+    kh = kernel.shape[0]
+    halo = (kh - 1) // 2
+
+    def shard_fn(xs, ks):
+        padded = halo_exchange(xs, halo) if halo else xs
+        return jax.lax.conv_general_dilated(
+            padded, ks, window_strides=strides,
+            padding=((0, 0), ((ks.shape[1] - 1) // 2,) * 2),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=(P(None, SPATIAL_AXIS, None, None), P()),
+                   out_specs=P(None, SPATIAL_AXIS, None, None))
+    x = jax.device_put(x, NamedSharding(mesh, P(None, SPATIAL_AXIS,
+                                                None, None)))
+    kernel = jax.device_put(kernel, NamedSharding(mesh, P()))
+    return fn(x, kernel)

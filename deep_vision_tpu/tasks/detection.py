@@ -67,7 +67,7 @@ def _bce(logit_or_prob, target, from_probs: bool, eps: float = 1e-7):
 
 def yolo_scale_loss(raw, y_true, gt_boxes, gt_mask, anchors_wh,
                     ignore_thresh: float = 0.5, lambda_coord: float = 5.0,
-                    lambda_noobj: float = 0.5):
+                    lambda_noobj: float = 0.5, use_pallas: bool = False):
     """Loss for ONE scale.
 
     raw: (B,G,G,A,5+C) head output; y_true: same shape, absolute xywh +
@@ -99,9 +99,18 @@ def yolo_scale_loss(raw, y_true, gt_boxes, gt_mask, anchors_wh,
     # penalized as background (yolov3.py:438-459, static-shape version)
     B, G = raw.shape[0], raw.shape[1]
     flat_pred = pred_corners.reshape(B, -1, 4)
-    iou = broadcast_iou(flat_pred, gt_boxes)               # (B, N, M)
-    iou = jnp.where(gt_mask[:, None, :] > 0, iou, 0.0)
-    best_iou = iou.max(-1).reshape(obj.shape)
+    if use_pallas:
+        # fused tiled kernel (ops/pallas_ops.py) — avoids the (B,N,M) HBM
+        # intermediate; single-device only (pallas_call has no GSPMD
+        # partitioning rule, so keep the XLA path under sharded meshes)
+        from deep_vision_tpu.ops.pallas_ops import best_iou_max_auto
+
+        best_iou = best_iou_max_auto(flat_pred, gt_boxes,
+                                     gt_mask).reshape(obj.shape)
+    else:
+        iou = broadcast_iou(flat_pred, gt_boxes)           # (B, N, M)
+        iou = jnp.where(gt_mask[:, None, :] > 0, iou, 0.0)
+        best_iou = iou.max(-1).reshape(obj.shape)
     ignore = (best_iou < ignore_thresh).astype(jnp.float32)
 
     obj_entropy = _bce(raw[..., 4:5], true_obj, from_probs=False)[..., 0]
@@ -123,10 +132,12 @@ class YoloTask:
 
     def __init__(self, num_classes: int,
                  anchors: np.ndarray = YOLO_ANCHORS,
-                 masks: np.ndarray = ANCHOR_MASKS):
+                 masks: np.ndarray = ANCHOR_MASKS,
+                 use_pallas: bool = False):
         self.num_classes = num_classes
         self.anchors = jnp.asarray(anchors)
         self.masks = masks
+        self.use_pallas = use_pallas
 
     def _scale_anchors(self, scale: int):
         return self.anchors[self.masks[scale]]
@@ -136,7 +147,8 @@ class YoloTask:
         for s, raw in enumerate(outputs):
             t, c = yolo_scale_loss(
                 raw, batch[f"y_true_{s}"], batch["boxes"],
-                batch["boxes_mask"], self._scale_anchors(s))
+                batch["boxes_mask"], self._scale_anchors(s),
+                use_pallas=self.use_pallas)
             totals = totals + t.mean()
             for k, v in c.items():
                 comps[f"{k}_{s}"] = v.mean()

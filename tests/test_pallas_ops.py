@@ -1,0 +1,39 @@
+"""Pallas kernel numerics vs the XLA reference implementation."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from deep_vision_tpu.ops.boxes import broadcast_iou
+from deep_vision_tpu.ops.pallas_ops import best_iou_max
+
+
+def _reference(pred, gt, mask):
+    iou = broadcast_iou(pred, gt)
+    iou = jnp.where(mask[:, None, :] > 0, iou, 0.0)
+    return iou.max(-1)
+
+
+def test_best_iou_max_matches_reference():
+    rng = np.random.default_rng(0)
+    B, N, M = 2, 700, 100  # N not a tile multiple, M not lane multiple
+    p1 = rng.uniform(0, 0.8, (B, N, 2)).astype(np.float32)
+    pred = np.concatenate([p1, p1 + rng.uniform(0.05, 0.2, (B, N, 2))
+                           .astype(np.float32)], -1)
+    g1 = rng.uniform(0, 0.8, (B, M, 2)).astype(np.float32)
+    gt = np.concatenate([g1, g1 + rng.uniform(0.05, 0.2, (B, M, 2))
+                         .astype(np.float32)], -1)
+    mask = (rng.uniform(size=(B, M)) > 0.5).astype(np.float32)
+    got = best_iou_max(jnp.asarray(pred), jnp.asarray(gt),
+                       jnp.asarray(mask), interpret=True)
+    want = _reference(jnp.asarray(pred), jnp.asarray(gt), jnp.asarray(mask))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_best_iou_max_all_masked_is_zero():
+    pred = jnp.asarray(np.random.default_rng(1)
+                       .uniform(0, 1, (1, 64, 4)).astype(np.float32))
+    gt = jnp.zeros((1, 8, 4))
+    mask = jnp.zeros((1, 8))
+    out = best_iou_max(pred, gt, mask, interpret=True)
+    assert float(jnp.abs(out).max()) == 0.0

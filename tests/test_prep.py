@@ -153,3 +153,20 @@ def test_prepare_unpaired_and_celeba(tmp_path):
                                             "Male")
     assert (na, nb) == (2, 1)
     assert len(os.listdir(oa)) == 2 and len(os.listdir(ob)) == 1
+
+
+def test_prepare_voc_honors_split_lists(voc_layout, tmp_path):
+    """Regression: train/val shards must be disjoint when ImageSets exist."""
+    import pathlib
+
+    base = pathlib.Path(voc_layout) / "VOC2007"
+    main = base / "ImageSets" / "Main"
+    main.mkdir(parents=True)
+    (main / "train.txt").write_text("img000\nimg001\n")
+    (main / "val.txt").write_text("img002\n")
+    out = str(tmp_path / "recs")
+    n_train = prep.prepare_voc(voc_layout, out, "train", num_shards=1,
+                               num_workers=1)
+    n_val = prep.prepare_voc(voc_layout, out, "val", num_shards=1,
+                             num_workers=1)
+    assert (n_train, n_val) == (2, 1)
