@@ -1,0 +1,31 @@
+# Convenience targets — parity with the reference's per-directory Makefiles
+# (ResNet/pytorch/Makefile train_*/resume_*, CycleGAN/tensorflow/Makefile).
+# One Makefile, one CLI; jobs run in the foreground (use your own nohup/tmux
+# where the reference baked `nohup ... &` in).
+
+PY ?= python
+DATA ?= ./data
+WORKDIR ?= ./runs
+
+test:
+	$(PY) -m pytest tests/ -q
+
+bench:
+	$(PY) bench.py
+
+train_%:
+	$(PY) -m deep_vision_tpu.cli.train -m $* --data-root $(DATA) \
+		--workdir $(WORKDIR)/$*
+
+resume_%:
+	$(PY) -m deep_vision_tpu.cli.train -m $* --data-root $(DATA) \
+		--workdir $(WORKDIR)/$* --resume
+
+smoke_%:
+	$(PY) -m deep_vision_tpu.cli.train -m $* --synthetic --epochs 2 \
+		--workdir /tmp/smoke_$*
+
+list:
+	$(PY) -m deep_vision_tpu.cli.train --list -m x
+
+.PHONY: test bench list
