@@ -1,16 +1,25 @@
 """Driver benchmark: ResNet-50 train-step throughput on the attached chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} —
+extra keys report achieved TFLOP/s and MFU (model FLOPs utilization,
+%-of-peak for the chip's bf16 matmul rate).
 
 Baseline (BASELINE.md): the reference's only measured training throughput is
 ~800 img/s aggregate on 8 GPUs (ResNet-34 log timestamps,
 ResNet/pytorch/logs/resnet34-yanjiali-010319.log) ⇒ ~100 img/s/chip; the
 driver metric is "ResNet-50 ILSVRC2012 images/sec/chip" so vs_baseline
 divides by 100.
+
+Modes:
+    python bench.py              # train-step throughput + MFU (driver mode)
+    python bench.py --pipeline   # host input-pipeline throughput (JPEG
+                                 # decode+augment through ImageNetLoader)
+    python bench.py --profile    # also write a jax.profiler trace
 """
 
 from __future__ import annotations
 
+import argparse
 import functools
 import json
 import time
@@ -20,14 +29,30 @@ import jax.numpy as jnp
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 100.0
 
+# peak dense bf16 TFLOP/s per chip by device kind (public spec sheets)
+PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,   # v5e
+    "TPU v5": 459.0,        # v5p
+    "TPU v6 lite": 918.0,   # Trillium
+}
 
-def main():
+
+def _peak_tflops() -> float:
+    kind = jax.devices()[0].device_kind
+    for k, v in PEAK_BF16_TFLOPS.items():
+        if kind.startswith(k):
+            return v
+    return 197.0  # conservative default
+
+
+def bench_train_step(batch: int = 256, size: int = 224, steps: int = 20,
+                     profile: bool = False) -> dict:
     from deep_vision_tpu.core.optim import OptimizerConfig, build_optimizer
     from deep_vision_tpu.core.state import TrainState
     from deep_vision_tpu.models.resnet import ResNet50
     from deep_vision_tpu.tasks.classification import ClassificationTask
 
-    batch, size = 256, 224
     model = ResNet50(dtype=jnp.bfloat16)
     task = ClassificationTask(1000)
     tx = build_optimizer(OptimizerConfig(
@@ -56,28 +81,136 @@ def main():
             loss_fn, has_aux=True)(state.params)
         return state.apply_gradients(grads, batch_stats=new_bs), loss
 
-    # compile + warmup (device_get, not block_until_ready: the latter can
-    # return early through the axon tunnel)
-    state, loss = train_step(state, x, y)
+    # compile ONCE via AOT; the same executable provides XLA's own FLOP
+    # count (honest MFU numerator, no hand-derived constants) and runs the
+    # warmup + timed loop
+    compiled = train_step.lower(state, x, y).compile()
+    step_flops = None
+    try:
+        cost = compiled.cost_analysis()
+        if cost:
+            ca = cost[0] if isinstance(cost, (list, tuple)) else cost
+            step_flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
+    # warmup (device_get, not block_until_ready: the latter can return
+    # early through the axon tunnel)
+    state, loss = compiled(state, x, y)
     for _ in range(3):
-        state, loss = train_step(state, x, y)
+        state, loss = compiled(state, x, y)
     float(jax.device_get(loss))
 
-    steps = 20
+    if profile:
+        jax.profiler.start_trace("/tmp/bench_profile")
     t0 = time.perf_counter()
     for _ in range(steps):
-        state, loss = train_step(state, x, y)
+        state, loss = compiled(state, x, y)
     float(jax.device_get(loss))  # drains the async dispatch chain
     dt = time.perf_counter() - t0
+    if profile:
+        jax.profiler.stop_trace()
+        print("# trace written to /tmp/bench_profile")
 
     n_chips = jax.device_count()
     img_per_sec_per_chip = steps * batch / dt / n_chips
-    print(json.dumps({
+    out = {
         "metric": "resnet50_train_images_per_sec_per_chip",
         "value": round(img_per_sec_per_chip, 1),
         "unit": "images/sec/chip",
-        "vs_baseline": round(img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 2),
-    }))
+        "vs_baseline": round(
+            img_per_sec_per_chip / BASELINE_IMG_PER_SEC_PER_CHIP, 2),
+    }
+    if step_flops:
+        achieved = step_flops * steps / dt / n_chips / 1e12
+        out["tflops_per_chip"] = round(achieved, 1)
+        out["mfu_pct"] = round(100.0 * achieved / _peak_tflops(), 1)
+        out["device_kind"] = jax.devices()[0].device_kind
+        out["batch"] = batch
+    return out
+
+
+def bench_pipeline(num_workers: int = 16, batch: int = 256,
+                   n_images: int = 4096, jpeg_size: int = 400,
+                   image_size: int = 224,
+                   device_normalize: bool = True) -> dict:
+    """Host input-pipeline throughput: synthetic JPEGs on disk through the
+    REAL ImageNetLoader (decode + augment + batch assembly), no device work.
+
+    SURVEY §7 hard-part #1: this number must meet or beat the chip's
+    train-step rate or the chip starves.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    import numpy as np
+    from PIL import Image
+
+    from deep_vision_tpu.data.imagenet import ImageNetLoader
+
+    tmp = tempfile.mkdtemp(prefix="bench_pipeline_")
+    try:
+        root = os.path.join(tmp, "train")
+        os.makedirs(root)
+        rng = np.random.default_rng(0)
+        synsets = [f"n{i:08d}" for i in range(8)]
+        with open(os.path.join(tmp, "labels.txt"), "w") as f:
+            for s in synsets:
+                f.write(f"{s} synthetic\n")
+        # realistic decode cost: ImageNet train JPEGs average ~400×350
+        base = rng.integers(0, 255, (8, jpeg_size, jpeg_size, 3),
+                            dtype=np.uint8)
+        for i in range(n_images):
+            Image.fromarray(base[i % 8]).save(
+                os.path.join(root, f"{synsets[i % 8]}_{i}.JPEG"), quality=85)
+
+        loader = ImageNetLoader(
+            root, os.path.join(tmp, "labels.txt"), batch, train=True,
+            image_size=image_size, num_workers=num_workers,
+            process_index=0, process_count=1,
+            device_normalize=device_normalize)
+        # warm one batch (pool spin-up), then measure a full epoch
+        it = iter(loader)
+        next(it)
+        t0 = time.perf_counter()
+        n = batch  # the warm batch came from this epoch's budget
+        for b in it:
+            n += len(b["label"])
+        dt = time.perf_counter() - t0
+        loader.close()
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    img_per_sec = (n - batch) / dt
+    return {
+        "metric": "imagenet_pipeline_images_per_sec",
+        "value": round(img_per_sec, 1),
+        "unit": "images/sec/host",
+        "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC_PER_CHIP, 2),
+        "num_workers": num_workers,
+        "jpeg_size": jpeg_size,
+        "device_normalize": device_normalize,
+        "host_cores": os.cpu_count(),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--pipeline", action="store_true",
+                   help="measure host input-pipeline throughput instead")
+    p.add_argument("--profile", action="store_true")
+    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--num-workers", type=int, default=16)
+    p.add_argument("--host-normalize", action="store_true")
+    args = p.parse_args()
+    if args.pipeline:
+        out = bench_pipeline(num_workers=args.num_workers, batch=args.batch,
+                             device_normalize=not args.host_normalize)
+    else:
+        out = bench_train_step(batch=args.batch, steps=args.steps,
+                               profile=args.profile)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -71,7 +71,7 @@ def main(argv=None):
     p = argparse.ArgumentParser(description="deep_vision_tpu inference")
     sub = p.add_subparsers(dest="cmd", required=True)
     for name in ("classify", "detect", "pose", "sample", "translate",
-                 "export"):
+                 "export", "eval"):
         s = sub.add_parser(name)
         s.add_argument("-m", "--model", required=True)
         s.add_argument("--workdir", required=True)
@@ -79,6 +79,13 @@ def main(argv=None):
             s.add_argument("--images", nargs="+", required=True)
         if name == "detect":
             s.add_argument("--score-threshold", type=float, default=0.3)
+        if name == "eval":
+            s.add_argument("--data-root", default=None,
+                           help="dvrec shards (cli.prepare_data output)")
+            s.add_argument("--synthetic", action="store_true")
+            s.add_argument("--synthetic-size", type=int, default=64)
+            s.add_argument("--batch-size", type=int, default=None)
+            s.add_argument("--split", default="val")
         if name == "sample":
             s.add_argument("-n", type=int, default=16)
             s.add_argument("--out", default="samples.png")
@@ -178,6 +185,8 @@ def main(argv=None):
             dst = os.path.join(args.out_dir, os.path.basename(f))
             Image.fromarray(out8).save(dst)
             print(f"{f} -> {dst}")
+    elif args.cmd == "eval":
+        return _cmd_eval(args, cfg)
     elif args.cmd == "export":
         from deep_vision_tpu.core.export import export_forward
 
@@ -189,6 +198,48 @@ def main(argv=None):
                            (1, cfg.image_size, cfg.image_size, cfg.channels),
                            args.out)
         print(f"exported {n} bytes of StableHLO to {args.out}")
+    return 0
+
+
+def _cmd_eval(args, cfg):
+    """Detection evaluation: decode + NMS + VOC mAP@0.5 over a val split —
+    the evaluation the reference's YOLO README lists as "WIP"."""
+    from deep_vision_tpu.core.trainer import Trainer
+    from deep_vision_tpu.data.detection import (
+        CenterNetLoader,
+        DetectionLoader,
+        synthetic_detection_dataset,
+    )
+
+    if cfg.task == "centernet":
+        from deep_vision_tpu.tasks.centernet import CenterNetTask
+
+        task, loader_cls = CenterNetTask(cfg.num_classes), CenterNetLoader
+    elif cfg.task == "detection":
+        from deep_vision_tpu.tasks.detection import YoloTask
+
+        task, loader_cls = YoloTask(cfg.num_classes), DetectionLoader
+    else:
+        raise SystemExit(
+            f"eval supports detection/centernet configs, not '{cfg.task}'")
+    if args.synthetic:
+        samples = synthetic_detection_dataset(
+            args.synthetic_size, cfg.image_size, min(cfg.num_classes, 3),
+            seed=2)
+    else:
+        from deep_vision_tpu.data.records import load_detection_records
+
+        assert args.data_root, "--data-root required without --synthetic"
+        samples = load_detection_records(args.data_root, args.split)
+    batch = args.batch_size or cfg.eval_batch_size
+    loader = loader_cls(samples, batch, cfg.num_classes, cfg.image_size,
+                        train=False)
+    model, state = _load_state(cfg, args.workdir)
+    trainer = Trainer(cfg, model, task, workdir=args.workdir)
+    metrics = trainer.evaluate(state, loader)
+    print(f"eval[{args.split}] n={len(samples)} "
+          + " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items())))
+    print(f"mAP@0.5 = {metrics.get('mAP', float('nan')):.4f}")
     return 0
 
 

@@ -33,6 +33,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="mesh spec like 'data=8' or 'data=4,model=2'")
     p.add_argument("--num-workers", type=int, default=16,
                    help="decode/augment worker processes (ImageNet path)")
+    p.add_argument("--host-normalize", action="store_true",
+                   help="float32 jitter+normalize on the HOST (reference "
+                        "semantics) instead of fused device preprocessing")
     p.add_argument("--profile", action="store_true",
                    help="jax.profiler trace of steps 10-20 → workdir/profile")
     p.add_argument("--list", action="store_true", help="list configs and exit")
@@ -86,6 +89,7 @@ def main(argv=None):
             f"task '{cfg.task}' CLI wiring lands with its stack")
 
     task = ClassificationTask(cfg.num_classes, cfg.label_smoothing)
+    preprocess_fn = None
 
     if args.synthetic:
         from deep_vision_tpu.data.synthetic import synthetic_classification
@@ -118,16 +122,25 @@ def main(argv=None):
         assert args.data_root, "--data-root required without --synthetic"
         labels = os.path.join(args.data_root, "imagenet_2012_metadata.txt")
         resize = max(cfg.image_size * 256 // 224, cfg.image_size + 8)
+        # uint8 host pipeline + device-side jitter/normalize (fused into
+        # the jit step): 4× less H2D, ~30% less host CPU per image
+        dev_norm = not args.host_normalize
         train_loader = ImageNetLoader(
             os.path.join(args.data_root, "train"), labels, cfg.batch_size,
             train=True, image_size=cfg.image_size, resize=resize,
-            num_workers=args.num_workers, seed=cfg.seed)
+            num_workers=args.num_workers, seed=cfg.seed,
+            device_normalize=dev_norm)
         val_loader = ImageNetLoader(
             os.path.join(args.data_root, "val"), labels, cfg.eval_batch_size,
             train=False, image_size=cfg.image_size, resize=resize,
-            num_workers=args.num_workers)
+            num_workers=args.num_workers, device_normalize=dev_norm)
+        if dev_norm:
+            from deep_vision_tpu.ops.preprocess import make_imagenet_preprocess
 
-    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir)
+            preprocess_fn = make_imagenet_preprocess()
+
+    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir,
+                      preprocess_fn=preprocess_fn)
     if args.profile:
         trainer.profile_steps = (10, 20)
     state = trainer.fit(train_loader, val_loader, resume=args.resume)
@@ -151,9 +164,22 @@ def _main_detection(args, cfg, mesh):
         from deep_vision_tpu.tasks.detection import YoloTask
 
         # pallas ignore-mask kernel: single-device TPU only (pallas_call
-        # has no GSPMD partitioning rule under a sharded mesh)
+        # has no GSPMD partitioning rule under a sharded mesh), and only
+        # after a one-batch parity check against the XLA path
         use_pallas = (mesh.devices.size == 1
                       and jax.default_backend() == "tpu")
+        if use_pallas:
+            from deep_vision_tpu.ops.pallas_ops import pallas_parity_ok
+            from deep_vision_tpu.tasks.detection import MAX_BOXES
+
+            # check at the REAL training shapes — Mosaic tiling/VMEM limits
+            # are shape-dependent, so toy shapes prove nothing; the loss
+            # calls the kernel once PER SCALE with that scale's n_pred
+            use_pallas = all(
+                pallas_parity_ok(batch=cfg.batch_size,
+                                 n_pred=3 * (cfg.image_size // s) ** 2,
+                                 n_gt=MAX_BOXES)
+                for s in (8, 16, 32))
         task = YoloTask(cfg.num_classes, use_pallas=use_pallas)
     if args.synthetic:
         train_samples = synthetic_detection_dataset(

@@ -48,10 +48,14 @@ class Trainer:
     :class:`deep_vision_tpu.core.adversarial.AdversarialTrainer`."""
 
     def __init__(self, config: TrainConfig, model, task, mesh=None,
-                 workdir: str | None = None):
+                 workdir: str | None = None, preprocess_fn=None):
         self.config = config
         self.model = model
         self.task = task
+        # optional device-side input preprocessing run INSIDE the jitted
+        # steps (e.g. uint8→jitter→normalize, ops/preprocess.py) — XLA
+        # fuses it into the first conv; signature (batch, rng, train)
+        self.preprocess_fn = preprocess_fn
         self.mesh = mesh if mesh is not None else make_mesh()
         self.workdir = workdir or os.path.join("runs", config.name)
         self.logger = MetricLogger(self.workdir)
@@ -79,6 +83,9 @@ class Trainer:
         rng = jax.random.PRNGKey(self.config.seed)
         init_rng, state_rng = jax.random.split(rng)
         image = jnp.asarray(sample_batch["image"][:1])
+        if self.preprocess_fn is not None:
+            image = self.preprocess_fn({"image": image}, init_rng,
+                                       train=False)["image"]
         variables = jax.jit(
             functools.partial(self.model.init, train=False)
         )({"params": init_rng, "dropout": init_rng}, image)
@@ -109,9 +116,13 @@ class Trainer:
 
     def _build_steps(self):
         task, has_bn = self.task, self._has_bn
+        preprocess_fn = self.preprocess_fn
 
         def train_step(state: TrainState, batch: dict):
             step_rng = jax.random.fold_in(state.rng, state.step)
+            if preprocess_fn is not None:
+                batch = preprocess_fn(
+                    batch, jax.random.fold_in(step_rng, 1), train=True)
 
             def loss_fn(params):
                 variables = {"params": params}
@@ -135,12 +146,27 @@ class Trainer:
             metrics = {"loss": loss, **aux}
             return new_state, metrics
 
+        # host-evaluator protocol (e.g. detection mAP): the task decodes
+        # postprocessed outputs ON DEVICE (static shapes — decode+NMS stay
+        # XLA-compiled) in the SAME forward pass as the loss metrics; the
+        # host accumulates AP across the val set
+        has_outputs = hasattr(task, "eval_outputs")
+
         def eval_step(state: TrainState, batch: dict):
+            if preprocess_fn is not None:
+                batch = preprocess_fn(batch, jax.random.PRNGKey(0),
+                                      train=False)
             variables = {"params": state.params}
             if has_bn:
                 variables["batch_stats"] = state.batch_stats
             out = state.apply_fn(variables, batch["image"], train=False)
-            return task.eval_metrics(out, batch)
+            sums = task.eval_metrics(out, batch)
+            extra = None
+            if has_outputs:
+                extra = task.eval_outputs(out, batch)
+                if "weight" in batch:
+                    extra["weight"] = batch["weight"]
+            return sums, extra
 
         self._jit_train_step = jax.jit(train_step, donate_argnums=0)
         self._jit_eval_step = jax.jit(eval_step)
@@ -151,20 +177,38 @@ class Trainer:
         return self._jit_train_step(state, shard_batch(batch, self.mesh))
 
     def eval_step(self, state, batch):
+        """Metric sums for one batch (decoded-output extras, if the task
+        produces them, are consumed by :meth:`evaluate`)."""
         if self._jit_eval_step is None:
             self._build_steps()
-        return self._jit_eval_step(state, shard_batch(batch, self.mesh))
+        sums, _ = self._jit_eval_step(state, shard_batch(batch, self.mesh))
+        return sums
 
     # ------------------------------------------------------------------ loops
 
     def evaluate(self, state: TrainState, val_data: Iterable) -> dict:
+        if self._has_bn is None:
+            # evaluating a restored state without going through init_state
+            # (e.g. cli.infer eval): derive BN presence from the state
+            self._has_bn = bool(state.batch_stats)
+        if self._jit_eval_step is None:
+            self._build_steps()
+        make_ev = getattr(self.task, "make_host_evaluator", None)
+        evaluator = make_ev() if make_ev is not None else None
         totals: dict[str, float] = {}
         for batch in val_data:
-            sums = jax.device_get(self.eval_step(state, batch))
+            batch = shard_batch(batch, self.mesh)
+            sums, extra = self._jit_eval_step(state, batch)
+            sums = jax.device_get(sums)
             for k, v in sums.items():
                 totals[k] = totals.get(k, 0.0) + float(v)
+            if evaluator is not None and extra is not None:
+                evaluator.add_batch(jax.device_get(extra))
         count = max(totals.pop("count", 1.0), 1.0)
-        return {k: v / count for k, v in totals.items()}
+        out = {k: v / count for k, v in totals.items()}
+        if evaluator is not None:
+            out.update(evaluator.compute())
+        return out
 
     def train_epoch(self, state: TrainState, train_data: Iterable,
                     epoch: int) -> TrainState:

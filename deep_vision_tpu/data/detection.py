@@ -95,7 +95,10 @@ class DetectionLoader:
         self.epoch = epoch
 
     def __len__(self) -> int:
-        return len(self.samples) // self.batch_size
+        full = len(self.samples) // self.batch_size
+        if not self.train and len(self.samples) % self.batch_size:
+            return full + 1  # eval covers the FULL set (padded last batch)
+        return full
 
     def _prepare(self, sample: dict, rng: np.random.Generator) -> dict:
         img = sample["image"]
@@ -121,8 +124,19 @@ class DetectionLoader:
             rng.shuffle(idx)
         for b in range(len(self)):
             sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            n_real = len(sel)
+            if n_real < self.batch_size:
+                # weight-0 fillers keep the batch shape static; loss metrics
+                # and the mAP accumulator both honor the weight mask
+                sel = np.concatenate(
+                    [sel, np.repeat(idx[:1], self.batch_size - n_real)])
             items = [self._prepare(self.samples[i], rng) for i in sel]
-            yield {k: np.stack([it[k] for it in items]) for k in items[0]}
+            batch = {k: np.stack([it[k] for it in items]) for k in items[0]}
+            if not self.train:
+                weight = np.zeros(self.batch_size, np.float32)
+                weight[:n_real] = 1.0
+                batch["weight"] = weight
+            yield batch
 
 
 class CenterNetLoader(DetectionLoader):

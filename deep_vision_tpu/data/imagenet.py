@@ -37,10 +37,15 @@ def load_synset_index(labels_file: str) -> dict[str, int]:
     return mapping
 
 
-def _decode(path: str) -> np.ndarray:
+def _decode(path: str, draft_size: int | None = None) -> np.ndarray:
     from PIL import Image
 
     with Image.open(path) as im:
+        if draft_size is not None:
+            # JPEG DCT-domain downscale during decode (1/2, 1/4, 1/8):
+            # large photos decode several× faster; PIL guarantees the
+            # result stays ≥ the requested size, so rescale() still works
+            im.draft("RGB", (draft_size, draft_size))
         return np.asarray(im.convert("RGB"))  # drops alpha, CMYK→RGB
 
 
@@ -74,7 +79,20 @@ def _worker_init(cfg: dict):
 
 
 def _load_one(cfg: dict, i: int, seed: int) -> tuple[np.ndarray, np.int32]:
-    img = _decode(os.path.join(cfg["root_dir"], cfg["files"][i]))
+    # draft (DCT-domain downscale) only on the fast uint8 path — the
+    # --host-normalize path promises reference-exact decode semantics
+    draft = cfg["resize"] if cfg.get("device_normalize") else None
+    img = _decode(os.path.join(cfg["root_dir"], cfg["files"][i]),
+                  draft_size=draft)
+    if cfg.get("device_normalize"):
+        # uint8 host path: decode+rescale+crop only; jitter+normalize run
+        # inside the jitted step (ops/preprocess.py) — 4× smaller H2D
+        if cfg["train"]:
+            rng = np.random.default_rng(seed)
+            return T.train_transform_u8(img, rng, cfg["image_size"],
+                                        cfg["resize"]), cfg["labels"][i]
+        return T.eval_transform_u8(img, cfg["image_size"],
+                                   cfg["resize"]), cfg["labels"][i]
     if cfg["train"]:
         rng = np.random.default_rng(seed)
         x = T.train_transform(img, rng, cfg["image_size"], cfg["resize"])
@@ -99,7 +117,9 @@ class ImageNetLoader:
                  train: bool = True, image_size: int = 224, resize: int = 256,
                  num_workers: int = 16, seed: int = 0,
                  process_index: int | None = None,
-                 process_count: int | None = None):
+                 process_count: int | None = None,
+                 prefetch_batches: int = 2,
+                 device_normalize: bool = False):
         import jax
 
         self.ds = ImageNetFolder(root_dir, labels_file)
@@ -113,16 +133,23 @@ class ImageNetLoader:
         self.num_workers = num_workers
         self.seed = seed
         self.epoch = 0
+        self.prefetch_batches = max(1, prefetch_batches)
         self._cfg = dict(root_dir=self.ds.root_dir, files=self.ds.files,
                          labels=self.ds.labels, train=train,
-                         image_size=image_size, resize=resize)
+                         image_size=image_size, resize=resize,
+                         device_normalize=device_normalize)
         self._pool = None
-        # create the pool EAGERLY on the main thread: forking lazily from the
-        # prefetch producer thread can inherit held locks and deadlock
+        # create the pool EAGERLY on the main thread. forkserver (spawn as
+        # fallback) — NOT fork: by loader-construction time the JAX runtime
+        # has live threads, and fork-with-threads can inherit held locks and
+        # deadlock nondeterministically on long runs
         if self.num_workers > 0:
             import multiprocessing as mp
 
-            ctx = mp.get_context("fork")
+            try:
+                ctx = mp.get_context("forkserver")
+            except ValueError:
+                ctx = mp.get_context("spawn")
             self._pool = ctx.Pool(self.num_workers, initializer=_worker_init,
                                   initargs=(self._cfg,))
 
@@ -132,7 +159,30 @@ class ImageNetLoader:
     def __len__(self) -> int:
         return len(self.host_indices) // self.batch_size
 
+    def _batch_args(self, idx, seeds, b):
+        """(args, n_real) for batch b — padded to the static batch size."""
+        sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+        n_real = len(sel)
+        if n_real < self.batch_size:
+            sel = np.concatenate(
+                [sel, np.repeat(idx[:1], self.batch_size - n_real)])
+        args = [(int(i), int(s)) for i, s in
+                zip(sel, seeds[b * self.batch_size:
+                               b * self.batch_size + self.batch_size])]
+        return args, n_real
+
+    def _assemble(self, out, n_real) -> dict:
+        batch = {"image": np.stack([o[0] for o in out]),
+                 "label": np.asarray([o[1] for o in out], np.int32)}
+        if not self.train:
+            weight = np.zeros(self.batch_size, np.float32)
+            weight[:n_real] = 1.0
+            batch["weight"] = weight
+        return batch
+
     def __iter__(self) -> Iterator[dict]:
+        from collections import deque
+
         rng = np.random.default_rng((self.seed, self.epoch))
         idx = self.host_indices.copy()
         if self.train:
@@ -142,26 +192,30 @@ class ImageNetLoader:
         # static batch size with weight-0 fillers (pad_last semantics)
         partial = (not self.train) and (len(idx) % self.batch_size != 0)
         seeds = rng.integers(0, 2**63 - 1, size=len(idx) + self.batch_size)
-        for b in range(full + int(partial)):
-            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
-            n_real = len(sel)
-            if n_real < self.batch_size:
-                sel = np.concatenate(
-                    [sel, np.repeat(idx[:1], self.batch_size - n_real)])
-            args = [(int(i), int(s)) for i, s in
-                    zip(sel, seeds[b * self.batch_size:
-                                   b * self.batch_size + self.batch_size])]
-            if self._pool is not None:
-                out = self._pool.map(_worker_load, args, chunksize=8)
-            else:
-                out = [_load_one(self._cfg, *a) for a in args]
-            batch = {"image": np.stack([o[0] for o in out]),
-                     "label": np.asarray([o[1] for o in out], np.int32)}
-            if not self.train:
-                weight = np.zeros(self.batch_size, np.float32)
-                weight[:n_real] = 1.0
-                batch["weight"] = weight
-            yield batch
+        n_batches = full + int(partial)
+        if self._pool is None:
+            for b in range(n_batches):
+                args, n_real = self._batch_args(idx, seeds, b)
+                yield self._assemble([_load_one(self._cfg, *a) for a in args],
+                                     n_real)
+            return
+        # overlapped decode: keep `prefetch_batches` async batches in flight
+        # so workers decode batch N+1..N+k while the chip trains on batch N
+        # (the DataLoader(num_workers) prefetch role,
+        # ResNet/pytorch/train.py:229-234)
+        chunk = max(1, self.batch_size // (2 * self.num_workers))
+        pending: deque = deque()
+        for b in range(n_batches):
+            args, n_real = self._batch_args(idx, seeds, b)
+            pending.append(
+                (self._pool.map_async(_worker_load, args, chunksize=chunk),
+                 n_real))
+            if len(pending) > self.prefetch_batches:
+                res, nr = pending.popleft()
+                yield self._assemble(res.get(), nr)
+        while pending:
+            res, nr = pending.popleft()
+            yield self._assemble(res.get(), nr)
 
     def close(self):
         if self._pool is not None:

@@ -27,7 +27,9 @@ def crop_roi(img: np.ndarray, keypoints: np.ndarray, scale: float,
     crop + keypoints in normalized crop coords (preprocess.py:43-88)."""
     h, w = img.shape[:2]
     kp = np.asarray(keypoints, np.float32)
-    vis = kp[:, 0] >= 0
+    # visible = visibility channel set AND coords valid (MPII marks occluded
+    # joints vis=0 while keeping coordinates; negative coords mean absent)
+    vis = (kp[:, 2] > 0) & (kp[:, 0] >= 0)
     if not vis.any():
         norm = np.concatenate([kp[:, :2] / [w, h], kp[:, 2:3]], 1)
         return img, norm

@@ -112,3 +112,18 @@ def eval_transform(img: np.ndarray, size: int = 224, resize: int = 256
     img = rescale(img, resize)
     img = center_crop(img, size)
     return normalize(img)
+
+
+def train_transform_u8(img: np.ndarray, rng: np.random.Generator,
+                       size: int = 224, resize: int = 256) -> np.ndarray:
+    """Host half of the device-preprocess split: Rescale → flip → RandomCrop,
+    all uint8 (jitter+normalize run on device — ops/preprocess.py)."""
+    img = rescale(img, resize)
+    img = random_horizontal_flip(img, rng)
+    return np.ascontiguousarray(random_crop(img, size, rng))
+
+
+def eval_transform_u8(img: np.ndarray, size: int = 224, resize: int = 256
+                      ) -> np.ndarray:
+    """Host half for eval: Rescale → CenterCrop, uint8."""
+    return np.ascontiguousarray(center_crop(rescale(img, resize), size))

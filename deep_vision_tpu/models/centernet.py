@@ -29,10 +29,11 @@ class DetectionHead(nn.Module):
     out_features: int
     bias_init_value: float = 0.0  # heatmap head: -2.19 focal prior
     dtype: Any = jnp.float32
+    features: int = 256
 
     @nn.compact
     def __call__(self, x):
-        x = nn.Conv(256, (3, 3), padding="SAME",
+        x = nn.Conv(self.features, (3, 3), padding="SAME",
                     kernel_init=conv_kernel_init, dtype=self.dtype)(x)
         x = nn.relu(x)
         x = nn.Conv(self.out_features, (3, 3), padding="SAME",
@@ -42,10 +43,17 @@ class DetectionHead(nn.Module):
 
 
 class CenterNet(nn.Module):
-    """256²×3 → per-stack (heatmap_logits (64²,C), wh (64²,2), offset)."""
+    """256²×3 → per-stack (heatmap_logits (64²,C), wh (64²,2), offset).
+
+    ``order``/``filters`` default to the reference's order-5 table; smaller
+    settings give a test-scale model (order must satisfy
+    2**order ≤ input_size/4).
+    """
 
     num_classes: int = 80
     num_stack: int = 2
+    order: int = 5
+    filters: tuple = CENTERNET_FILTERS
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -54,25 +62,27 @@ class CenterNet(nn.Module):
             return nn.BatchNorm(use_running_average=not train, momentum=0.9,
                                 dtype=self.dtype)
 
+        base = self.filters[0]
         x = x.astype(self.dtype)
-        x = nn.Conv(128, (7, 7), (2, 2), padding="SAME",
+        x = nn.Conv(base // 2, (7, 7), (2, 2), padding="SAME",
                     kernel_init=conv_kernel_init, dtype=self.dtype)(x)  # /2
         x = nn.relu(bn()(x))
-        x = PreActBottleneck(256, self.dtype)(x, train)
+        x = PreActBottleneck(base, self.dtype)(x, train)
         x = nn.max_pool(x, (2, 2), (2, 2))                              # /4
 
         outputs = []
         for s in range(self.num_stack):
-            y = HourglassModule(5, list(CENTERNET_FILTERS),
+            y = HourglassModule(self.order, list(self.filters),
                                 num_residual=1, dtype=self.dtype)(x, train)
-            y = nn.Conv(256, (3, 3), padding="SAME",
+            y = nn.Conv(base, (3, 3), padding="SAME",
                         kernel_init=conv_kernel_init, dtype=self.dtype)(y)
             y = nn.relu(bn()(y))
             # -2.19 bias prior: σ(-2.19)≈0.1 initial heatmap (CenterNet)
-            heat = DetectionHead(self.num_classes, -2.19, self.dtype)(y)
-            wh = DetectionHead(2, 0.0, self.dtype)(y)
-            offset = DetectionHead(2, 0.0, self.dtype)(y)
+            heat = DetectionHead(self.num_classes, -2.19, self.dtype,
+                                 features=base)(y)
+            wh = DetectionHead(2, 0.0, self.dtype, features=base)(y)
+            offset = DetectionHead(2, 0.0, self.dtype, features=base)(y)
             outputs.append((heat, wh, offset))
             if s < self.num_stack - 1:
-                x = x + nn.Conv(256, (1, 1), dtype=self.dtype)(y)
+                x = x + nn.Conv(base, (1, 1), dtype=self.dtype)(y)
         return tuple(outputs)

@@ -65,30 +65,41 @@ class DarknetResidual(nn.Module):
 
 
 class Darknet53(nn.Module):
-    """Backbone emitting (52², 26², 13²) feature maps at 416² input."""
+    """Backbone emitting (52², 26², 13²) feature maps at 416² input.
+
+    ``width``/``blocks`` scale channels and residual-block counts
+    (1.0/(1,2,8,8,4) = the paper's Darknet-53); smaller settings give a
+    yolov3-tiny-class backbone for fast tests and small datasets.
+    """
 
     dtype: Any = jnp.float32
+    width: float = 1.0
+    blocks: tuple = (1, 2, 8, 8, 4)
+
+    def _w(self, f: int) -> int:
+        return max(8, int(f * self.width))
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         conv = partial(DarknetConv, dtype=self.dtype)
-        x = conv(32, 3)(x, train)
-        x = conv(64, 3, 2)(x, train)                      # /2
-        x = DarknetResidual(64, self.dtype)(x, train)
-        x = conv(128, 3, 2)(x, train)                     # /4
-        for _ in range(2):
-            x = DarknetResidual(128, self.dtype)(x, train)
-        x = conv(256, 3, 2)(x, train)                     # /8
-        for _ in range(8):
-            x = DarknetResidual(256, self.dtype)(x, train)
+        x = conv(self._w(32), 3)(x, train)
+        x = conv(self._w(64), 3, 2)(x, train)             # /2
+        for _ in range(self.blocks[0]):
+            x = DarknetResidual(self._w(64), self.dtype)(x, train)
+        x = conv(self._w(128), 3, 2)(x, train)            # /4
+        for _ in range(self.blocks[1]):
+            x = DarknetResidual(self._w(128), self.dtype)(x, train)
+        x = conv(self._w(256), 3, 2)(x, train)            # /8
+        for _ in range(self.blocks[2]):
+            x = DarknetResidual(self._w(256), self.dtype)(x, train)
         route_small = x                                   # 52²×256
-        x = conv(512, 3, 2)(x, train)                     # /16
-        for _ in range(8):
-            x = DarknetResidual(512, self.dtype)(x, train)
+        x = conv(self._w(512), 3, 2)(x, train)            # /16
+        for _ in range(self.blocks[3]):
+            x = DarknetResidual(self._w(512), self.dtype)(x, train)
         route_medium = x                                  # 26²×512
-        x = conv(1024, 3, 2)(x, train)                    # /32
-        for _ in range(4):
-            x = DarknetResidual(1024, self.dtype)(x, train)
+        x = conv(self._w(1024), 3, 2)(x, train)           # /32
+        for _ in range(self.blocks[4]):
+            x = DarknetResidual(self._w(1024), self.dtype)(x, train)
         return route_small, route_medium, x               # 13²×1024
 
 
@@ -135,23 +146,32 @@ class YoloV3(nn.Module):
 
     num_classes: int = 80
     dtype: Any = jnp.float32
+    width: float = 1.0
+    blocks: tuple = (1, 2, 8, 8, 4)
+
+    def _w(self, f: int) -> int:
+        return max(8, int(f * self.width))
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         x = x.astype(self.dtype)
-        small, medium, large = Darknet53(self.dtype)(x, train)
+        small, medium, large = Darknet53(self.dtype, self.width,
+                                         self.blocks)(x, train)
 
-        x13 = YoloConvBlock(512, self.dtype)(large, train)
-        out13 = YoloHead(512, self.num_classes, self.dtype)(x13, train)
+        x13 = YoloConvBlock(self._w(512), self.dtype)(large, train)
+        out13 = YoloHead(self._w(512), self.num_classes, self.dtype)(
+            x13, train)
 
-        x = DarknetConv(256, 1, dtype=self.dtype)(x13, train)
+        x = DarknetConv(self._w(256), 1, dtype=self.dtype)(x13, train)
         x = jnp.concatenate([_upsample2(x), medium], axis=-1)
-        x26 = YoloConvBlock(256, self.dtype)(x, train)
-        out26 = YoloHead(256, self.num_classes, self.dtype)(x26, train)
+        x26 = YoloConvBlock(self._w(256), self.dtype)(x, train)
+        out26 = YoloHead(self._w(256), self.num_classes, self.dtype)(
+            x26, train)
 
-        x = DarknetConv(128, 1, dtype=self.dtype)(x26, train)
+        x = DarknetConv(self._w(128), 1, dtype=self.dtype)(x26, train)
         x = jnp.concatenate([_upsample2(x), small], axis=-1)
-        x52 = YoloConvBlock(128, self.dtype)(x, train)
-        out52 = YoloHead(128, self.num_classes, self.dtype)(x52, train)
+        x52 = YoloConvBlock(self._w(128), self.dtype)(x, train)
+        out52 = YoloHead(self._w(128), self.num_classes, self.dtype)(
+            x52, train)
 
         return out52, out26, out13  # scale order matches ANCHOR_MASKS rows

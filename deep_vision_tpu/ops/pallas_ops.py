@@ -88,3 +88,51 @@ def best_iou_max_auto(pred_boxes, gt_boxes, gt_mask):
     """Pallas on TPU; interpret-mode elsewhere (tests, CPU dryruns)."""
     on_tpu = jax.default_backend() == "tpu"
     return best_iou_max(pred_boxes, gt_boxes, gt_mask, interpret=not on_tpu)
+
+
+_PARITY_CACHE: dict[tuple, bool] = {}
+
+
+def pallas_parity_ok(batch: int = 2, n_pred: int = 600, n_gt: int = 100,
+                     tol: float = 1e-5, interpret: bool = False) -> bool:
+    """One-batch parity check of the COMPILED kernel vs the XLA path.
+
+    The Mosaic compilation of ``best_iou_max`` (block shapes with lane dim 4
+    and full-batch sublane blocks) is environment- AND shape-sensitive, so
+    callers must gate on the exact (batch, n_pred, n_gt) shapes training
+    will use; results are cached per shape per process. A compile failure
+    or numeric divergence disables the Pallas path.
+    """
+    key = (batch, n_pred, n_gt)
+    if key in _PARITY_CACHE and not interpret:
+        return _PARITY_CACHE[key]
+    from deep_vision_tpu.ops.boxes import broadcast_iou
+
+    try:
+        rng = jax.random.PRNGKey(42)
+        k1, k2, k3, k4, k5 = jax.random.split(rng, 5)
+        p_xy = jax.random.uniform(k1, (batch, n_pred, 2))
+        p_wh = jax.random.uniform(k2, (batch, n_pred, 2), minval=0.01,
+                                  maxval=0.4)
+        pred = jnp.concatenate([p_xy - p_wh / 2, p_xy + p_wh / 2], -1)
+        g_xy = jax.random.uniform(k3, (batch, n_gt, 2))
+        g_wh = jax.random.uniform(k4, (batch, n_gt, 2), minval=0.01,
+                                  maxval=0.4)
+        gt = jnp.concatenate([g_xy - g_wh / 2, g_xy + g_wh / 2], -1)
+        mask = (jax.random.uniform(k5, (batch, n_gt)) > 0.3).astype(
+            jnp.float32)
+        got = best_iou_max(pred, gt, mask, interpret=interpret)
+        iou = jnp.where(mask[:, None, :] > 0, broadcast_iou(pred, gt), 0.0)
+        want = iou.max(-1)
+        err = float(jax.device_get(jnp.abs(got - want).max()))
+        ok = err < tol
+        if not ok:
+            print(f"[pallas] parity check FAILED (max err {err:.2e}) — "
+                  "falling back to the XLA ignore-mask path")
+    except Exception as e:  # compile/runtime failure → XLA fallback
+        print(f"[pallas] kernel unavailable ({type(e).__name__}: {e}) — "
+              "falling back to the XLA ignore-mask path")
+        ok = False
+    if not interpret:
+        _PARITY_CACHE[key] = ok
+    return ok

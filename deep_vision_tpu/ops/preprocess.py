@@ -1,0 +1,72 @@
+"""Device-side input preprocessing (jitter + normalize inside the jit step).
+
+TPU-first split of the reference's cv2/torch host pipeline
+(ResNet/pytorch/data_load.py:72-296): the host keeps only what must be
+dynamic-shaped (JPEG decode, aspect-preserving rescale, crop — all uint8),
+and the float work (ColorJitter :213-296, Normalize :197-210) moves into
+the jitted train step where XLA fuses it into the first conv's HBM read.
+Shipping uint8 instead of float32 also cuts host→device transfer 4×.
+
+Semantics vs the host path: identical factor ranges; the three jitter ops
+apply in a fixed order (brightness→contrast→saturation) instead of the
+reference's shuffled order — a no-op in expectation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from deep_vision_tpu.data.transforms import IMAGENET_MEAN, IMAGENET_STD
+
+_GRAY = jnp.asarray([0.299, 0.587, 0.114])
+
+
+def jitter_normalize(images, rng, train: bool,
+                     mean=IMAGENET_MEAN, std=IMAGENET_STD,
+                     brightness: float = 0.2, contrast: float = 0.2,
+                     saturation: float = 0.2):
+    """uint8 (B,H,W,3) → normalized float32, with train-time color jitter.
+
+    Already-float inputs pass through normalization only (so the same step
+    works with host-normalized loaders — their floats are already
+    standardized and this fn must NOT run; callers gate on dtype).
+    """
+    x = images.astype(jnp.float32) / 255.0
+    if train:
+        b = images.shape[0]
+        kb, kc, ks = jax.random.split(rng, 3)
+        fb = jax.random.uniform(kb, (b, 1, 1, 1),
+                                minval=max(0.0, 1 - brightness),
+                                maxval=1 + brightness)
+        x = x * fb
+        m = x.mean(axis=(1, 2, 3), keepdims=True)
+        fc = jax.random.uniform(kc, (b, 1, 1, 1),
+                                minval=max(0.0, 1 - contrast),
+                                maxval=1 + contrast)
+        x = (x - m) * fc + m
+        gray = (x * _GRAY).sum(-1, keepdims=True)
+        fs = jax.random.uniform(ks, (b, 1, 1, 1),
+                                minval=max(0.0, 1 - saturation),
+                                maxval=1 + saturation)
+        x = gray + (x - gray) * fs
+        x = jnp.clip(x, 0.0, 1.0)
+    return (x - jnp.asarray(mean)) / jnp.asarray(std)
+
+
+def make_imagenet_preprocess(brightness: float = 0.2, contrast: float = 0.2,
+                             saturation: float = 0.2):
+    """Trainer ``preprocess_fn``: applied to uint8 image batches inside the
+    jitted step; float batches (host-normalized path) pass through."""
+
+    def fn(batch: dict, rng, train: bool) -> dict:
+        img = batch["image"]
+        if img.dtype != jnp.uint8:
+            return batch
+        out = dict(batch)
+        out["image"] = jitter_normalize(
+            img, rng, train, brightness=brightness, contrast=contrast,
+            saturation=saturation)
+        return out
+
+    return fn

@@ -39,13 +39,17 @@ def encode_centernet_labels(boxes_xywh: np.ndarray, classes: np.ndarray,
     """One image's gt (normalized centroid xywh) → training targets.
 
     Returns {"heatmap": (G,G,C), "wh": (M,2), "offset": (M,2),
-    "indices": (M,) flat grid index, "obj_mask": (M,)}.
+    "indices": (M,) flat grid index, "obj_mask": (M,),
+    "boxes": (M,4) normalized corner gt list, "gt_classes": (M,)} —
+    the gt list feeds the host mAP accumulator.
     """
     heat = np.zeros((grid, grid, num_classes), np.float32)
     wh = np.zeros((MAX_OBJECTS, 2), np.float32)
     offset = np.zeros((MAX_OBJECTS, 2), np.float32)
     indices = np.zeros((MAX_OBJECTS,), np.int64)
     mask = np.zeros((MAX_OBJECTS,), np.float32)
+    boxes_list = np.zeros((MAX_OBJECTS, 4), np.float32)
+    classes_list = np.zeros((MAX_OBJECTS,), np.int32)
     n = min(len(boxes_xywh), MAX_OBJECTS)
     if n:
         b = np.asarray(boxes_xywh[:n], np.float32)
@@ -69,8 +73,12 @@ def encode_centernet_labels(boxes_xywh: np.ndarray, classes: np.ndarray,
         offset[:n] = np.stack([cx - xi, cy - yi], 1)
         indices[:n] = yi * grid + xi
         mask[:n] = 1.0
+        boxes_list[:n] = np.concatenate(
+            [b[:, :2] - b[:, 2:4] / 2, b[:, :2] + b[:, 2:4] / 2], 1)
+        classes_list[:n] = cls
     return {"heatmap": heat, "wh": wh, "offset": offset,
-            "indices": indices, "obj_mask": mask}
+            "indices": indices, "obj_mask": mask,
+            "boxes": boxes_list, "gt_classes": classes_list}
 
 
 def focal_loss(pred_logits, gt_heatmap, alpha: float = 2.0, beta: float = 4.0,
@@ -95,13 +103,15 @@ def _gather_at(features, indices):
 
 
 class CenterNetTask:
-    monitor = "neg_loss"
+    monitor = "mAP"
 
     def __init__(self, num_classes: int, wh_weight: float = 0.1,
-                 offset_weight: float = 1.0):
+                 offset_weight: float = 1.0,
+                 eval_score_threshold: float = 0.05):
         self.num_classes = num_classes
         self.wh_weight = wh_weight
         self.offset_weight = offset_weight
+        self.eval_score_threshold = eval_score_threshold
 
     def _stack_loss(self, heat, wh, offset, batch):
         l_heat = focal_loss(heat, batch["heatmap"]).mean()
@@ -125,10 +135,42 @@ class CenterNetTask:
         return total, comps
 
     def eval_metrics(self, outputs, batch):
-        loss, _ = self.loss(outputs, batch)
-        n = batch["heatmap"].shape[0]
-        return {"loss": loss * n, "neg_loss": -loss * n,
-                "count": jnp.asarray(n, jnp.float32)}
+        # per-image loss (objects normalized per image rather than per
+        # batch), masked by the eval-padding weight
+        w = batch.get("weight")
+        if w is None:
+            w = jnp.ones((batch["heatmap"].shape[0],), jnp.float32)
+        mask = batch["obj_mask"][..., None]
+        n_img = jnp.maximum(batch["obj_mask"].sum(-1), 1.0)
+        per_image = 0.0
+        for heat, wh, offset in outputs:
+            l_heat = focal_loss(heat, batch["heatmap"])            # (B,)
+            pred_wh = _gather_at(wh, batch["indices"])
+            pred_off = _gather_at(offset, batch["indices"])
+            l_wh = (jnp.abs(pred_wh - batch["wh"]) * mask).sum((1, 2)) / n_img
+            l_off = (jnp.abs(pred_off - batch["offset"]) * mask
+                     ).sum((1, 2)) / n_img
+            per_image = per_image + l_heat + self.wh_weight * l_wh + \
+                self.offset_weight * l_off
+        loss_sum = (per_image * w).sum()
+        return {"loss": loss_sum, "neg_loss": -loss_sum, "count": w.sum()}
+
+    def eval_outputs(self, outputs, batch):
+        """Decode the FINAL stack's peaks for the host mAP accumulator;
+        boxes normalized to [0,1] to match the encoded gt list."""
+        heat, wh, offset = outputs[-1]
+        G = heat.shape[1]
+        boxes, scores, cls = decode_detections(heat, wh, offset)
+        valid = (scores > self.eval_score_threshold).astype(jnp.float32)
+        return {"det_boxes": boxes / G, "det_scores": scores,
+                "det_classes": cls, "det_valid": valid,
+                "gt_boxes": batch["boxes"], "gt_mask": batch["obj_mask"],
+                "gt_classes": batch["gt_classes"]}
+
+    def make_host_evaluator(self):
+        from deep_vision_tpu.tasks.map_eval import DetectionMAPAccumulator
+
+        return DetectionMAPAccumulator(self.num_classes)
 
 
 def decode_detections(heat_logits, wh, offset, k: int = 100):

@@ -96,9 +96,12 @@ def yolo_scale_loss(raw, y_true, gt_boxes, gt_mask, anchors_wh,
     wh_loss = (obj * weight * wh_loss).sum((1, 2, 3)) * lambda_coord
 
     # ignore mask: preds overlapping ANY same-image gt > thresh are not
-    # penalized as background (yolov3.py:438-459, static-shape version)
+    # penalized as background (yolov3.py:438-459, static-shape version).
+    # stop_gradient: the mask is a hard threshold (zero gradient anyway) and
+    # pallas_call has no autodiff rule — without this the Pallas path fails
+    # to linearize under value_and_grad.
     B, G = raw.shape[0], raw.shape[1]
-    flat_pred = pred_corners.reshape(B, -1, 4)
+    flat_pred = jax.lax.stop_gradient(pred_corners.reshape(B, -1, 4))
     if use_pallas:
         # fused tiled kernel (ops/pallas_ops.py) — avoids the (B,N,M) HBM
         # intermediate; single-device only (pallas_call has no GSPMD
@@ -126,18 +129,25 @@ def yolo_scale_loss(raw, y_true, gt_boxes, gt_mask, anchors_wh,
 
 
 class YoloTask:
-    """Task bundle for the Trainer: multi-scale loss + eval."""
+    """Task bundle for the Trainer: multi-scale loss + eval.
 
-    monitor = "neg_loss"
+    Validation computes mAP@0.5 (decode + NMS on device via
+    ``eval_outputs``, VOC-style AP accumulated on host) — the evaluation
+    the reference's README admits is "WIP" and never shipped.
+    """
+
+    monitor = "mAP"
 
     def __init__(self, num_classes: int,
                  anchors: np.ndarray = YOLO_ANCHORS,
                  masks: np.ndarray = ANCHOR_MASKS,
-                 use_pallas: bool = False):
+                 use_pallas: bool = False,
+                 eval_score_threshold: float = 0.05):
         self.num_classes = num_classes
         self.anchors = jnp.asarray(anchors)
         self.masks = masks
         self.use_pallas = use_pallas
+        self.eval_score_threshold = eval_score_threshold
 
     def _scale_anchors(self, scale: int):
         return self.anchors[self.masks[scale]]
@@ -155,10 +165,36 @@ class YoloTask:
         return totals, comps
 
     def eval_metrics(self, outputs, batch):
-        loss, _ = self.loss(outputs, batch)
-        n = batch["boxes"].shape[0]
-        return {"loss": loss * n, "neg_loss": -loss * n,
-                "count": jnp.asarray(n, jnp.float32)}
+        # per-image loss, masked by the eval-padding weight so weight-0
+        # filler rows don't pollute the metric
+        w = batch.get("weight")
+        if w is None:
+            w = jnp.ones((batch["boxes"].shape[0],), jnp.float32)
+        per_image = 0.0
+        for s, raw in enumerate(outputs):
+            t, _ = yolo_scale_loss(
+                raw, batch[f"y_true_{s}"], batch["boxes"],
+                batch["boxes_mask"], self._scale_anchors(s),
+                use_pallas=self.use_pallas)
+            per_image = per_image + t
+        loss_sum = (per_image * w).sum()
+        return {"loss": loss_sum, "neg_loss": -loss_sum, "count": w.sum()}
+
+    def eval_outputs(self, outputs, batch):
+        """Device-side decode + static-shape NMS for the host mAP
+        accumulator (Trainer host-evaluator protocol)."""
+        boxes, scores, classes, valid = postprocess(
+            outputs, self.num_classes, anchors=np.asarray(self.anchors),
+            masks=self.masks, score_threshold=self.eval_score_threshold)
+        return {"det_boxes": boxes, "det_scores": scores,
+                "det_classes": classes, "det_valid": valid,
+                "gt_boxes": batch["boxes"], "gt_mask": batch["boxes_mask"],
+                "gt_classes": batch["gt_classes"]}
+
+    def make_host_evaluator(self):
+        from deep_vision_tpu.tasks.map_eval import DetectionMAPAccumulator
+
+        return DetectionMAPAccumulator(self.num_classes)
 
 
 # ---------------------------------------------------------------------------
@@ -196,12 +232,19 @@ def encode_labels(boxes_xywh: np.ndarray, classes: np.ndarray,
            for s, g in enumerate(grids)}
     boxes_list = np.zeros((MAX_BOXES, 4), np.float32)
     boxes_mask = np.zeros((MAX_BOXES,), np.float32)
+    classes_list = np.zeros((MAX_BOXES,), np.int32)
     if n:
+        # truncate EVERYTHING to MAX_BOXES so the y_true positives stay
+        # consistent with the ignore-mask box list — otherwise overflow
+        # boxes would be positives penalized as background
         m = min(n, MAX_BOXES)
-        corners = np.concatenate([boxes_xywh[:m, :2] - boxes_xywh[:m, 2:4] / 2,
-                                  boxes_xywh[:m, :2] + boxes_xywh[:m, 2:4] / 2], 1)
+        boxes_xywh = boxes_xywh[:m]
+        classes = classes[:m]
+        corners = np.concatenate([boxes_xywh[:, :2] - boxes_xywh[:, 2:4] / 2,
+                                  boxes_xywh[:, :2] + boxes_xywh[:, 2:4] / 2], 1)
         boxes_list[:m] = corners
         boxes_mask[:m] = 1.0
+        classes_list[:m] = classes
         best = find_best_anchor(boxes_xywh[:, 2:4], anchors)
         for s, g in enumerate(grids):
             sel = np.isin(best, masks[s])
@@ -216,7 +259,8 @@ def encode_labels(boxes_xywh: np.ndarray, classes: np.ndarray,
             y[gy, gx, a_idx, 0:4] = b[:, 0:4]
             y[gy, gx, a_idx, 4] = 1.0
             y[gy, gx, a_idx, 5 + cls] = 1.0
-    return {**out, "boxes": boxes_list, "boxes_mask": boxes_mask}
+    return {**out, "boxes": boxes_list, "boxes_mask": boxes_mask,
+            "gt_classes": classes_list}
 
 
 # ---------------------------------------------------------------------------

@@ -111,21 +111,23 @@ class DCGANTask:
         """Twin-tape simultaneous update (main.py:55-71): both grads are
         computed against the CURRENT params, then both applied."""
         g, d = states["generator"], states["discriminator"]
-        z_rng, drop_rng = jax.random.split(rng)
+        # independent dropout masks per discriminator application — the
+        # reference's eager TF calls each draw fresh masks
+        z_rng, drop_g, drop_real, drop_fake = jax.random.split(rng, 4)
         real = batch["image"]
         z = jax.random.normal(z_rng, (real.shape[0], self.latent_dim))
 
         def g_loss_fn(g_params):
             fake, g_bs = _apply(g, g_params, z, train=True)
             fake_logit, _ = _apply(d, d.params, fake, train=True,
-                                   rng=drop_rng)
+                                   rng=drop_g)
             return _bce_logits(fake_logit, True), (g_bs, fake)
 
         def d_loss_fn(d_params, fake):
             real_logit, _ = _apply(d, d_params, real, train=True,
-                                   rng=drop_rng)
+                                   rng=drop_real)
             fake_logit, _ = _apply(d, d_params, fake, train=True,
-                                   rng=drop_rng)
+                                   rng=drop_fake)
             return _bce_logits(real_logit, True) + _bce_logits(fake_logit,
                                                                False)
 

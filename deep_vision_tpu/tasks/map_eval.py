@@ -98,3 +98,34 @@ class MeanAPEvaluator:
             aps[c] = average_precision(recall, precision, self.use_07)
         mean_ap = float(np.mean(list(aps.values()))) if aps else 0.0
         return {"mAP": mean_ap, "per_class": aps}
+
+
+class DetectionMAPAccumulator:
+    """Trainer host-evaluator: consumes ``task.eval_outputs`` batches
+    (device-side decode+NMS results + padded gt lists) and reduces to
+    scalar metrics merged into the validation dict."""
+
+    def __init__(self, num_classes: int, iou_threshold: float = 0.5,
+                 use_07_metric: bool = False):
+        self.ev = MeanAPEvaluator(num_classes, iou_threshold, use_07_metric)
+
+    def add_batch(self, outs: dict):
+        det_boxes = np.asarray(outs["det_boxes"])
+        det_scores = np.asarray(outs["det_scores"])
+        det_classes = np.asarray(outs["det_classes"])
+        det_valid = np.asarray(outs["det_valid"])
+        gt_boxes = np.asarray(outs["gt_boxes"])
+        gt_mask = np.asarray(outs["gt_mask"])
+        gt_classes = np.asarray(outs["gt_classes"])
+        # weight-0 rows are eval padding (pad_last batches): skip whole image
+        img_w = np.asarray(outs.get("weight", np.ones(len(det_boxes))))
+        for i in range(len(det_boxes)):
+            if img_w[i] <= 0:
+                continue
+            v = det_valid[i] > 0
+            m = gt_mask[i] > 0
+            self.ev.add(det_boxes[i][v], det_scores[i][v], det_classes[i][v],
+                        gt_boxes[i][m], gt_classes[i][m])
+
+    def compute(self) -> dict:
+        return {"mAP": self.ev.compute()["mAP"]}

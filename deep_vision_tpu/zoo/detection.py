@@ -39,3 +39,22 @@ def yolov3_coco():
 @register_config("yolov3_voc")
 def yolov3_voc():
     return _yolo("yolov3_voc", 20, 16)
+
+
+@register_config("yolov3_toy")
+def yolov3_toy():
+    """Tiny-width YOLOv3 at 64² for smoke runs, convergence tests, and
+    small custom datasets (no reference counterpart — test infrastructure)."""
+    return TrainConfig(
+        name="yolov3_toy",
+        model=lambda: YoloV3(num_classes=3, dtype=jnp.float32,
+                             width=0.125, blocks=(1, 1, 1, 1, 1)),
+        task="detection",
+        batch_size=8,
+        total_epochs=60,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3,
+                                  grad_clip_norm=10.0),
+        image_size=64,
+        num_classes=3,
+        half_precision=False,
+    )

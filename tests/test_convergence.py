@@ -1,0 +1,146 @@
+"""Tiny-overfit convergence tests — each task stack must provably LEARN,
+not just produce finite losses (SURVEY §4 implication (e)).
+
+Each test trains a scaled-down model on a handful of synthetic scenes and
+asserts an outcome a silently-broken loss/codec wiring would fail:
+- YOLO: loss falls ≥5× AND train-set mAP ≥0.8 through the wired
+  decode→NMS→VOC-AP evaluator (the eval the reference lists as "WIP").
+- CenterNet: decode recovers the planted objects (mAP ≥0.8) — the stack
+  the reference left unfinished (ObjectsAsPoints/tensorflow/train.py:35).
+- Hourglass: predicted heatmap argmax hits planted keypoints (PCK ≥0.85).
+- DCGAN: 50-step adversarial loss trajectories stay in sane ranges.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deep_vision_tpu.core.config import TrainConfig, get_config
+from deep_vision_tpu.core.optim import OptimizerConfig
+from deep_vision_tpu.core.trainer import Trainer
+
+
+def test_yolo_overfit_reaches_map(tmp_path, mesh1):
+    from deep_vision_tpu.data.detection import (
+        DetectionLoader,
+        synthetic_detection_dataset,
+    )
+    from deep_vision_tpu.tasks.detection import YoloTask
+
+    cfg = get_config("yolov3_toy")
+    cfg.total_epochs = 150
+    cfg.checkpoint_every_epochs = 1000
+    samples = synthetic_detection_dataset(8, 64, 3, seed=3)
+    train = DetectionLoader(samples, 8, 3, 64, train=True, augment=False,
+                            seed=0)
+    val = DetectionLoader(samples, 8, 3, 64, train=False)
+    task = YoloTask(3)
+    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh1,
+                      workdir=str(tmp_path))
+    state = trainer.init_state(next(iter(train)))
+    m0 = trainer.evaluate(state, val)
+    state = trainer.fit(train, None, state=state)
+    m1 = trainer.evaluate(state, val)
+    assert m1["loss"] * 5 < m0["loss"], (m0, m1)   # loss falls ≥5×
+    assert m1["mAP"] >= 0.8, m1                     # localizes its train set
+
+
+def test_centernet_overfit_recovers_planted_objects(tmp_path, mesh1):
+    from deep_vision_tpu.data.detection import (
+        CenterNetLoader,
+        synthetic_detection_dataset,
+    )
+    from deep_vision_tpu.models.centernet import CenterNet
+    from deep_vision_tpu.tasks.centernet import CenterNetTask
+
+    cfg = TrainConfig(
+        name="centernet_toy",
+        model=lambda: CenterNet(num_classes=3, num_stack=1, order=3,
+                                filters=(32, 32, 48, 64),
+                                dtype=jnp.float32),
+        task="centernet", batch_size=8, total_epochs=150,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        image_size=64, num_classes=3, half_precision=False,
+        checkpoint_every_epochs=1000)
+    samples = synthetic_detection_dataset(8, 64, 3, seed=4)
+    train = CenterNetLoader(samples, 8, 3, 64, train=True, augment=False,
+                            seed=0)
+    val = CenterNetLoader(samples, 8, 3, 64, train=False)
+    task = CenterNetTask(3)
+    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh1,
+                      workdir=str(tmp_path))
+    state = trainer.init_state(next(iter(train)))
+    state = trainer.fit(train, None, state=state)
+    m = trainer.evaluate(state, val)
+    assert m["mAP"] >= 0.8, m
+
+
+def test_hourglass_overfit_localizes_keypoints(tmp_path, mesh1):
+    from deep_vision_tpu.data.pose import PoseLoader, synthetic_pose_dataset
+    from deep_vision_tpu.models.hourglass import StackedHourglass
+    from deep_vision_tpu.tasks.pose import PoseTask
+
+    K = 4
+    cfg = TrainConfig(
+        name="hg_toy",
+        model=lambda: StackedHourglass(num_stack=1, num_heatmap=K,
+                                       filters=32, dtype=jnp.float32),
+        task="pose", batch_size=8, total_epochs=120,
+        optimizer=OptimizerConfig(name="adam", learning_rate=2e-3),
+        image_size=64, num_classes=K, half_precision=False,
+        checkpoint_every_epochs=1000)
+    samples = synthetic_pose_dataset(8, 64, K, seed=5)
+    train = PoseLoader(samples, 8, 64, 16, K, train=True, seed=0)
+    val = PoseLoader(samples, 8, 64, 16, K, train=False)
+    trainer = Trainer(cfg, cfg.model(), PoseTask(), mesh=mesh1,
+                      workdir=str(tmp_path))
+    state = trainer.init_state(next(iter(train)))
+    state = trainer.fit(train, None, state=state)
+
+    # PCK: argmax of each predicted heatmap within 2 cells of the planted
+    # keypoint (the demo_hourglass_pose.ipynb eyeball check, quantified)
+    batch = next(iter(val))
+    variables = {"params": state.params}
+    if state.batch_stats:
+        variables["batch_stats"] = state.batch_stats
+    heat = np.asarray(trainer.model.apply(
+        variables, jnp.asarray(batch["image"]), train=False)[-1])
+    kp = batch["keypoints"]
+    hits = total = 0
+    for b in range(heat.shape[0]):
+        for k in range(K):
+            if kp[b, k, 2] <= 0:
+                continue
+            total += 1
+            yy, xx = np.unravel_index(np.argmax(heat[b, :, :, k]),
+                                      heat.shape[1:3])
+            if abs(xx - kp[b, k, 0]) <= 2 and abs(yy - kp[b, k, 1]) <= 2:
+                hits += 1
+    assert total > 0
+    assert hits / total >= 0.85, f"PCK {hits}/{total}"
+
+
+def test_dcgan_loss_trajectories_sane():
+    from deep_vision_tpu.models.gan import DCGANDiscriminator, DCGANGenerator
+    from deep_vision_tpu.tasks.gan import DCGANTask
+
+    task = DCGANTask(DCGANGenerator(), DCGANDiscriminator(), latent_dim=16,
+                     opt=OptimizerConfig(name="adam", learning_rate=2e-4,
+                                         b1=0.5))
+    rng = jax.random.PRNGKey(0)
+    data = np.random.default_rng(0).uniform(
+        -1, 1, (8, 28, 28, 1)).astype(np.float32)
+    batch = {"image": jnp.asarray(data)}
+    states = task.init_states(rng, batch)
+    step = jax.jit(task.train_step)
+    g_losses, d_losses = [], []
+    for i in range(50):
+        states, _, metrics = step(states, batch, jax.random.fold_in(rng, i))
+        g_losses.append(float(metrics["g_loss"]))
+        d_losses.append(float(metrics["d_loss"]))
+    g, d = np.asarray(g_losses), np.asarray(d_losses)
+    assert np.isfinite(g).all() and np.isfinite(d).all()
+    # discriminator improves on the fixed real batch: d_loss trends down
+    assert d[-10:].mean() < d[:5].mean(), (d[:5], d[-10:])
+    # neither side collapses: G still gets gradient signal (finite, nonzero)
+    assert 0.0 < g[-1] < 20.0 and 0.0 < d[-1] < 10.0

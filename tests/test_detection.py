@@ -88,6 +88,29 @@ def test_encode_labels_places_box():
     assert enc["boxes_mask"].sum() == 1
 
 
+def test_encode_labels_overflow_truncated_consistently():
+    """>MAX_BOXES boxes: y_true positives must cover exactly the same first
+    MAX_BOXES boxes as the ignore-mask list, so no positive is simultaneously
+    penalized as background."""
+    rng = np.random.default_rng(7)
+    n = D.MAX_BOXES + 20
+    xy = rng.uniform(0.2, 0.8, (n, 2)).astype(np.float32)
+    wh = rng.uniform(0.05, 0.3, (n, 2)).astype(np.float32)
+    boxes = np.concatenate([xy, wh], 1)
+    classes = rng.integers(0, 5, n)
+    enc = D.encode_labels(boxes, classes, num_classes=5)
+    assert enc["boxes_mask"].sum() == D.MAX_BOXES
+    # every positive cell's box must appear in the ignore-mask list
+    gt_corners = enc["boxes"][enc["boxes_mask"] > 0]
+    for s in range(3):
+        y = enc[f"y_true_{s}"]
+        pos = y[..., 4] > 0
+        for b in y[pos][:, 0:4]:
+            corner = np.concatenate([b[:2] - b[2:] / 2, b[:2] + b[2:] / 2])
+            match = np.abs(gt_corners - corner).max(1).min()
+            assert match < 1e-6
+
+
 def test_yolo_loss_zero_for_perfect_prediction():
     """If raw predictions exactly re-encode the ground truth, coordinate and
     class losses vanish and obj loss is small (finite BCE saturation)."""
@@ -127,6 +150,29 @@ def test_yolo_loss_penalizes_wrong_prediction():
         raw, y_true, jnp.asarray(enc["boxes"])[None],
         jnp.asarray(enc["boxes_mask"])[None], anchors)
     assert float(total.sum()) > 1.0
+
+
+def test_yolo_loss_grad_with_pallas_path():
+    """value_and_grad must work through the Pallas ignore-mask path —
+    pallas_call has no autodiff rule, so the mask is stop_gradient'd."""
+    num_classes = 3
+    enc = D.encode_labels(
+        np.array([[0.5, 0.5, 0.3, 0.3]], np.float32),
+        np.array([1]), num_classes, grids=(13,), masks=np.array([[6, 7, 8]]))
+    y_true = jnp.asarray(enc["y_true_0"])[None]
+    anchors = jnp.asarray(YOLO_ANCHORS[[6, 7, 8]])
+    raw = jnp.zeros((1, 13, 13, 3, 5 + num_classes))
+
+    def loss_fn(raw):
+        total, _ = D.yolo_scale_loss(
+            raw, y_true, jnp.asarray(enc["boxes"])[None],
+            jnp.asarray(enc["boxes_mask"])[None], anchors, use_pallas=True)
+        return total.sum()
+
+    loss, grads = jax.value_and_grad(loss_fn)(raw)
+    assert np.isfinite(float(loss))
+    assert np.isfinite(np.asarray(grads)).all()
+    assert float(jnp.abs(grads).max()) > 0
 
 
 def test_average_precision_perfect():

@@ -108,6 +108,54 @@ def test_multiprocess_workers(fake_imagenet):
         loader.close()
 
 
+def test_device_normalize_path_matches_host(fake_imagenet):
+    """uint8 loader + device jitter_normalize(train=False) must reproduce
+    the host eval_transform exactly (same crop, same normalization)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.ops.preprocess import jitter_normalize
+
+    root, labels = fake_imagenet
+    host = ImageNetLoader(root, labels, batch_size=4, train=False,
+                          image_size=32, resize=36, num_workers=0,
+                          process_index=0, process_count=1)
+    dev = ImageNetLoader(root, labels, batch_size=4, train=False,
+                         image_size=32, resize=36, num_workers=0,
+                         process_index=0, process_count=1,
+                         device_normalize=True)
+    hb = next(iter(host))
+    db = next(iter(dev))
+    assert db["image"].dtype == np.uint8
+    out = np.asarray(jitter_normalize(jnp.asarray(db["image"]),
+                                      jax.random.PRNGKey(0), train=False))
+    np.testing.assert_allclose(out, hb["image"], atol=1e-5)
+
+
+def test_device_preprocess_trains(fake_imagenet, tmp_path, mesh1):
+    """End-to-end: uint8 batches through Trainer(preprocess_fn=...) —
+    the fused-device path the ImageNet CLI uses by default."""
+    from deep_vision_tpu.core.config import get_config
+    from deep_vision_tpu.core.trainer import Trainer
+    from deep_vision_tpu.ops.preprocess import make_imagenet_preprocess
+    from deep_vision_tpu.tasks.classification import ClassificationTask
+
+    root, labels = fake_imagenet
+    cfg = get_config("resnet50")
+    cfg.total_epochs = 1
+    cfg.batch_size = cfg.eval_batch_size = 4
+    cfg.image_size = 32
+    loader = ImageNetLoader(root, labels, batch_size=4, train=True,
+                            image_size=32, resize=36, num_workers=0,
+                            process_index=0, process_count=1,
+                            device_normalize=True)
+    trainer = Trainer(cfg, cfg.model(), ClassificationTask(cfg.num_classes),
+                      mesh=mesh1, workdir=str(tmp_path),
+                      preprocess_fn=make_imagenet_preprocess())
+    state = trainer.fit(loader, None)
+    assert int(np.asarray(state.step)) == len(loader)
+
+
 def test_val_loader_isolated_from_train_with_zero_workers(fake_imagenet):
     """Regression: two 0-worker loaders must not share decode state —
     val must read val files with eval transforms."""

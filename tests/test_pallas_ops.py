@@ -37,3 +37,10 @@ def test_best_iou_max_all_masked_is_zero():
     mask = jnp.zeros((1, 8))
     out = best_iou_max(pred, gt, mask, interpret=True)
     assert float(jnp.abs(out).max()) == 0.0
+
+
+def test_parity_check_passes_interpret():
+    """The startup gate the CLI uses before enabling the Pallas path."""
+    from deep_vision_tpu.ops.pallas_ops import pallas_parity_ok
+
+    assert pallas_parity_ok(interpret=True)
