@@ -1,0 +1,24 @@
+# Container recipe for the training CLI — the Hourglass Dockerfile role
+# (Hourglass/tensorflow/Dockerfile:1-20: cuda base + pip deps + ENTRYPOINT
+# main.py), re-based for TPU hosts: no CUDA base image needed, the TPU
+# runtime comes with jax[tpu] wheels.
+#
+# Build:  docker build -t deep-vision-tpu .
+# Smoke:  docker run --rm deep-vision-tpu -m resnet50 --synthetic --epochs 2
+# TPU:    run on a TPU VM with --privileged --net=host (libtpu device access)
+#         docker run --privileged --net=host -v /data:/data deep-vision-tpu \
+#             -m resnet50 --data-root /data/imagenet --upload gs://bucket/run1
+
+FROM python:3.12-slim
+
+WORKDIR /app
+
+# TPU wheels; on a non-TPU host jax falls back to CPU automatically.
+RUN pip install --no-cache-dir \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    flax optax orbax-checkpoint chex einops numpy pillow
+
+COPY deep_vision_tpu/ deep_vision_tpu/
+
+ENTRYPOINT ["python", "-m", "deep_vision_tpu.cli.train"]
+CMD ["--list", "-m", "x"]

@@ -112,7 +112,10 @@ def bench_train_step(batch: int = 256, size: int = 224, steps: int = 20,
         jax.profiler.stop_trace()
         print("# trace written to /tmp/bench_profile")
 
-    n_chips = jax.device_count()
+    # normalize by the devices the step ACTUALLY spans (a plain jit runs on
+    # one device regardless of how many chips the host exposes)
+    n_chips = len({d for arr in jax.tree_util.tree_leaves(state)
+                   for d in arr.devices()}) or 1
     img_per_sec_per_chip = steps * batch / dt / n_chips
     out = {
         "metric": "resnet50_train_images_per_sec_per_chip",

@@ -41,6 +41,16 @@ def main(argv=None):
     imagenet = sub.add_parser("imagenet")
     imagenet.add_argument("--src", required=True)
     imagenet.add_argument("--labels", required=True)
+    imagenet.add_argument("--bbox-csv", default=None,
+                          help="imagenet-bboxes output; boxes go into "
+                               "record headers")
+
+    # XML bbox tree → relative-coords CSV (process_bounding_boxes.py role)
+    bboxes = sub.add_parser("imagenet-bboxes")
+    bboxes.add_argument("--xml-dir", required=True)
+    bboxes.add_argument("--out-csv", required=True)
+    bboxes.add_argument("--synsets", default=None,
+                        help="restrict to challenge synsets (one id/line)")
 
     unpaired = sub.add_parser("unpaired")
     unpaired.add_argument("--dir-a", required=True)
@@ -73,7 +83,13 @@ def main(argv=None):
                               args.split, args.num_shards, args.num_workers)
     elif args.cmd == "imagenet":
         n = prep.prepare_imagenet(args.src, args.labels, args.out, args.split,
-                                  args.num_shards, args.num_workers)
+                                  args.num_shards, args.num_workers,
+                                  bbox_csv=args.bbox_csv)
+    elif args.cmd == "imagenet-bboxes":
+        stats = prep.process_imagenet_bboxes(args.xml_dir, args.out_csv,
+                                             args.synsets)
+        print(f"prepared: {stats}")
+        return 0
     elif args.cmd == "unpaired":
         n = prep.prepare_unpaired(args.dir_a, args.dir_b, args.out,
                                   args.split, args.num_shards,

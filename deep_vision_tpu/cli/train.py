@@ -36,6 +36,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host-normalize", action="store_true",
                    help="float32 jitter+normalize on the HOST (reference "
                         "semantics) instead of fused device preprocessing")
+    p.add_argument("--upload", default=None,
+                   help="sync checkpoints to this URI after each save "
+                        "(path, file://, or gs://)")
     p.add_argument("--profile", action="store_true",
                    help="jax.profiler trace of steps 10-20 → workdir/profile")
     p.add_argument("--list", action="store_true", help="list configs and exit")
@@ -140,7 +143,7 @@ def main(argv=None):
             preprocess_fn = make_imagenet_preprocess()
 
     trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir,
-                      preprocess_fn=preprocess_fn)
+                      preprocess_fn=preprocess_fn, upload=args.upload)
     if args.profile:
         trainer.profile_steps = (10, 20)
     state = trainer.fit(train_loader, val_loader, resume=args.resume)
@@ -199,7 +202,8 @@ def _main_detection(args, cfg, mesh):
                              train=True, seed=cfg.seed)
     val_loader = LoaderCls(val_samples, cfg.batch_size,
                            cfg.num_classes, cfg.image_size, train=False)
-    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir)
+    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir,
+                      upload=args.upload)
     state = trainer.fit(train_loader, val_loader, resume=args.resume)
     final = trainer.evaluate(state, val_loader)
     print("final:", " ".join(f"{k}={v:.4f}" for k, v in final.items()))
@@ -230,7 +234,8 @@ def _main_pose(args, cfg, mesh):
                               seed=cfg.seed)
     val_loader = PoseLoader(val_samples, cfg.batch_size, cfg.image_size,
                             heatmap_size, cfg.num_classes, train=False)
-    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir)
+    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir,
+                      upload=args.upload)
     state = trainer.fit(train_loader, val_loader, resume=args.resume)
     final = trainer.evaluate(state, val_loader)
     print("final:", " ".join(f"{k}={v:.4f}" for k, v in final.items()))
@@ -269,7 +274,8 @@ def _main_gan(args, cfg, mesh):
             lambda: gan_models.PatchGANDiscriminator(dtype=dtype),
             opt=cfg.optimizer)
 
-    trainer = AdversarialTrainer(cfg, task, mesh=mesh, workdir=args.workdir)
+    trainer = AdversarialTrainer(cfg, task, mesh=mesh, workdir=args.workdir,
+                                 upload=args.upload)
     states = trainer.fit(loader, epochs=cfg.total_epochs, resume=args.resume)
     print("done: trained", ", ".join(states))
     return 0

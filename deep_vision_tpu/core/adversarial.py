@@ -22,6 +22,7 @@ from typing import Iterable
 import jax
 
 from deep_vision_tpu.core import checkpoint as ckpt_lib
+from deep_vision_tpu.core.state import DivergenceGuard, all_finite
 from deep_vision_tpu.core.config import TrainConfig
 from deep_vision_tpu.core.metrics import MetricLogger, ThroughputMeter
 from deep_vision_tpu.core.optim import build_scheduler, set_learning_rate
@@ -30,7 +31,7 @@ from deep_vision_tpu.parallel import make_mesh, replicate, shard_batch
 
 class AdversarialTrainer:
     def __init__(self, config: TrainConfig, task, mesh=None,
-                 workdir: str | None = None):
+                 workdir: str | None = None, upload: str | None = None):
         self.config = config
         self.task = task  # owns models, optimizers, and the step math
         self.mesh = mesh if mesh is not None else make_mesh()
@@ -42,9 +43,15 @@ class AdversarialTrainer:
         self.checkpointer = ckpt_lib.Checkpointer(
             os.path.join(self.workdir, "checkpoints"),
             max_to_keep=config.keep_checkpoints)
+        self.uploader = None
+        if upload:
+            from deep_vision_tpu.core.upload import ArtifactUploader
+
+            self.uploader = ArtifactUploader(upload)
         self._jit_step = None
         self.start_epoch = 1
         self.start_step = 0
+        self.guard = DivergenceGuard(config.max_bad_steps)
 
     def init_states(self, sample_batch: dict) -> dict:
         states = self.task.init_states(
@@ -59,13 +66,31 @@ class AdversarialTrainer:
         self.start_step = int(self.checkpointer.latest_step() or 0)
         if "scheduler" in extras:
             self.scheduler.load_state_dict(extras["scheduler"])
+        first = next(iter(states.values()))
+        self.guard.set_baseline(int(jax.device_get(first.bad_steps)))
         print(f"[resume] adversarial start_epoch={self.start_epoch} "
               f"step={self.start_step}")
         return {k: replicate(v, self.mesh) for k, v in states.items()}
 
     def train_step(self, states, batch, rng):
         if self._jit_step is None:
-            self._jit_step = jax.jit(self.task.train_step, donate_argnums=0)
+            task_step = self.task.train_step
+
+            def guarded(states, batch, rng):
+                """Divergence guard around the task's multi-network step:
+                if any loss or any updated network went non-finite, every
+                network keeps its previous params/opt_state (GAN updates are
+                coupled — applying half a step would unbalance G vs D)."""
+                new_states, outputs, metrics = task_step(states, batch, rng)
+                ok = all_finite(list(metrics.values())) & all_finite(
+                    {k: s.params for k, s in new_states.items()})
+                merged = {k: new_states[k].keep_if(ok, states[k])
+                          for k in new_states}
+                first = next(iter(merged))
+                metrics = dict(metrics, bad_steps=merged[first].bad_steps)
+                return merged, outputs, metrics
+
+            self._jit_step = jax.jit(guarded, donate_argnums=0)
         return self._jit_step(states, shard_batch(batch, self.mesh), rng)
 
     def fit(self, train_data: Iterable, epochs: int | None = None,
@@ -101,6 +126,7 @@ class AdversarialTrainer:
                 if step % cfg.log_every_steps == 0:
                     m = {k: float(v) for k, v in
                          jax.device_get(metrics).items()}
+                    self.guard.check(m)
                     self.logger.log_dict(step, m)
                     print(f"Epoch {epoch} Step {step} "
                           + " ".join(f"{k}={v:.4f}" for k, v in m.items())
@@ -112,6 +138,9 @@ class AdversarialTrainer:
                     step, states,
                     extras={"epoch": epoch,
                             "scheduler": self.scheduler.state_dict()})
+                if self.uploader is not None:
+                    self.uploader.sync(self.checkpointer.directory,
+                                       "checkpoints")
             if sample_hook is not None:
                 sample_hook(epoch, states)
         return states

@@ -46,6 +46,43 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def _restore_payload(self, step: int, template: dict) -> tuple[dict, dict]:
+        """Restore ``template``-shaped payload + extras; keys the stored
+        checkpoint predates (e.g. ``bad_steps``) are dropped from the
+        template and left at their fresh-state values, so old checkpoints
+        stay restorable after TrainState grows a field."""
+        abstract = jax.tree_util.tree_map(
+            ocp.utils.to_shape_dtype_struct, template)
+        try:
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(abstract),
+                    extras=ocp.args.JsonRestore(),
+                ),
+            )
+            return restored["state"], dict(restored["extras"] or {})
+        except (ValueError, KeyError):
+            # structure mismatch: intersect the template with what the
+            # checkpoint actually holds, then retry
+            meta = self._mgr.item_metadata(step)["state"]
+
+            def prune(tmpl, stored):
+                if not isinstance(tmpl, dict):
+                    return tmpl
+                return {k: prune(v, stored[k]) for k, v in tmpl.items()
+                        if stored is not None and k in stored}
+
+            pruned = prune(abstract, meta)
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(pruned),
+                    extras=ocp.args.JsonRestore(),
+                ),
+            )
+            return restored["state"], dict(restored["extras"] or {})
+
     def restore(self, state: TrainState, step: int | None = None
                 ) -> tuple[TrainState, dict]:
         """Restore into the structure of a freshly-initialized ``state``."""
@@ -53,18 +90,10 @@ class Checkpointer:
             step = self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        abstract = jax.tree_util.tree_map(
-            ocp.utils.to_shape_dtype_struct, {"state": state.save_dict()}
-        )
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                extras=ocp.args.JsonRestore(),
-            ),
-        )
-        new_state = state.load_dict(restored["state"]["state"])
-        return new_state, dict(restored["extras"] or {})
+        payload, extras = self._restore_payload(
+            step, {"state": state.save_dict()})
+        new_state = state.load_dict(payload["state"])
+        return new_state, extras
 
     # -- multi-state trees (AdversarialTrainer: {name: TrainState}) --------
 
@@ -85,19 +114,10 @@ class Checkpointer:
             step = self._mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        abstract = jax.tree_util.tree_map(
-            ocp.utils.to_shape_dtype_struct,
-            {k: v.save_dict() for k, v in states.items()})
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(abstract),
-                extras=ocp.args.JsonRestore(),
-            ),
-        )
-        new_states = {k: v.load_dict(restored["state"][k])
-                      for k, v in states.items()}
-        return new_states, dict(restored["extras"] or {})
+        payload, extras = self._restore_payload(
+            step, {k: v.save_dict() for k, v in states.items()})
+        new_states = {k: v.load_dict(payload[k]) for k, v in states.items()}
+        return new_states, extras
 
     def close(self):
         self._mgr.close()

@@ -40,6 +40,9 @@ class TrainConfig:
     checkpoint_every_epochs: int = 1
     keep_checkpoints: int = 3
     log_every_steps: int = 10  # reference printed every 10 batches
+    # divergence guard: non-finite steps are skipped + counted; the run
+    # halts with a clear error once more than this many were skipped
+    max_bad_steps: int = 100
     seed: int = 42
     extra: dict = dataclasses.field(default_factory=dict)
 

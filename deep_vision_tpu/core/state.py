@@ -18,6 +18,42 @@ import optax
 from flax import core, struct
 
 
+class DivergenceGuard:
+    """Host-side policy over the cumulative ``bad_steps`` counter: warn on
+    newly-skipped non-finite steps, halt once THIS RUN skipped more than
+    ``limit``.  ``baseline`` is the counter value restored from a
+    checkpoint so old skips never count against the current run."""
+
+    def __init__(self, limit: int):
+        self.limit = limit
+        self.baseline = 0
+        self._seen = 0
+
+    def set_baseline(self, bad_steps: int):
+        self.baseline = self._seen = int(bad_steps)
+
+    def check(self, metrics: dict):
+        bad = int(metrics.get("bad_steps", 0))
+        if bad > self._seen:
+            print(f"[warn] skipped {bad - self._seen} non-finite step(s) — "
+                  f"{bad - self.baseline} total this run", flush=True)
+            self._seen = bad
+        if bad - self.baseline > self.limit:
+            raise RuntimeError(
+                f"training diverged: {bad - self.baseline} non-finite steps "
+                f"skipped (> max_bad_steps={self.limit}); lower the "
+                f"learning rate or inspect the input data")
+
+
+def all_finite(tree) -> jax.Array:
+    """Scalar bool: every element of every array leaf is finite."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.bool_(True)
+    checks = [jnp.all(jnp.isfinite(leaf)) for leaf in leaves]
+    return jnp.stack(checks).all()
+
+
 class TrainState(struct.PyTreeNode):
     """Immutable train state; ``apply_fn``/``tx`` are static (not saved)."""
 
@@ -26,6 +62,11 @@ class TrainState(struct.PyTreeNode):
     opt_state: optax.OptState
     batch_stats: core.FrozenDict[str, Any] | dict  # {} for BN-free models
     rng: jax.Array
+    # cumulative count of skipped non-finite steps (divergence guard — the
+    # reference merely TODO'd its NaN val losses, Hourglass/tensorflow/
+    # train.py:126-130; we skip the bad update, count it, and let the host
+    # loop halt past config.max_bad_steps)
+    bad_steps: jax.Array
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
 
@@ -39,6 +80,30 @@ class TrainState(struct.PyTreeNode):
             **changes,
         )
 
+    def keep_if(self, ok, old: "TrainState") -> "TrainState":
+        """Branch-free guard merge: where ``ok`` is False, revert
+        params/opt_state/batch_stats to ``old`` and count one bad step;
+        the step counter keeps its advanced value either way (so per-step
+        rng folding never repeats a stream).  No host sync, jit/GSPMD-safe."""
+
+        def sel(new, prev):
+            return jax.tree_util.tree_map(
+                lambda a, b: jnp.where(ok, a, b), new, prev)
+
+        return self.replace(
+            params=sel(self.params, old.params),
+            opt_state=sel(self.opt_state, old.opt_state),
+            batch_stats=sel(self.batch_stats, old.batch_stats),
+            bad_steps=old.bad_steps + (~ok).astype(jnp.int32),
+        )
+
+    def apply_gradients_if_finite(self, loss, grads, **changes) -> "TrainState":
+        """``apply_gradients`` guarded on loss/grad finiteness: a non-finite
+        step keeps params/opt_state/batch_stats unchanged and increments
+        ``bad_steps`` (see :meth:`keep_if`)."""
+        ok = jnp.isfinite(loss) & all_finite(grads)
+        return self.apply_gradients(grads, **changes).keep_if(ok, self)
+
     @classmethod
     def create(cls, *, apply_fn, params, tx, batch_stats=None, rng=None) -> "TrainState":
         return cls(
@@ -47,6 +112,7 @@ class TrainState(struct.PyTreeNode):
             opt_state=tx.init(params),
             batch_stats=batch_stats if batch_stats is not None else {},
             rng=rng if rng is not None else jax.random.PRNGKey(0),
+            bad_steps=jnp.zeros((), jnp.int32),
             apply_fn=apply_fn,
             tx=tx,
         )
@@ -59,6 +125,7 @@ class TrainState(struct.PyTreeNode):
             "opt_state": self.opt_state,
             "batch_stats": self.batch_stats,
             "rng": self.rng,
+            "bad_steps": self.bad_steps,
         }
 
     def load_dict(self, payload: dict) -> "TrainState":

@@ -38,7 +38,7 @@ from deep_vision_tpu.core.optim import (
     build_scheduler,
     set_learning_rate,
 )
-from deep_vision_tpu.core.state import TrainState
+from deep_vision_tpu.core.state import DivergenceGuard, TrainState
 from deep_vision_tpu.parallel import make_mesh, replicate, shard_batch
 
 
@@ -48,7 +48,8 @@ class Trainer:
     :class:`deep_vision_tpu.core.adversarial.AdversarialTrainer`."""
 
     def __init__(self, config: TrainConfig, model, task, mesh=None,
-                 workdir: str | None = None, preprocess_fn=None):
+                 workdir: str | None = None, preprocess_fn=None,
+                 upload: str | None = None):
         self.config = config
         self.model = model
         self.task = task
@@ -68,10 +69,18 @@ class Trainer:
             max_to_keep=config.keep_checkpoints)
         self.best_checkpointer = ckpt_lib.Checkpointer(
             os.path.join(self.workdir, "checkpoints_best"), max_to_keep=1)
+        # optional off-host artifact sync after each checkpoint (the
+        # Hourglass GCS-upload role, Hourglass/tensorflow/main.py:21-65)
+        self.uploader = None
+        if upload:
+            from deep_vision_tpu.core.upload import ArtifactUploader
+
+            self.uploader = ArtifactUploader(upload)
         self._has_bn: bool | None = None
         self._jit_train_step = None
         self._jit_eval_step = None
         self.start_epoch = 1
+        self.guard = DivergenceGuard(config.max_bad_steps)
         # profiling: trace steps [start, stop) of epoch 1 to
         # workdir/profile (the reference had only throughput prints —
         # SURVEY §5 tracing; TPU-native answer is a jax.profiler trace)
@@ -108,6 +117,8 @@ class Trainer:
             self.scheduler.load_state_dict(extras["scheduler"])
         if "history" in extras:
             self.logger.load_state_dict(extras["history"])
+        # old skips must not count against the resumed run's budget
+        self.guard.set_baseline(int(jax.device_get(state.bad_steps)))
         print(f"[resume] restored step={int(state.step)} "
               f"start_epoch={self.start_epoch}")
         return replicate(state, self.mesh)
@@ -142,8 +153,13 @@ class Trainer:
 
             (loss, (new_bs, aux)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(state.params)
-            new_state = state.apply_gradients(grads, batch_stats=new_bs)
-            metrics = {"loss": loss, **aux}
+            # divergence guard: a non-finite loss/grad step is skipped (not
+            # applied) and counted; the epoch loop halts past
+            # config.max_bad_steps (reference context: the NaN val losses
+            # Hourglass/tensorflow/train.py:126-130 only TODO'd about)
+            new_state = state.apply_gradients_if_finite(
+                loss, grads, batch_stats=new_bs)
+            metrics = {"loss": loss, "bad_steps": new_state.bad_steps, **aux}
             return new_state, metrics
 
         # host-evaluator protocol (e.g. detection mAP): the task decodes
@@ -238,6 +254,7 @@ class Trainer:
             meter.update(bs)
             if pending is not None and (i % cfg.log_every_steps == 0):
                 m = {k: float(v) for k, v in jax.device_get(pending).items()}
+                self.guard.check(m)
                 self.logger.log_dict(int(state.step) - 1,
                                      {f"train_{k}": v for k, v in m.items()})
                 print(f"Epoch {epoch} Batch {i} loss {m['loss']:.4f} "
@@ -251,6 +268,7 @@ class Trainer:
                   f"{self.workdir}/profile", flush=True)
         if pending is not None:
             m = {k: float(v) for k, v in jax.device_get(pending).items()}
+            self.guard.check(m)
             self.logger.log_dict(int(state.step),
                                  {f"train_{k}": v for k, v in m.items()})
         self.logger.log("images_per_sec", int(state.step), meter.images_per_sec)
@@ -300,6 +318,9 @@ class Trainer:
                     int(jax.device_get(state.step)), state,
                     extras={"epoch": epoch, "metric": float(metric_val),
                             "monitor": monitor or ""})
+                if self.uploader is not None:
+                    self.uploader.sync(self.best_checkpointer.directory,
+                                       "checkpoints_best")
         return state
 
     def save(self, state: TrainState, epoch: int):
@@ -308,3 +329,5 @@ class Trainer:
             extras={"epoch": epoch,
                     "scheduler": self.scheduler.state_dict(),
                     "history": self.logger.state_dict()})
+        if self.uploader is not None:
+            self.uploader.sync(self.checkpointer.directory, "checkpoints")

@@ -1,0 +1,117 @@
+"""Artifact upload: sync run artifacts (checkpoints) off-host.
+
+The reference's cloud path is Hourglass-only: ``main.py:21-65`` trains,
+then pushes the saved model to a GCS bucket with ``google.cloud.storage``.
+Generalized here as a destination-URI sync usable from every trainer via
+``--upload <uri>``:
+
+- ``/path`` or ``file:///path`` — local/NFS mirror (works everywhere,
+  including air-gapped CI);
+- ``gs://bucket/prefix`` — Google Cloud Storage, via the
+  ``google.cloud.storage`` client if installed, else the ``gsutil`` CLI
+  (both gated: this repo adds no cloud dependencies).
+
+Sync is one-way and incremental by (size, mtime), rsync-style, so calling
+it after every checkpoint is cheap.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+
+def _iter_files(src_dir: str):
+    for root, _, files in os.walk(src_dir):
+        for f in files:
+            full = os.path.join(root, f)
+            yield full, os.path.relpath(full, src_dir)
+
+
+def _sync_local(src_dir: str, dest_dir: str) -> int:
+    n = 0
+    keep = set()
+    for full, rel in _iter_files(src_dir):
+        keep.add(rel)
+        dest = os.path.join(dest_dir, rel)
+        st = os.stat(full)
+        if os.path.exists(dest):
+            dst = os.stat(dest)
+            if dst.st_size == st.st_size and dst.st_mtime >= st.st_mtime:
+                continue
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        shutil.copy2(full, dest)
+        n += 1
+    # true mirror: drop files pruned locally (max_to_keep rotation),
+    # so the destination doesn't accumulate every checkpoint ever written
+    for full, rel in list(_iter_files(dest_dir)):
+        if rel not in keep:
+            os.remove(full)
+    for root, dirs, files in os.walk(dest_dir, topdown=False):
+        if not dirs and not files and root != dest_dir:
+            os.rmdir(root)
+    return n
+
+
+def _sync_gcs(src_dir: str, uri: str) -> int:
+    try:
+        from google.cloud import storage  # type: ignore
+    except ImportError:
+        # fall back to the gsutil CLI if present (-d: true mirror,
+        # deletes remotely what max_to_keep pruned locally)
+        if shutil.which("gsutil"):
+            subprocess.run(["gsutil", "-m", "rsync", "-r", "-d",
+                            src_dir, uri], check=True)
+            return -1  # count unknown
+        raise RuntimeError(
+            "gs:// upload needs google-cloud-storage or gsutil; neither "
+            "is available — use a file:// destination or install one")
+    bucket_name, _, prefix = uri[len("gs://"):].partition("/")
+    bucket = storage.Client().bucket(bucket_name)
+    # incremental: list what's already there once, skip same-size blobs
+    # (checkpoint files are content-addressed-ish — same size ⇒ same file
+    # for orbax array payloads; a rare same-size edit re-uploads next run)
+    existing = {b.name: b.size
+                for b in bucket.list_blobs(prefix=prefix or None)}
+    n = 0
+    keep = set()
+    for full, rel in _iter_files(src_dir):
+        name = os.path.join(prefix, rel) if prefix else rel
+        keep.add(name)
+        if existing.get(name) == os.path.getsize(full):
+            continue
+        bucket.blob(name).upload_from_filename(full)
+        n += 1
+    for name in existing:  # mirror semantics (see _sync_local)
+        if name not in keep:
+            bucket.blob(name).delete()
+    return n
+
+
+def sync_dir(src_dir: str, dest_uri: str) -> int:
+    """Mirror ``src_dir`` under ``dest_uri``; returns files copied
+    (-1 if the backend doesn't report)."""
+    if dest_uri.startswith("gs://"):
+        return _sync_gcs(src_dir, dest_uri)
+    dest = dest_uri[len("file://"):] if dest_uri.startswith("file://") \
+        else dest_uri
+    os.makedirs(dest, exist_ok=True)
+    return _sync_local(src_dir, dest)
+
+
+class ArtifactUploader:
+    """Post-checkpoint hook: mirrors the workdir's checkpoint dirs to a
+    destination URI.  Failures are reported but never kill training —
+    losing an upload must not lose the run."""
+
+    def __init__(self, dest_uri: str):
+        self.dest_uri = dest_uri.rstrip("/")
+
+    def sync(self, src_dir: str, tag: str):
+        try:
+            n = sync_dir(src_dir, f"{self.dest_uri}/{tag}")
+            print(f"[upload] {tag}: {n if n >= 0 else '?'} file(s) → "
+                  f"{self.dest_uri}/{tag}", flush=True)
+        except Exception as e:  # noqa: BLE001 — deliberately broad
+            print(f"[upload] FAILED for {tag}: {e}", flush=True)

@@ -27,32 +27,36 @@ def flip_boxes_lr(boxes: np.ndarray) -> np.ndarray:
 
 
 def random_crop_with_boxes(img: np.ndarray, boxes: np.ndarray,
-                           rng: np.random.Generator,
-                           min_keep: float = 0.3):
-    """Random crop keeping ≥1 box; boxes clipped into the crop, boxes whose
-    remaining area fraction < min_keep are dropped (preprocess.py:52-119
-    semantics without the tf.while retry loop: we sample a crop containing
-    all box centers)."""
+                           rng: np.random.Generator):
+    """Box-preserving random crop — exact semantics of the reference's
+    ``get_random_crop_delta`` + ``random_crop_image_and_label``
+    (YOLO/tensorflow/preprocess.py:52-119): sample one margin per side
+    uniformly between the union hull of ALL boxes and the image edge, so
+    the crop always contains every box in full; boxes are renormalized by
+    the delta formula (new = (old - lo_delta) / (1 - lo_delta - hi_delta)).
+
+    Returns (crop, new_boxes, keep) — keep is all-True (kept for caller
+    symmetry with flip/other augmentations that can drop boxes).
+    """
     h, w = img.shape[:2]
     if len(boxes) == 0:
         return img, boxes, np.zeros((0,), bool)
-    centers_x = (boxes[:, 0] + boxes[:, 2]) / 2 * w
-    centers_y = (boxes[:, 1] + boxes[:, 3]) / 2 * h
-    # crop bounds must include every center: sample within the slack
-    x1 = int(rng.integers(0, max(1, int(centers_x.min()) + 1)))
-    y1 = int(rng.integers(0, max(1, int(centers_y.min()) + 1)))
-    x2 = int(rng.integers(min(w - 1, int(np.ceil(centers_x.max()))), w)) + 1
-    y2 = int(rng.integers(min(h - 1, int(np.ceil(centers_y.max()))), h)) + 1
-    crop = img[y1:y2, x1:x2]
-    ch, cw = crop.shape[:2]
-    abs_boxes = boxes * [w, h, w, h]
-    shifted = abs_boxes - [x1, y1, x1, y1]
-    clipped = np.clip(shifted, 0, [cw, ch, cw, ch])
-    area = np.maximum(clipped[:, 2] - clipped[:, 0], 0) * \
-        np.maximum(clipped[:, 3] - clipped[:, 1], 0)
-    orig = (abs_boxes[:, 2] - abs_boxes[:, 0]) * (abs_boxes[:, 3] - abs_boxes[:, 1])
-    keep = area / np.maximum(orig, 1e-9) >= min_keep
-    return crop, (clipped / [cw, ch, cw, ch])[keep].astype(np.float32), keep
+    # normalized slack between the hull of all boxes and each image edge
+    dx1 = rng.uniform(0, max(0.0, boxes[:, 0].min()))
+    dy1 = rng.uniform(0, max(0.0, boxes[:, 1].min()))
+    dx2 = rng.uniform(0, max(0.0, 1.0 - boxes[:, 2].max()))
+    dy2 = rng.uniform(0, max(0.0, 1.0 - boxes[:, 3].max()))
+    new_w = 1.0 - dx1 - dx2
+    new_h = 1.0 - dy1 - dy2
+    out = boxes.copy()
+    out[:, [0, 2]] = (boxes[:, [0, 2]] - dx1) / max(new_w, 1e-9)
+    out[:, [1, 3]] = (boxes[:, [1, 3]] - dy1) / max(new_h, 1e-9)
+    oy, ox = int(dy1 * h), int(dx1 * w)
+    th = max(1, int(np.ceil(new_h * h)))
+    tw = max(1, int(np.ceil(new_w * w)))
+    crop = img[oy:oy + th, ox:ox + tw]
+    out = np.clip(out, 0.0, 1.0).astype(np.float32)
+    return crop, out, np.ones(len(boxes), bool)
 
 
 def resize_square(img: np.ndarray, size: int) -> np.ndarray:

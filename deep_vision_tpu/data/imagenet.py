@@ -29,11 +29,13 @@ def load_synset_index(labels_file: str) -> dict[str, int]:
     """synset → class index, line order = index (reference :33-44)."""
     mapping: dict[str, int] = {}
     with open(labels_file) as f:
-        for idx, line in enumerate(f):
+        for line in f:
             line = line.strip()
             if not line:
-                continue
-            mapping[line.split(" ")[0]] = idx
+                continue  # blank lines don't consume an index
+            # split on ANY whitespace: the reference metadata file is
+            # tab-separated ("n01440764\ttench, Tinca tinca")
+            mapping[line.split()[0]] = len(mapping)
     return mapping
 
 

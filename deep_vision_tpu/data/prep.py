@@ -38,6 +38,66 @@ def _encode_labeled_file(item):
             f.read()
 
 
+def sanitize_image(payload: bytes) -> tuple[bytes, str]:
+    """Build-time image hardening → (clean JPEG bytes, status).
+
+    The reference handled ImageNet's dirty files with hard-coded filename
+    blacklists (PNG-as-.JPEG ``_is_png`` build_imagenet_tfrecord.py:272-283,
+    CMYK JPEGs ``_is_cmyk`` :286-309) re-encoded through a TF session
+    (``ImageCoder`` :236-270).  We detect by CONTENT instead of filename, so
+    any dirty file is caught, not just the 23 known ones:
+
+    - clean RGB JPEG → bytes pass through untouched (status ``ok``);
+    - PNG/CMYK/grayscale/palette/alpha → decoded + re-encoded as RGB JPEG
+      quality 100, matching the ImageCoder settings (status ``reencoded``);
+    - truncated-but-salvageable → partial decode re-encoded (``reencoded``);
+    - undecodable → status ``bad`` (caller drops the file so shards are
+      100% readable instead of throwing mid-epoch).
+    """
+    import io
+
+    from PIL import Image, ImageFile
+
+    try:
+        with Image.open(io.BytesIO(payload)) as im:
+            if im.format == "JPEG" and im.mode == "RGB":
+                im.load()  # full decode — catches truncation up front
+                return payload, "ok"
+    except Exception:
+        pass  # fall through to the salvage path
+    old = ImageFile.LOAD_TRUNCATED_IMAGES
+    ImageFile.LOAD_TRUNCATED_IMAGES = True
+    try:
+        with Image.open(io.BytesIO(payload)) as im:
+            rgb = im.convert("RGB")
+        buf = io.BytesIO()
+        rgb.save(buf, format="JPEG", quality=100)
+        return buf.getvalue(), "reencoded"
+    except Exception:
+        return b"", "bad"
+    finally:
+        ImageFile.LOAD_TRUNCATED_IMAGES = old
+
+
+def _encode_imagenet_item(item):
+    """(path, label, synset, human, bboxes) → (header, clean JPEG) or None
+    to drop an undecodable file (records._write_shard skips None)."""
+    path, label, synset, human, bboxes = item
+    with open(path, "rb") as f:
+        payload = f.read()
+    clean, status = sanitize_image(payload)
+    if status == "bad":
+        print(f"[prep] dropping undecodable image {path}", flush=True)
+        return None
+    header = {"label": int(label), "filename": os.path.basename(path),
+              "synset": synset, "human": human}
+    if bboxes:
+        header["bboxes"] = bboxes
+    if status == "reencoded":
+        header["reencoded"] = True
+    return header, clean
+
+
 def _encode_file(path):
     with open(path, "rb") as f:
         return {"filename": os.path.basename(path)}, f.read()
@@ -171,22 +231,136 @@ def prepare_mpii(annotation_json: str, image_dir: str, out_dir: str,
     return len(samples)
 
 
+def load_synset_humans(metadata_file: str) -> dict[str, str]:
+    """synset → human-readable label ("n01440764 → tench, Tinca tinca") —
+    the ``synset_to_human`` lookup of build_imagenet_tfrecord.py:472-689.
+    Accepts both tab- and space-separated metadata lines."""
+    out: dict[str, str] = {}
+    with open(metadata_file) as f:
+        for line in f:
+            parts = line.strip().split(None, 1)
+            if parts:
+                out[parts[0]] = parts[1] if len(parts) > 1 else ""
+    return out
+
+
+def load_bbox_csv(csv_path: str) -> dict[str, list[list[float]]]:
+    """bbox CSV (``process_imagenet_bboxes`` output / the reference's
+    process_bounding_boxes.py format) → filename → [[x1,y1,x2,y2], ...]."""
+    out: dict[str, list[list[float]]] = {}
+    with open(csv_path) as f:
+        for line in f:
+            parts = line.strip().split(",")
+            if len(parts) != 5:
+                continue
+            out.setdefault(parts[0], []).append(
+                [float(v) for v in parts[1:]])
+    return out
+
+
+def process_imagenet_bboxes(xml_dir: str, out_csv: str,
+                            synsets_file: str | None = None) -> dict:
+    """ImageNet bbox XML tree (``<xml_dir>/nXXXX/nXXXX_YYYY.xml``) → CSV of
+    ``<file>.JPEG,xmin,ymin,xmax,ymax`` relative coords — the
+    process_bounding_boxes.py:16-264 role.
+
+    Same data-noise rules as the reference: coords are normalized by the
+    annotator-displayed width/height stored in the XML, min/max swapped if
+    inverted, clamped to [0,1]; degenerate boxes (zero extent after
+    clamping) are skipped; with a synsets file, off-challenge XML dirs are
+    skipped, and a box label that differs from the directory synset is only
+    rejected when it IS a challenge synset (many dog boxes carry human
+    labels like 'Scottish_deerhound' instead of a synset id).
+    Returns counters {files, boxes, skipped_files, skipped_boxes}.
+    """
+    import glob as _glob
+
+    wanted = None
+    if synsets_file is not None:
+        with open(synsets_file) as f:
+            wanted = {line.strip() for line in f if line.strip()}
+    stats = {"files": 0, "boxes": 0, "skipped_files": 0, "skipped_boxes": 0}
+    with open(out_csv, "w") as out:
+        for xml_path in sorted(
+                _glob.glob(os.path.join(xml_dir, "*", "*.xml"))):
+            synset = os.path.basename(os.path.dirname(xml_path))
+            if wanted is not None and synset not in wanted:
+                stats["skipped_files"] += 1
+                continue
+            try:
+                root = ET.parse(xml_path).getroot()
+            except ET.ParseError:
+                stats["skipped_files"] += 1
+                continue
+            # the XML's <filename> is noisy (sometimes '%s'); the XML
+            # basename is authoritative, as in the reference
+            image_name = os.path.splitext(os.path.basename(xml_path))[0]
+            wrote = 0
+            for obj in root.iter("object"):
+                name = obj.findtext("name", "")
+                if (wanted is not None and name != synset
+                        and name in wanted):
+                    stats["skipped_boxes"] += 1
+                    continue
+                try:
+                    w = float(root.findtext(".//width"))
+                    h = float(root.findtext(".//height"))
+                    bb = obj.find("bndbox")
+                    xs = sorted((float(bb.findtext("xmin")) / w,
+                                 float(bb.findtext("xmax")) / w))
+                    ys = sorted((float(bb.findtext("ymin")) / h,
+                                 float(bb.findtext("ymax")) / h))
+                except (TypeError, ValueError, ZeroDivisionError):
+                    stats["skipped_boxes"] += 1
+                    continue
+                x1, x2 = (min(max(v, 0.0), 1.0) for v in xs)
+                y1, y2 = (min(max(v, 0.0), 1.0) for v in ys)
+                if x1 >= x2 or y1 >= y2:
+                    stats["skipped_boxes"] += 1
+                    continue
+                out.write(f"{image_name}.JPEG,{x1:.4f},{y1:.4f},"
+                          f"{x2:.4f},{y2:.4f}\n")
+                wrote += 1
+            if wrote:
+                stats["files"] += 1
+                stats["boxes"] += wrote
+            else:
+                stats["skipped_files"] += 1
+    return stats
+
+
 def prepare_imagenet(src_dir: str, labels_file: str, out_dir: str,
                      split: str = "train", num_shards: int = 64,
-                     num_workers: int = 8) -> int:
+                     num_workers: int = 8, bbox_csv: str | None = None) -> int:
     """Flattened synset-prefixed JPEG dir → classification dvrec shards
     (the 1024/128-shard layout of build_imagenet_tfrecord.py, scaled by
-    ``num_shards``)."""
-    from deep_vision_tpu.data.imagenet import load_synset_index
+    ``num_shards``).
 
-    label_map = load_synset_index(labels_file)
+    Every image is content-sanitized at build time (``sanitize_image`` —
+    the blacklist+ImageCoder role, :236-309): PNG-as-JPEG / CMYK /
+    truncated files are re-encoded, undecodable ones dropped, so shards
+    are 100% readable.  Headers carry synset + human label (:472-689) and,
+    with ``bbox_csv``, the image's bounding boxes."""
+    # one pass over the metadata file yields both lookups (synset→index by
+    # line order, synset→human by the rest of the line)
+    label_map: dict[str, int] = {}
+    humans = load_synset_humans(labels_file)
+    for idx, synset in enumerate(humans):
+        label_map[synset] = idx
+    boxes = load_bbox_csv(bbox_csv) if bbox_csv else {}
     files = sorted(f for f in os.listdir(src_dir)
                    if os.path.isfile(os.path.join(src_dir, f)))
-    items = [(os.path.join(src_dir, f), label_map[f.split("_")[0]])
-             for f in files]
-    R.write_sharded(items, out_dir, split, num_shards, _encode_labeled_file,
-                    num_workers)
-    return len(items)
+    items = []
+    for f in files:
+        synset = f.split("_")[0]
+        items.append((os.path.join(src_dir, f), label_map[synset], synset,
+                      humans.get(synset, ""), boxes.get(f, None)))
+    _, written = R.write_sharded(items, out_dir, split, num_shards,
+                                 _encode_imagenet_item, num_workers)
+    if written < len(items):
+        print(f"[prep] dropped {len(items) - written} undecodable file(s) "
+              f"of {len(items)}", flush=True)
+    return written
 
 
 def prepare_unpaired(dir_a: str, dir_b: str, out_dir: str,

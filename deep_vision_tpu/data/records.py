@@ -73,27 +73,36 @@ def list_shards(root: str, split: str) -> list[str]:
 
 def _write_shard(args):
     path, items, encode = args
+    n = 0
     with RecordWriter(path) as w:
         for item in items:
-            header, payload = encode(item)
+            enc = encode(item)
+            if enc is None:  # encoder dropped the item (e.g. corrupt image)
+                continue
+            header, payload = enc
             w.write(header, payload)
-    return path
+            n += 1
+    return path, n
 
 
 def write_sharded(items: Sequence, out_dir: str, split: str, num_shards: int,
-                  encode: Callable, num_workers: int = 8) -> list[str]:
+                  encode: Callable, num_workers: int = 8) -> tuple[list[str], int]:
     """Fan items out to ``num_shards`` files, ``num_workers`` processes —
-    the ray.remote/Coordinator role from the reference prep scripts."""
+    the ray.remote/Coordinator role from the reference prep scripts.
+    Returns (shard paths, records actually written) — the count can be
+    below ``len(items)`` when the encoder drops items."""
     os.makedirs(out_dir, exist_ok=True)
     chunks = [list(items[i::num_shards]) for i in range(num_shards)]
     jobs = [(shard_name(out_dir, split, i, num_shards), chunk, encode)
             for i, chunk in enumerate(chunks)]
     if num_workers <= 1:
-        return [_write_shard(j) for j in jobs]
-    import multiprocessing as mp
+        results = [_write_shard(j) for j in jobs]
+    else:
+        import multiprocessing as mp
 
-    with mp.get_context("fork").Pool(min(num_workers, num_shards)) as pool:
-        return pool.map(_write_shard, jobs)
+        with mp.get_context("fork").Pool(min(num_workers, num_shards)) as pool:
+            results = pool.map(_write_shard, jobs)
+    return [p for p, _ in results], sum(n for _, n in results)
 
 
 # ---------------------------------------------------------------------------

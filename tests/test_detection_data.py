@@ -23,14 +23,46 @@ def test_flip_boxes():
     np.testing.assert_allclose(flip_boxes_lr(f), b, atol=1e-6)
 
 
-def test_random_crop_keeps_centers():
+def test_random_crop_preserves_all_boxes():
+    """Reference semantics (YOLO/tensorflow/preprocess.py:52-119): the crop
+    margins are sampled between the hull of all boxes and the image edges,
+    so EVERY box survives in full and renormalized coords stay in [0,1]."""
     rng = np.random.default_rng(0)
-    img = np.zeros((100, 100, 3), np.uint8)
-    boxes = np.array([[0.4, 0.4, 0.6, 0.6]], np.float32)
-    for _ in range(10):
+    img = np.arange(100 * 100 * 3, dtype=np.uint8).reshape(100, 100, 3)
+    boxes = np.array([[0.4, 0.4, 0.6, 0.6],
+                      [0.1, 0.55, 0.3, 0.9]], np.float32)
+    for _ in range(50):
         crop, new_boxes, keep = random_crop_with_boxes(img, boxes, rng)
-        assert keep.sum() >= 1
+        assert keep.all() and len(new_boxes) == len(boxes)
         assert (new_boxes >= 0).all() and (new_boxes <= 1).all()
+        # widths/heights only grow in normalized coords (denominator < 1)
+        assert (new_boxes[:, 2] - new_boxes[:, 0]
+                >= boxes[:, 2] - boxes[:, 0] - 1e-6).all()
+        # crop is strictly within the original image
+        assert crop.shape[0] <= 100 and crop.shape[1] <= 100
+
+
+def test_random_crop_delta_formula():
+    """Pin the renormalization math: new = (old - lo) / (1 - lo - hi)."""
+
+    class FixedRng:
+        def __init__(self, vals):
+            self.vals = list(vals)
+
+        def uniform(self, lo, hi):
+            v = self.vals.pop(0)
+            assert lo <= v <= max(hi, lo + 1e-12), (v, lo, hi)
+            return v
+
+    img = np.zeros((200, 200, 3), np.uint8)
+    boxes = np.array([[0.2, 0.3, 0.8, 0.7]], np.float32)
+    # dx1=0.1, dy1=0.2, dx2=0.1, dy2=0.1
+    crop, nb, keep = random_crop_with_boxes(img, boxes,
+                                            FixedRng([0.1, 0.2, 0.1, 0.1]))
+    np.testing.assert_allclose(
+        nb[0], [(0.2 - 0.1) / 0.8, (0.3 - 0.2) / 0.7,
+                (0.8 - 0.1) / 0.8, (0.7 - 0.2) / 0.7], atol=1e-6)
+    assert crop.shape[:2] == (140, 160)  # ceil(0.7*200), ceil(0.8*200)
 
 
 def test_loader_static_shapes():

@@ -128,6 +128,111 @@ def test_prepare_imagenet_shards(tmp_path):
     assert sorted(labels_seen) == [0, 0, 1, 1, 1]
 
 
+def test_prepare_imagenet_dirty_dir(tmp_path):
+    """VERDICT r1 item 7: a dirty source dir (PNG-as-.JPEG, CMYK JPEG,
+    truncated JPEG, undecodable junk) must yield 100% READABLE shards —
+    the reference handled only 23 hard-coded blacklist files
+    (build_imagenet_tfrecord.py:272-309); we detect by content."""
+    import io
+
+    src = tmp_path / "flat"
+    src.mkdir()
+    rng = np.random.default_rng(0)
+    arr = rng.integers(0, 255, (32, 32, 3), dtype=np.uint8)
+    # 1 clean JPEG
+    Image.fromarray(arr).save(src / "n01440764_0.JPEG", format="JPEG")
+    # 1 PNG masquerading as .JPEG (the _is_png case)
+    Image.fromarray(arr).save(src / "n01440764_1.JPEG", format="PNG")
+    # 1 CMYK JPEG (the _is_cmyk case)
+    Image.fromarray(arr).convert("CMYK").save(src / "n01443537_0.JPEG",
+                                              format="JPEG")
+    # 1 mildly truncated JPEG (tail of the scan cut — salvageable)
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG")
+    (src / "n01443537_1.JPEG").write_bytes(
+        buf.getvalue()[:int(len(buf.getvalue()) * 0.9)])
+    # 1 undecodable junk file (severe corruption — dropped)
+    (src / "n01443537_2.JPEG").write_bytes(b"not an image at all")
+    labels = tmp_path / "meta.txt"
+    labels.write_text("n01440764\ttench, Tinca tinca\n"
+                      "n01443537\tgoldfish, Carassius auratus\n")
+    out = str(tmp_path / "recs")
+    prep.prepare_imagenet(str(src), str(labels), out, "train",
+                          num_shards=1, num_workers=1)
+    recs = [(h, p) for sh in list_shards(out, "train")
+            for h, p in read_records(sh)]
+    assert len(recs) == 4  # junk dropped, everything else kept
+    for h, payload in recs:
+        img = Image.open(io.BytesIO(payload))
+        img.load()  # every stored payload decodes fully
+        assert img.mode == "RGB" and img.format == "JPEG"
+        # synset → human-label metadata in every header (:472-689 role)
+        assert h["synset"] in ("n01440764", "n01443537")
+        assert "tench" in h["human"] or "goldfish" in h["human"]
+    reencoded = [h for h, _ in recs if h.get("reencoded")]
+    assert len(reencoded) == 3  # png + cmyk + truncated
+
+
+def test_process_imagenet_bboxes(tmp_path):
+    """The process_bounding_boxes.py:16-264 port: XML tree → relative CSV
+    with clamping, min/max swap, degenerate-box and synset filtering."""
+    xml_dir = tmp_path / "bbox"
+    (xml_dir / "n01440764").mkdir(parents=True)
+    (xml_dir / "n09999999").mkdir(parents=True)
+
+    def write_xml(path, objs, w=200, h=100):
+        body = "".join(
+            f"<object><name>{n}</name><bndbox><xmin>{x1}</xmin>"
+            f"<ymin>{y1}</ymin><xmax>{x2}</xmax><ymax>{y2}</ymax>"
+            f"</bndbox></object>" for n, x1, y1, x2, y2 in objs)
+        path.write_text(f"<annotation><filename>%s</filename>"
+                        f"<size><width>{w}</width><height>{h}</height>"
+                        f"</size>{body}</annotation>")
+
+    # normal box + inverted min/max + out-of-bounds (clamps) + degenerate
+    write_xml(xml_dir / "n01440764" / "n01440764_1.xml",
+              [("n01440764", 20, 10, 120, 80),
+               ("n01440764", 160, 90, 40, 20),     # inverted → swapped
+               ("n01440764", -50, -10, 400, 150),  # clamps to [0,1]
+               ("n01440764", 20, 10, 20, 80)])     # zero width → skipped
+    # human-label box (kept: 'Scottish_deerhound' is not a synset id)
+    # + off-synset challenge box (skipped)
+    write_xml(xml_dir / "n01440764" / "n01440764_2.xml",
+              [("Scottish_deerhound", 10, 10, 50, 50),
+               ("n01443537", 10, 10, 50, 50)])
+    # off-challenge synset dir (skipped entirely under synsets filter)
+    write_xml(xml_dir / "n09999999" / "n09999999_1.xml",
+              [("n09999999", 10, 10, 50, 50)])
+    synsets = tmp_path / "synsets.txt"
+    synsets.write_text("n01440764\nn01443537\n")
+    out_csv = tmp_path / "boxes.csv"
+    stats = prep.process_imagenet_bboxes(str(xml_dir), str(out_csv),
+                                         str(synsets))
+    assert stats["files"] == 2 and stats["skipped_files"] == 1
+    assert stats["boxes"] == 4 and stats["skipped_boxes"] == 2
+    rows = prep.load_bbox_csv(str(out_csv))
+    np.testing.assert_allclose(rows["n01440764_1.JPEG"][0],
+                               [0.1, 0.1, 0.6, 0.8], atol=1e-4)
+    np.testing.assert_allclose(rows["n01440764_1.JPEG"][1],
+                               [0.2, 0.2, 0.8, 0.9], atol=1e-4)
+    np.testing.assert_allclose(rows["n01440764_1.JPEG"][2],
+                               [0.0, 0.0, 1.0, 1.0], atol=1e-4)
+    assert len(rows["n01440764_2.JPEG"]) == 1
+
+    # bbox plumbing into record headers (build_imagenet_tfrecord.py:472-689)
+    src = tmp_path / "flat"
+    src.mkdir()
+    _save_jpg(src / "n01440764_1.JPEG", 32, 32)
+    labels = tmp_path / "meta.txt"
+    labels.write_text("n01440764 tench\n")
+    out = str(tmp_path / "recs")
+    prep.prepare_imagenet(str(src), str(labels), out, "train", num_shards=1,
+                          num_workers=1, bbox_csv=str(out_csv))
+    (h, _), = [(h, p) for sh in list_shards(out, "train")
+               for h, p in read_records(sh)]
+    assert len(h["bboxes"]) == 3
+
+
 def test_prepare_unpaired_and_celeba(tmp_path):
     da, db = tmp_path / "a", tmp_path / "b"
     da.mkdir(), db.mkdir()
