@@ -52,6 +52,19 @@ def main(argv=None):
     bboxes.add_argument("--synsets", default=None,
                         help="restrict to challenge synsets (one id/line)")
 
+    # raw download → flat loader layout (untar/flatten-script.sh roles)
+    ftrain = sub.add_parser("imagenet-flatten-train")
+    ftrain.add_argument("--src", required=True,
+                        help="dir of per-synset tars or subdirectories")
+    ftrain.add_argument("--dest", required=True)
+    fval = sub.add_parser("imagenet-flatten-val")
+    fval.add_argument("--src", required=True)
+    fval.add_argument("--dest", required=True)
+    fval.add_argument("--ground-truth", default=None,
+                      help="ILSVRC2012 validation ground-truth file "
+                           "(needed for the flat official layout)")
+    fval.add_argument("--synsets", default=None)
+
     unpaired = sub.add_parser("unpaired")
     unpaired.add_argument("--dir-a", required=True)
     unpaired.add_argument("--dir-b", required=True)
@@ -89,6 +102,14 @@ def main(argv=None):
         stats = prep.process_imagenet_bboxes(args.xml_dir, args.out_csv,
                                              args.synsets)
         print(f"prepared: {stats}")
+        return 0
+    elif args.cmd == "imagenet-flatten-train":
+        print(f"prepared: {prep.flatten_imagenet_train(args.src, args.dest)}")
+        return 0
+    elif args.cmd == "imagenet-flatten-val":
+        n = prep.flatten_imagenet_val(args.src, args.dest,
+                                      args.ground_truth, args.synsets)
+        print(f"prepared: {n}")
         return 0
     elif args.cmd == "unpaired":
         n = prep.prepare_unpaired(args.dir_a, args.dir_b, args.out,

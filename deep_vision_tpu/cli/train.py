@@ -36,9 +36,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--host-normalize", action="store_true",
                    help="float32 jitter+normalize on the HOST (reference "
                         "semantics) instead of fused device preprocessing")
+    p.add_argument("--tf-preprocessing", action="store_true",
+                   help="TF 'ResNet preprocessing' pipeline (aspect-"
+                        "preserving resize + mean subtraction, no jitter) "
+                        "instead of the cv2/torch one")
     p.add_argument("--upload", default=None,
                    help="sync checkpoints to this URI after each save "
                         "(path, file://, or gs://)")
+    p.add_argument("--pretrained", default=None,
+                   help="torch-format ResNet state_dict (.pth) to start "
+                        "from (the load_model_weights role); head kept "
+                        "only when num_classes matches")
     p.add_argument("--profile", action="store_true",
                    help="jax.profiler trace of steps 10-20 → workdir/profile")
     p.add_argument("--list", action="store_true", help="list configs and exit")
@@ -127,16 +135,21 @@ def main(argv=None):
         resize = max(cfg.image_size * 256 // 224, cfg.image_size + 8)
         # uint8 host pipeline + device-side jitter/normalize (fused into
         # the jit step): 4× less H2D, ~30% less host CPU per image
-        dev_norm = not args.host_normalize
+        if args.tf_preprocessing and args.host_normalize:
+            raise SystemExit("--tf-preprocessing and --host-normalize pick "
+                             "contradictory pipelines; pass only one")
+        preprocessing = "tf" if args.tf_preprocessing else "torch"
+        dev_norm = not args.host_normalize and preprocessing == "torch"
         train_loader = ImageNetLoader(
             os.path.join(args.data_root, "train"), labels, cfg.batch_size,
             train=True, image_size=cfg.image_size, resize=resize,
             num_workers=args.num_workers, seed=cfg.seed,
-            device_normalize=dev_norm)
+            device_normalize=dev_norm, preprocessing=preprocessing)
         val_loader = ImageNetLoader(
             os.path.join(args.data_root, "val"), labels, cfg.eval_batch_size,
             train=False, image_size=cfg.image_size, resize=resize,
-            num_workers=args.num_workers, device_normalize=dev_norm)
+            num_workers=args.num_workers, device_normalize=dev_norm,
+            preprocessing=preprocessing)
         if dev_norm:
             from deep_vision_tpu.ops.preprocess import make_imagenet_preprocess
 
@@ -146,10 +159,44 @@ def main(argv=None):
                       preprocess_fn=preprocess_fn, upload=args.upload)
     if args.profile:
         trainer.profile_steps = (10, 20)
-    state = trainer.fit(train_loader, val_loader, resume=args.resume)
+    state = None
+    if args.pretrained:
+        state = _load_pretrained_state(args, cfg, trainer, train_loader)
+    state = trainer.fit(train_loader, val_loader, state=state,
+                        resume=args.resume)
     final = trainer.evaluate(state, val_loader)
     print("final:", " ".join(f"{k}={v:.4f}" for k, v in final.items()))
     return 0
+
+
+def _load_pretrained_state(args, cfg, trainer, train_loader):
+    """Initialize, overlay a torch-format checkpoint, re-place on mesh —
+    the reference's pretrained start (resnet50v2.py:137-153)."""
+    import jax
+
+    from deep_vision_tpu.models.pretrained import (
+        STAGE_SIZES,
+        load_torch_checkpoint,
+        merge_pretrained,
+    )
+    from deep_vision_tpu.parallel import replicate
+
+    if args.model not in STAGE_SIZES:
+        raise SystemExit(
+            f"--pretrained supports {sorted(STAGE_SIZES)} (torch-format "
+            f"V1 checkpoints); '{args.model}' has a different param tree")
+    state = trainer.init_state(next(iter(train_loader)))
+    arch = args.model
+    include_fc = cfg.num_classes == 1000  # ImageNet head transfers as-is
+    imported = load_torch_checkpoint(args.pretrained, arch, include_fc)
+    merged = merge_pretrained(
+        {"params": jax.device_get(state.params),
+         "batch_stats": jax.device_get(state.batch_stats)}, imported)
+    print(f"[pretrained] loaded {arch} weights from {args.pretrained} "
+          f"(head {'kept' if include_fc else 'fresh'})")
+    return replicate(
+        state.replace(params=merged["params"],
+                      batch_stats=merged["batch_stats"]), trainer.mesh)
 
 
 def _main_detection(args, cfg, mesh):

@@ -86,6 +86,16 @@ def _load_one(cfg: dict, i: int, seed: int) -> tuple[np.ndarray, np.int32]:
     draft = cfg["resize"] if cfg.get("device_normalize") else None
     img = _decode(os.path.join(cfg["root_dir"], cfg["files"][i]),
                   draft_size=draft)
+    if cfg.get("preprocessing") == "tf":
+        # TF "ResNet preprocessing" variant (mean-centered 0-255 floats) —
+        # host-only, incompatible with the device-normalize split
+        if cfg["train"]:
+            rng = np.random.default_rng(seed)
+            x = T.tf_train_transform(img, rng, cfg["image_size"],
+                                     cfg["resize"])
+        else:
+            x = T.tf_eval_transform(img, cfg["image_size"], cfg["resize"])
+        return x, cfg["labels"][i]
     if cfg.get("device_normalize"):
         # uint8 host path: decode+rescale+crop only; jitter+normalize run
         # inside the jitted step (ops/preprocess.py) — 4× smaller H2D
@@ -121,8 +131,17 @@ class ImageNetLoader:
                  process_index: int | None = None,
                  process_count: int | None = None,
                  prefetch_batches: int = 2,
-                 device_normalize: bool = False):
+                 device_normalize: bool = False,
+                 preprocessing: str = "torch"):
         import jax
+
+        if preprocessing not in ("torch", "tf"):
+            raise ValueError(f"preprocessing must be torch|tf, "
+                             f"got {preprocessing!r}")
+        if preprocessing == "tf" and device_normalize:
+            raise ValueError("tf preprocessing is host-side only "
+                             "(mean-centered 0-255 floats); disable "
+                             "device_normalize")
 
         self.ds = ImageNetFolder(root_dir, labels_file)
         pi = jax.process_index() if process_index is None else process_index
@@ -139,7 +158,8 @@ class ImageNetLoader:
         self._cfg = dict(root_dir=self.ds.root_dir, files=self.ds.files,
                          labels=self.ds.labels, train=train,
                          image_size=image_size, resize=resize,
-                         device_normalize=device_normalize)
+                         device_normalize=device_normalize,
+                         preprocessing=preprocessing)
         self._pool = None
         # create the pool EAGERLY on the main thread. forkserver (spawn as
         # fallback) — NOT fork: by loader-construction time the JAX runtime

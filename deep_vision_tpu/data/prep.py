@@ -363,6 +363,112 @@ def prepare_imagenet(src_dir: str, labels_file: str, out_dir: str,
     return written
 
 
+def flatten_imagenet_train(train_dir: str, out_dir: str,
+                           link: bool = True) -> int:
+    """Raw ILSVRC2012 train layout → the flat ``synset_imagename.JPEG``
+    dir the loaders expect — the untar-script.sh + flatten-script.sh role.
+
+    Handles both raw layouts: per-synset tars (``nXXXX.tar`` as extracted
+    from ILSVRC2012_img_train.tar) and per-synset subdirectories.  Files
+    inside train tars are already named ``nXXXX_YYYY.JPEG`` so flattening
+    is a move/link, not a rename.  ``link=True`` hardlinks (falls back to
+    copy across filesystems) instead of the reference's 150 GB ``cp``."""
+    import shutil
+    import tarfile
+
+    os.makedirs(out_dir, exist_ok=True)
+    n = 0
+    for entry in sorted(os.listdir(train_dir)):
+        full = os.path.join(train_dir, entry)
+        if entry.endswith(".tar"):
+            with tarfile.open(full) as tf:
+                for member in tf:
+                    if not member.isfile():
+                        continue
+                    if os.path.exists(os.path.join(
+                            out_dir, os.path.basename(member.name))):
+                        continue  # idempotent re-runs, like the dir branch
+                    tf.extract(member, out_dir, filter="data")
+                    n += 1
+        elif os.path.isdir(full):
+            for f in sorted(os.listdir(full)):
+                dst = os.path.join(out_dir, f)
+                if os.path.exists(dst):
+                    continue
+                if link:
+                    try:
+                        os.link(os.path.join(full, f), dst)
+                    except OSError:
+                        shutil.copy2(os.path.join(full, f), dst)
+                else:
+                    shutil.copy2(os.path.join(full, f), dst)
+                n += 1
+    return n
+
+
+def flatten_imagenet_val(val_dir: str, out_dir: str,
+                         ground_truth: str | None = None,
+                         synsets_file: str | None = None,
+                         link: bool = True) -> int:
+    """Raw val layout → flat ``synset_ILSVRC2012_val_XXXX.JPEG`` dir —
+    the flatten-val-script.sh role.
+
+    Two raw layouts:
+    - per-synset subdirectories (the reference script's input): flatten
+      with ``<dirname>_<filename>`` naming;
+    - the flat official tar output (``ILSVRC2012_val_00000001.JPEG`` ...)
+      plus the 50k-line ground-truth file (1-based ILSVRC2012 label ids)
+      and the synsets list mapping id→synset: prefix each file with its
+      synset."""
+    import shutil
+
+    os.makedirs(out_dir, exist_ok=True)
+
+    def place(src, name):
+        dst = os.path.join(out_dir, name)
+        if os.path.exists(dst):
+            return
+        if link:
+            try:
+                os.link(src, dst)
+                return
+            except OSError:
+                pass
+        shutil.copy2(src, dst)
+
+    entries = sorted(os.listdir(val_dir))
+    subdirs = [e for e in entries
+               if os.path.isdir(os.path.join(val_dir, e))]
+    n = 0
+    if subdirs:
+        for d in subdirs:
+            for f in sorted(os.listdir(os.path.join(val_dir, d))):
+                place(os.path.join(val_dir, d, f), f"{d}_{f}")
+                n += 1
+        return n
+    if not (ground_truth and synsets_file):
+        raise ValueError(
+            "flat val dir needs --ground-truth (ILSVRC2012 validation "
+            "ground truth) and --synsets (id→synset order) to label files")
+    with open(synsets_file) as f:
+        synsets = [line.strip() for line in f if line.strip()]
+    with open(ground_truth) as f:
+        labels = [int(line) for line in f if line.strip()]
+    files = [e for e in entries if e.upper().endswith((".JPEG", ".JPG"))]
+    if len(files) != len(labels):
+        raise ValueError(f"{len(files)} val images vs {len(labels)} "
+                         f"ground-truth lines")
+    bad = [l for l in labels if not 1 <= l <= len(synsets)]
+    if bad:
+        raise ValueError(
+            f"ground-truth labels must be 1..{len(synsets)} (ILSVRC ids "
+            f"are 1-based); got e.g. {bad[0]} — is the file 0-based?")
+    for f, lab in zip(files, labels):
+        place(os.path.join(val_dir, f), f"{synsets[lab - 1]}_{f}")
+        n += 1
+    return n
+
+
 def prepare_unpaired(dir_a: str, dir_b: str, out_dir: str,
                      split: str = "train", num_shards: int = 4,
                      num_workers: int = 4) -> tuple[int, int]:

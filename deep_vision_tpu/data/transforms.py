@@ -114,6 +114,34 @@ def eval_transform(img: np.ndarray, size: int = 224, resize: int = 256
     return normalize(img)
 
 
+# -- the TF "ResNet preprocessing" variant (ResNet/tensorflow/data_load.py) --
+# channel means in RAW 0-255 space (:35-38); this pipeline subtracts means
+# but does NOT divide by 255 or std — models trained with it expect
+# mean-centered 0-255-range inputs
+
+TF_CHANNEL_MEANS = np.array([123.68, 116.78, 103.94], np.float32)
+
+
+def tf_train_transform(img: np.ndarray, rng: np.random.Generator,
+                       size: int = 224, resize: int = 256) -> np.ndarray:
+    """TF train path (:158-193): aspect-preserving resize(256) → random
+    crop(224) → random flip → mean subtraction.  (Crop comes BEFORE flip
+    here, unlike the cv2/torch pipeline; no color jitter.)"""
+    img = rescale(img, resize)
+    img = random_crop(img, size, rng)
+    img = random_horizontal_flip(img, rng)
+    return img.astype(np.float32) - TF_CHANNEL_MEANS
+
+
+def tf_eval_transform(img: np.ndarray, size: int = 224, resize: int = 256
+                      ) -> np.ndarray:
+    """TF eval path: aspect-preserving resize → central crop (:46-63) →
+    mean subtraction (:66-92)."""
+    img = rescale(img, resize)
+    img = center_crop(img, size)
+    return img.astype(np.float32) - TF_CHANNEL_MEANS
+
+
 def train_transform_u8(img: np.ndarray, rng: np.random.Generator,
                        size: int = 224, resize: int = 256) -> np.ndarray:
     """Host half of the device-preprocess split: Rescale → flip → RandomCrop,

@@ -1,0 +1,148 @@
+"""Pretrained-weight import: torch ResNet checkpoints → flax variables.
+
+The reference downloads Keras ImageNet weights for its TF ResNet-50 V2
+(ResNet/tensorflow/models/resnet50v2.py:137-153 ``load_model_weights``).
+The TPU-native equivalent imports the de-facto standard checkpoint format
+for these architectures — a torchvision-style ``state_dict``
+(``conv1/bn1/layer{1..4}.{i}.conv{j}/bn{j}/downsample/fc``) — into the
+flax ``ResNet`` pytree, so ``models.resnet.ResNet50`` can start from
+published ImageNet weights instead of scratch.
+
+Layout mapping (torch → flax):
+- conv weight ``(O, I, kH, kW)`` → kernel ``(kH, kW, I, O)``
+- fc weight ``(O, I)`` → Dense kernel ``(I, O)``
+- bn ``weight/bias`` → BatchNorm ``scale/bias`` (params);
+  ``running_mean/running_var`` → ``mean/var`` (batch_stats)
+- torchvision block j of stage s → ``{Basic,Bottleneck}Block_k`` with k
+  counting blocks across stages in call order (flax auto-naming).
+
+Note: stride placement follows torchvision's "V1.5" convention (stride on
+the 3×3 conv), which both this package's ``BottleneckBlock`` and every
+published torchvision checkpoint use.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Sequence
+
+import numpy as np
+
+STAGE_SIZES = {
+    "resnet34": (3, 4, 6, 3),
+    "resnet50": (3, 4, 6, 3),
+    "resnet152": (3, 8, 36, 3),
+}
+BLOCK_NAME = {
+    "resnet34": "BasicBlock",
+    "resnet50": "BottleneckBlock",
+    "resnet152": "BottleneckBlock",
+}
+CONVS_PER_BLOCK = {"BasicBlock": 2, "BottleneckBlock": 3}
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor or array-like → numpy (no torch import needed)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _conv(t) -> np.ndarray:
+    return _np(t).transpose(2, 3, 1, 0)  # (O,I,H,W) → (H,W,I,O)
+
+
+def import_torch_resnet(state_dict: Mapping, arch: str = "resnet50",
+                        include_fc: bool = True) -> dict:
+    """torchvision-style ``state_dict`` → ``{"params": ..., "batch_stats":
+    ...}`` for :class:`deep_vision_tpu.models.resnet.ResNet`.
+
+    ``include_fc=False`` drops the classifier head (fine-tuning on a
+    different class count; init the head fresh and merge).
+    Raises ``KeyError`` with the missing torch key if the checkpoint
+    doesn't match the architecture.
+    """
+    if arch not in STAGE_SIZES:
+        raise ValueError(f"unknown arch '{arch}'; have {sorted(STAGE_SIZES)}")
+    sd = state_dict
+    block = BLOCK_NAME[arch]
+    n_convs = CONVS_PER_BLOCK[block]
+    params: dict = {"Conv_0": {"kernel": _conv(sd["conv1.weight"])}}
+    stats: dict = {}
+
+    def bn(torch_prefix: str, flax_parent: dict, stats_parent: dict,
+           flax_name: str):
+        flax_parent[flax_name] = {
+            "scale": _np(sd[f"{torch_prefix}.weight"]),
+            "bias": _np(sd[f"{torch_prefix}.bias"]),
+        }
+        stats_parent[flax_name] = {
+            "mean": _np(sd[f"{torch_prefix}.running_mean"]),
+            "var": _np(sd[f"{torch_prefix}.running_var"]),
+        }
+
+    bn("bn1", params, stats, "BatchNorm_0")
+
+    k = 0  # flax block index, counted across stages
+    for stage, num_blocks in enumerate(STAGE_SIZES[arch], start=1):
+        for i in range(num_blocks):
+            t = f"layer{stage}.{i}"
+            name = f"{block}_{k}"
+            p: dict = {}
+            s: dict = {}
+            for j in range(n_convs):
+                p[f"Conv_{j}"] = {
+                    "kernel": _conv(sd[f"{t}.conv{j + 1}.weight"])}
+                bn(f"{t}.bn{j + 1}", p, s, f"BatchNorm_{j}")
+            if f"{t}.downsample.0.weight" in sd:
+                p[f"Conv_{n_convs}"] = {
+                    "kernel": _conv(sd[f"{t}.downsample.0.weight"])}
+                bn(f"{t}.downsample.1", p, s, f"BatchNorm_{n_convs}")
+            params[name] = p
+            stats[name] = s
+            k += 1
+
+    if include_fc:
+        params["Dense_0"] = {"kernel": _np(sd["fc.weight"]).T,
+                             "bias": _np(sd["fc.bias"])}
+    return {"params": params, "batch_stats": stats}
+
+
+def load_torch_checkpoint(path: str, arch: str = "resnet50",
+                          include_fc: bool = True) -> dict:
+    """Load a ``.pth``/``.pt`` state_dict from disk and convert.  Accepts
+    both a bare state_dict and the common ``{"state_dict": ...}`` wrapper
+    (with optional ``module.`` DataParallel prefixes)."""
+    import torch
+
+    obj = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(obj, dict) and "state_dict" in obj:
+        obj = obj["state_dict"]
+    obj = {k.removeprefix("module."): v for k, v in obj.items()}
+    return import_torch_resnet(obj, arch, include_fc)
+
+
+def merge_pretrained(variables: dict, imported: dict,
+                     include_fc: bool = True) -> dict:
+    """Overlay imported weights onto freshly-initialized ``variables``
+    (validates tree/shape agreement leaf by leaf)."""
+    import jax
+
+    def overlay(fresh, new):
+        if not isinstance(new, dict):
+            fresh_arr = np.asarray(fresh)
+            new_arr = np.asarray(new)
+            if fresh_arr.shape != new_arr.shape:
+                raise ValueError(
+                    f"shape mismatch: checkpoint {new_arr.shape} vs model "
+                    f"{fresh_arr.shape}")
+            return new_arr.astype(fresh_arr.dtype)
+        out = dict(fresh)
+        for k, v in new.items():
+            if k not in fresh:
+                raise KeyError(f"checkpoint key '{k}' not in model")
+            out[k] = overlay(fresh[k], v)
+        return out
+
+    merged = {col: overlay(variables[col], imported.get(col, {}))
+              for col in variables}
+    return jax.tree_util.tree_map(np.asarray, merged)

@@ -24,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
+SPATIAL_AXIS = "spatial"  # image-row (context) axis — see parallel/spatial.py
 
 
 def make_mesh(
@@ -76,8 +77,16 @@ def shard_batch(tree: Any, mesh: Mesh) -> Any:
     The global batch size must be divisible by the ``data`` axis size —
     the same contract MirroredStrategy enforced with
     ``global_batch = replicas * per_replica`` (YOLO/tensorflow/train.py:282).
+
+    On a mesh with a ``spatial`` axis, image-like leaves (ndim ≥ 4, H
+    divisible) additionally shard dim 1 (rows) over it — GSPMD then
+    spatially partitions the convolutions downstream, inserting the halo
+    collective-permutes itself, so activations larger than one chip's HBM
+    train with NO model changes (the Trainer-reachable counterpart of the
+    explicit shard_map kernel in parallel/spatial.py).
     """
     n_data = mesh.shape[DATA_AXIS]
+    n_spatial = mesh.shape.get(SPATIAL_AXIS, 1)
 
     def _put(x):
         if isinstance(x, jax.Array):  # already placed (e.g. prefetch thread)
@@ -89,6 +98,9 @@ def shard_batch(tree: Any, mesh: Mesh) -> Any:
             raise ValueError(
                 f"batch dim {x.shape[0]} not divisible by data axis {n_data}"
             )
-        return jax.device_put(x, batch_sharding(mesh))
+        spec = [DATA_AXIS] + [None] * (x.ndim - 1)
+        if n_spatial > 1 and x.ndim >= 4 and x.shape[1] % n_spatial == 0:
+            spec[1] = SPATIAL_AXIS  # rows over the spatial axis
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
 
     return jax.tree_util.tree_map(_put, tree)

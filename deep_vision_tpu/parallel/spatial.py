@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-SPATIAL_AXIS = "spatial"
+from deep_vision_tpu.parallel.mesh import SPATIAL_AXIS  # single source
 
 
 def halo_exchange(x, halo: int, axis_name: str = SPATIAL_AXIS):

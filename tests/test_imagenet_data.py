@@ -203,3 +203,43 @@ def test_prefetch_propagates_producer_errors():
     next(it)
     with _pytest.raises(RuntimeError, match="decode failed"):
         next(it)
+
+
+def test_tf_preprocessing_semantics():
+    """TF 'ResNet preprocessing' variant (ResNet/tensorflow/data_load.py):
+    aspect-preserving resize, central crop, and mean subtraction in RAW
+    0-255 space with NO std scaling."""
+    rng = np.random.default_rng(3)
+    img = rng.integers(0, 255, size=(100, 200, 3), dtype=np.uint8)
+    out = T.tf_eval_transform(img, size=64, resize=96)
+    assert out.shape == (64, 64, 3) and out.dtype == np.float32
+    # exact mean subtraction: central crop of the resized image minus means
+    resized = T.rescale(img, 96)
+    assert resized.shape[0] == 96  # smaller side pinned, aspect kept
+    assert resized.shape[1] == 192
+    expect = T.center_crop(resized, 64).astype(np.float32) - T.TF_CHANNEL_MEANS
+    np.testing.assert_allclose(out, expect, atol=1e-5)
+    # train path: right shape/range, varies with rng
+    a = T.tf_train_transform(img, np.random.default_rng(0), 64, 96)
+    b = T.tf_train_transform(img, np.random.default_rng(7), 64, 96)
+    assert a.shape == (64, 64, 3)
+    assert a.min() >= -T.TF_CHANNEL_MEANS.max() - 1e-3
+    assert a.max() <= 255.0
+    assert not np.allclose(a, b)
+
+
+def test_loader_tf_preprocessing(fake_imagenet):
+    root, labels = fake_imagenet
+    loader = ImageNetLoader(root, labels, batch_size=4, train=False,
+                            image_size=32, resize=40, num_workers=0,
+                            process_index=0, process_count=1,
+                            preprocessing="tf")
+    batch = next(iter(loader))
+    x = batch["image"]
+    assert x.shape == (4, 32, 32, 3) and x.dtype == np.float32
+    # mean-centered raw-range values, NOT [0,1]-normalized
+    assert x.min() < -50 and x.max() > 50
+    with pytest.raises(ValueError, match="host-side only"):
+        ImageNetLoader(root, labels, 4, num_workers=0, process_index=0,
+                       process_count=1, preprocessing="tf",
+                       device_normalize=True)

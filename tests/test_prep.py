@@ -233,6 +233,56 @@ def test_process_imagenet_bboxes(tmp_path):
     assert len(h["bboxes"]) == 3
 
 
+def test_flatten_imagenet_train_and_val(tmp_path):
+    """Raw-layout bootstrap (the untar/flatten-script.sh role): per-synset
+    tars/dirs → flat synset-prefixed train dir; flat official val + ground
+    truth → synset-prefixed val dir."""
+    import tarfile
+
+    # train: one synset as a tar, one as a directory
+    raw = tmp_path / "raw_train"
+    raw.mkdir()
+    syn_dir = raw / "n01443537"
+    syn_dir.mkdir()
+    _save_jpg(syn_dir / "n01443537_0.JPEG", 16, 16)
+    tar_src = tmp_path / "tarsrc"
+    tar_src.mkdir()
+    _save_jpg(tar_src / "n01440764_0.JPEG", 16, 16)
+    _save_jpg(tar_src / "n01440764_1.JPEG", 16, 16)
+    with tarfile.open(raw / "n01440764.tar", "w") as tf:
+        for f in sorted(tar_src.iterdir()):
+            tf.add(f, arcname=f.name)
+    flat = tmp_path / "train_flat"
+    n = prep.flatten_imagenet_train(str(raw), str(flat))
+    assert n == 3
+    assert sorted(os.listdir(flat)) == [
+        "n01440764_0.JPEG", "n01440764_1.JPEG", "n01443537_0.JPEG"]
+
+    # val: flat official naming + 1-based ground truth
+    raw_val = tmp_path / "raw_val"
+    raw_val.mkdir()
+    _save_jpg(raw_val / "ILSVRC2012_val_00000001.JPEG", 16, 16)
+    _save_jpg(raw_val / "ILSVRC2012_val_00000002.JPEG", 16, 16)
+    (tmp_path / "synsets.txt").write_text("n01440764\nn01443537\n")
+    (tmp_path / "gt.txt").write_text("2\n1\n")
+    flat_val = tmp_path / "val_flat"
+    n = prep.flatten_imagenet_val(str(raw_val), str(flat_val),
+                                  str(tmp_path / "gt.txt"),
+                                  str(tmp_path / "synsets.txt"))
+    assert n == 2
+    assert sorted(os.listdir(flat_val)) == [
+        "n01440764_ILSVRC2012_val_00000002.JPEG",
+        "n01443537_ILSVRC2012_val_00000001.JPEG"]
+
+    # val: per-synset-dir layout needs no ground truth
+    raw_val2 = tmp_path / "raw_val2"
+    (raw_val2 / "n01440764").mkdir(parents=True)
+    _save_jpg(raw_val2 / "n01440764" / "x.JPEG", 16, 16)
+    flat_val2 = tmp_path / "val_flat2"
+    assert prep.flatten_imagenet_val(str(raw_val2), str(flat_val2)) == 1
+    assert os.listdir(flat_val2) == ["n01440764_x.JPEG"]
+
+
 def test_prepare_unpaired_and_celeba(tmp_path):
     da, db = tmp_path / "a", tmp_path / "b"
     da.mkdir(), db.mkdir()
