@@ -19,6 +19,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-m", "--model", required=True,
                    help="config name (see --list)")
     p.add_argument("--data-root", default=None, help="dataset directory")
+    p.add_argument("--data-format", choices=("folder", "records"),
+                   default="folder",
+                   help="classification input: flat image dir (folder) or "
+                        "prepare_data imagenet dvrec shards (records)")
     p.add_argument("--synthetic", action="store_true",
                    help="synthetic data smoke run (no dataset needed)")
     p.add_argument("--synthetic-size", type=int, default=1024)
@@ -140,16 +144,24 @@ def main(argv=None):
                              "contradictory pipelines; pass only one")
         preprocessing = "tf" if args.tf_preprocessing else "torch"
         dev_norm = not args.host_normalize and preprocessing == "torch"
-        train_loader = ImageNetLoader(
-            os.path.join(args.data_root, "train"), labels, cfg.batch_size,
-            train=True, image_size=cfg.image_size, resize=resize,
-            num_workers=args.num_workers, seed=cfg.seed,
-            device_normalize=dev_norm, preprocessing=preprocessing)
-        val_loader = ImageNetLoader(
-            os.path.join(args.data_root, "val"), labels, cfg.eval_batch_size,
-            train=False, image_size=cfg.image_size, resize=resize,
-            num_workers=args.num_workers, device_normalize=dev_norm,
-            preprocessing=preprocessing)
+        common = dict(image_size=cfg.image_size, resize=resize,
+                      num_workers=args.num_workers,
+                      device_normalize=dev_norm, preprocessing=preprocessing)
+        if args.data_format == "records":
+            # dvrec shard consumption (the reference's TFRecord trainer path)
+            train_loader = ImageNetLoader.from_records(
+                args.data_root, "train", cfg.batch_size, train=True,
+                seed=cfg.seed, **common)
+            val_loader = ImageNetLoader.from_records(
+                args.data_root, "val", cfg.eval_batch_size, train=False,
+                **common)
+        else:
+            train_loader = ImageNetLoader(
+                os.path.join(args.data_root, "train"), labels,
+                cfg.batch_size, train=True, seed=cfg.seed, **common)
+            val_loader = ImageNetLoader(
+                os.path.join(args.data_root, "val"), labels,
+                cfg.eval_batch_size, train=False, **common)
         if dev_norm:
             from deep_vision_tpu.ops.preprocess import make_imagenet_preprocess
 

@@ -52,6 +52,7 @@ class AdversarialTrainer:
         self.start_epoch = 1
         self.start_step = 0
         self.guard = DivergenceGuard(config.max_bad_steps)
+        self._preempted = False  # SIGTERM → step-boundary save + return
 
     def init_states(self, sample_batch: dict) -> dict:
         states = self.task.init_states(
@@ -104,6 +105,19 @@ class AdversarialTrainer:
             states = self.maybe_resume(states)
         rng = jax.random.PRNGKey(cfg.seed + 17)
         step = self.start_step  # continues past-resume step numbering
+        from deep_vision_tpu.core.trainer import install_sigterm_flag
+
+        self._preempted = False  # stale flag must not abort a fresh fit()
+        restore = install_sigterm_flag(
+            lambda: setattr(self, "_preempted", True))
+        try:
+            return self._fit_epochs(train_data, epochs, states, rng, step,
+                                    sample_hook)
+        finally:
+            restore()
+
+    def _fit_epochs(self, train_data, epochs, states, rng, step, sample_hook):
+        cfg = self.config
         for epoch in range(self.start_epoch, epochs + 1):
             lr = self.scheduler.epoch_begin(epoch)
             states = {k: v.replace(
@@ -131,6 +145,19 @@ class AdversarialTrainer:
                     print(f"Epoch {epoch} Step {step} "
                           + " ".join(f"{k}={v:.4f}" for k, v in m.items())
                           + f" {meter.images_per_sec:.1f} img/s", flush=True)
+                if self._preempted:
+                    self.checkpointer.save_tree(
+                        step, states,
+                        extras={"epoch": epoch - 1,
+                                "scheduler": self.scheduler.state_dict()})
+                    if self.uploader is not None:
+                        # the VM disappears seconds after SIGTERM — the
+                        # preempt save is the one that MUST reach off-host
+                        self.uploader.sync(self.checkpointer.directory,
+                                           "checkpoints")
+                    print(f"[preempt] checkpoint saved at step {step}; "
+                          f"rerun with --resume to continue", flush=True)
+                    return states
             self.scheduler.step(epoch, None)
             print(f"Epoch {epoch} done in {time.time() - t0:.1f}s", flush=True)
             if epoch % cfg.checkpoint_every_epochs == 0:

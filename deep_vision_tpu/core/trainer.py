@@ -42,6 +42,20 @@ from deep_vision_tpu.core.state import DivergenceGuard, TrainState
 from deep_vision_tpu.parallel import make_mesh, replicate, shard_batch
 
 
+def install_sigterm_flag(on_sigterm):
+    """Install a SIGTERM → callback handler; returns a restore function.
+    Safe when not on the main thread (no-op) and when the previous handler
+    was installed outside Python (restores SIG_DFL, not None)."""
+    import signal
+
+    try:
+        prev = signal.signal(signal.SIGTERM, lambda *_: on_sigterm())
+    except ValueError:  # not the main thread: no handler, no-op restore
+        return lambda: None
+    restore_to = prev if prev is not None else signal.SIG_DFL
+    return lambda: signal.signal(signal.SIGTERM, restore_to)
+
+
 class Trainer:
     """Single-model/single-optimizer trainer (classification, detection,
     pose).  Adversarial multi-model training lives in
@@ -81,6 +95,11 @@ class Trainer:
         self._jit_eval_step = None
         self.start_epoch = 1
         self.guard = DivergenceGuard(config.max_bad_steps)
+        # preemption safety: TPU VMs get SIGTERM before eviction; fit()
+        # installs a handler that requests a step-boundary checkpoint +
+        # clean return so a preempted run loses at most one step, not an
+        # epoch (the reference could only resume from its last epoch save)
+        self._preempted = False
         # profiling: trace steps [start, stop) of epoch 1 to
         # workdir/profile (the reference had only throughput prints —
         # SURVEY §5 tracing; TPU-native answer is a jax.profiler trace)
@@ -261,6 +280,10 @@ class Trainer:
                       f"lr {self.scheduler.lr:.2e} "
                       f"{meter.images_per_sec:.1f} img/s", flush=True)
             pending = metrics
+            if self._preempted:
+                print("[preempt] SIGTERM — stopping at step boundary",
+                      flush=True)
+                break
         if trace_active:
             # epoch ended inside the trace window: flush what we have
             jax.profiler.stop_trace()
@@ -286,6 +309,20 @@ class Trainer:
             state = self.maybe_resume(state)
         monitor = monitor or getattr(self.task, "monitor", None)
         best = None
+        restore_handler = self._install_preempt_handler()
+        try:
+            return self._fit_epochs(train_data, val_data, state, monitor,
+                                    best)
+        finally:
+            restore_handler()
+
+    def _install_preempt_handler(self):
+        self._preempted = False  # stale flag must not abort a fresh fit()
+        return install_sigterm_flag(
+            lambda: setattr(self, "_preempted", True))
+
+    def _fit_epochs(self, train_data, val_data, state, monitor, best):
+        cfg = self.config
         for epoch in range(self.start_epoch, cfg.total_epochs + 1):
             # LR for THIS epoch (so warmup covers epoch 1); plateau-style
             # metric schedules adjust in scheduler.step() after validation.
@@ -296,6 +333,14 @@ class Trainer:
                 train_data.set_epoch(epoch)
             t0 = time.time()
             state = self.train_epoch(state, train_data, epoch)
+            if self._preempted:
+                # mid-epoch save as epoch-1: resume re-runs this epoch
+                # from its start but keeps every applied step/param update
+                self.save(state, epoch - 1)
+                print(f"[preempt] checkpoint saved at step "
+                      f"{int(jax.device_get(state.step))}; rerun with "
+                      f"--resume to continue", flush=True)
+                return state
             metric_val = None
             if val_data is not None:
                 val_metrics = self.evaluate(state, val_data)
@@ -307,6 +352,14 @@ class Trainer:
                 print(f"Epoch {epoch} val "
                       + " ".join(f"{k}={v:.4f}" for k, v in val_metrics.items())
                       + f" ({time.time() - t0:.1f}s)", flush=True)
+            if self._preempted:
+                # SIGTERM during validation: save NOW — the preemption
+                # grace period is too short for best-ckpt/scheduler work
+                self.save(state, epoch)
+                print(f"[preempt] checkpoint saved at step "
+                      f"{int(jax.device_get(state.step))}; rerun with "
+                      f"--resume to continue", flush=True)
+                return state
             self.scheduler.step(epoch, metric_val)
             if epoch % cfg.checkpoint_every_epochs == 0:
                 self.save(state, epoch)

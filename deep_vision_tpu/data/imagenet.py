@@ -51,6 +51,82 @@ def _decode(path: str, draft_size: int | None = None) -> np.ndarray:
         return np.asarray(im.convert("RGB"))  # drops alpha, CMYK→RGB
 
 
+def _decode_bytes(data: bytes, draft_size: int | None = None) -> np.ndarray:
+    import io
+
+    from PIL import Image
+
+    with Image.open(io.BytesIO(data)) as im:
+        if draft_size is not None:
+            im.draft("RGB", (draft_size, draft_size))
+        return np.asarray(im.convert("RGB"))
+
+
+class ImageNetRecords:
+    """Random-access view over classification dvrec shards (the consuming
+    side of ``prepare_data imagenet`` — the reference's TFRecord trainer
+    path, ResNet/tensorflow/train.py:178-214).
+
+    Construction scans shard HEADERS once (seeking over payloads) to build
+    an (path, offset, length, label) index; reads are then positioned
+    single-payload I/O, so the same multiprocess decode pool as the folder
+    loader parallelizes cleanly."""
+
+    def __init__(self, root: str, split: str):
+        import json
+        import struct
+
+        from deep_vision_tpu.data.records import list_shards
+
+        u32 = struct.Struct("<I")
+        self.entries: list[tuple[str, int, int]] = []
+        labels: list[int] = []
+        shards = list_shards(root, split)
+        if not shards:
+            raise FileNotFoundError(f"no {split}-*.dvrec under {root}")
+        for path in shards:
+            with open(path, "rb") as f:
+                while True:
+                    raw = f.read(4)
+                    if len(raw) < 4:
+                        break
+                    (hlen,) = u32.unpack(raw)
+                    header = json.loads(f.read(hlen))
+                    (plen,) = u32.unpack(f.read(4))
+                    off = f.tell()
+                    f.seek(plen, 1)  # skip payload
+                    self.entries.append((path, off, plen))
+                    labels.append(int(header["label"]))
+        self.labels = np.asarray(labels, np.int32)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+# worker-local fd cache: positioned reads reuse one open fd per shard.
+# Capped (LRU-ish) so 1024-shard datasets never approach the per-process
+# open-file limit; evicted fds are closed, reopening is cheap
+_FDS: dict = {}
+_FDS_MAX = 64
+
+
+def _pread(path: str, off: int, length: int) -> bytes:
+    f = _FDS.get(path)
+    if f is None:
+        while len(_FDS) >= _FDS_MAX:
+            _, old = _FDS.popitem()
+            old.close()
+        f = _FDS[path] = open(path, "rb")
+    f.seek(off)
+    return f.read(length)
+
+
+def _close_fds():
+    while _FDS:
+        _, f = _FDS.popitem()
+        f.close()
+
+
 class ImageNetFolder:
     """Flat-folder dataset: index → (decoded RGB uint8 HWC, label)."""
 
@@ -84,8 +160,12 @@ def _load_one(cfg: dict, i: int, seed: int) -> tuple[np.ndarray, np.int32]:
     # draft (DCT-domain downscale) only on the fast uint8 path — the
     # --host-normalize path promises reference-exact decode semantics
     draft = cfg["resize"] if cfg.get("device_normalize") else None
-    img = _decode(os.path.join(cfg["root_dir"], cfg["files"][i]),
-                  draft_size=draft)
+    if "entries" in cfg:  # dvrec shards: positioned read + decode
+        path, off, plen = cfg["entries"][i]
+        img = _decode_bytes(_pread(path, off, plen), draft_size=draft)
+    else:
+        img = _decode(os.path.join(cfg["root_dir"], cfg["files"][i]),
+                      draft_size=draft)
     if cfg.get("preprocessing") == "tf":
         # TF "ResNet preprocessing" variant (mean-centered 0-255 floats) —
         # host-only, incompatible with the device-normalize split
@@ -125,14 +205,16 @@ class ImageNetLoader:
     with ``prefetch_to_device`` for the H2D double buffer.
     """
 
-    def __init__(self, root_dir: str, labels_file: str, batch_size: int,
+    def __init__(self, root_dir: str | None, labels_file: str | None,
+                 batch_size: int,
                  train: bool = True, image_size: int = 224, resize: int = 256,
                  num_workers: int = 16, seed: int = 0,
                  process_index: int | None = None,
                  process_count: int | None = None,
                  prefetch_batches: int = 2,
                  device_normalize: bool = False,
-                 preprocessing: str = "torch"):
+                 preprocessing: str = "torch",
+                 dataset: ImageNetRecords | None = None):
         import jax
 
         if preprocessing not in ("torch", "tf"):
@@ -143,7 +225,11 @@ class ImageNetLoader:
                              "(mean-centered 0-255 floats); disable "
                              "device_normalize")
 
-        self.ds = ImageNetFolder(root_dir, labels_file)
+        # source: flat folder (default) or dvrec shards (``dataset`` /
+        # :meth:`from_records`) — downstream identical, only the worker
+        # read path differs
+        self.ds = dataset if dataset is not None \
+            else ImageNetFolder(root_dir, labels_file)
         pi = jax.process_index() if process_index is None else process_index
         pc = jax.process_count() if process_count is None else process_count
         # per-host shard: every host sees a disjoint 1/pc slice per epoch
@@ -155,11 +241,15 @@ class ImageNetLoader:
         self.seed = seed
         self.epoch = 0
         self.prefetch_batches = max(1, prefetch_batches)
-        self._cfg = dict(root_dir=self.ds.root_dir, files=self.ds.files,
-                         labels=self.ds.labels, train=train,
+        self._cfg = dict(labels=self.ds.labels, train=train,
                          image_size=image_size, resize=resize,
                          device_normalize=device_normalize,
                          preprocessing=preprocessing)
+        if isinstance(self.ds, ImageNetRecords):
+            self._cfg["entries"] = self.ds.entries
+        else:
+            self._cfg["root_dir"] = self.ds.root_dir
+            self._cfg["files"] = self.ds.files
         self._pool = None
         # create the pool EAGERLY on the main thread. forkserver (spawn as
         # fallback) — NOT fork: by loader-construction time the JAX runtime
@@ -174,6 +264,15 @@ class ImageNetLoader:
                 ctx = mp.get_context("spawn")
             self._pool = ctx.Pool(self.num_workers, initializer=_worker_init,
                                   initargs=(self._cfg,))
+
+    @classmethod
+    def from_records(cls, root: str, split: str, batch_size: int,
+                     **kwargs) -> "ImageNetLoader":
+        """Train from ``prepare_data imagenet`` dvrec shards — the
+        reference's TFRecord consumption path
+        (ResNet/tensorflow/train.py:178-214)."""
+        return cls(None, None, batch_size,
+                   dataset=ImageNetRecords(root, split), **kwargs)
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -241,5 +340,6 @@ class ImageNetLoader:
 
     def close(self):
         if self._pool is not None:
-            self._pool.terminate()
+            self._pool.terminate()  # worker fds die with the processes
             self._pool = None
+        _close_fds()  # 0-worker path reads in-process
