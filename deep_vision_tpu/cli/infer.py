@@ -81,11 +81,17 @@ def main(argv=None):
             s.add_argument("--score-threshold", type=float, default=0.3)
         if name == "eval":
             s.add_argument("--data-root", default=None,
-                           help="dvrec shards (cli.prepare_data output)")
+                           help="dvrec shards (cli.prepare_data output), "
+                                "flat image dir, or MNIST idx dir")
             s.add_argument("--synthetic", action="store_true")
             s.add_argument("--synthetic-size", type=int, default=64)
             s.add_argument("--batch-size", type=int, default=None)
             s.add_argument("--split", default="val")
+            s.add_argument("--num-workers", type=int, default=4)
+            s.add_argument("--tf-preprocessing", action="store_true",
+                           help="evaluate with the TF 'ResNet "
+                                "preprocessing' pipeline (match what the "
+                                "checkpoint was trained with)")
         if name == "sample":
             s.add_argument("-n", type=int, default=16)
             s.add_argument("--out", default="samples.png")
@@ -202,9 +208,82 @@ def main(argv=None):
 
 
 def _cmd_eval(args, cfg):
-    """Detection evaluation: decode + NMS + VOC mAP@0.5 over a val split —
-    the evaluation the reference's YOLO README lists as "WIP"."""
+    """Held-out evaluation from a restored checkpoint: detection/centernet
+    report VOC mAP@0.5 (the evaluation the reference's YOLO README lists
+    as "WIP"), classification reports top-1/top-5 (the reference's
+    ``validate()``), pose reports val loss."""
     from deep_vision_tpu.core.trainer import Trainer
+
+    batch = args.batch_size or cfg.eval_batch_size
+    if cfg.task == "classification":
+        task, loader, n = _classification_eval_loader(args, cfg, batch)
+    elif cfg.task == "pose":
+        task, loader, n = _pose_eval_loader(args, cfg, batch)
+    elif cfg.task in ("detection", "centernet"):
+        task, loader, n = _detection_eval_loader(args, cfg, batch)
+    else:
+        raise SystemExit(f"eval does not support task '{cfg.task}'")
+    model, state = _load_state(cfg, args.workdir)
+    trainer = Trainer(cfg, model, task, workdir=args.workdir)
+    # the restored state lives on one device; eval batches shard over the
+    # full mesh — replicate or the jit rejects the device mismatch
+    from deep_vision_tpu.parallel import replicate
+
+    state = replicate(state, trainer.mesh)
+    metrics = trainer.evaluate(state, loader)
+    print(f"eval[{args.split}] n={n} "
+          + " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items())))
+    if "mAP" in metrics:
+        print(f"mAP@0.5 = {metrics['mAP']:.4f}")
+    return 0
+
+
+def _classification_eval_loader(args, cfg, batch):
+    from deep_vision_tpu.tasks.classification import ClassificationTask
+
+    task = ClassificationTask(cfg.num_classes, cfg.label_smoothing)
+    if args.synthetic:
+        from deep_vision_tpu.data.loader import ArrayLoader
+        from deep_vision_tpu.data.synthetic import synthetic_classification
+
+        data = synthetic_classification(args.synthetic_size, cfg.image_size,
+                                        cfg.channels, cfg.num_classes, seed=2)
+        return task, ArrayLoader(data, batch, shuffle=False, drop_last=False,
+                                 pad_last=True), args.synthetic_size
+    assert args.data_root, "--data-root required without --synthetic"
+    from deep_vision_tpu.cli.train import build_classification_val_loader
+
+    # same wiring as the train CLI's val loader (records-vs-folder/MNIST
+    # dispatch, resize formula, preprocessing choice) so eval can't drift
+    loader = build_classification_val_loader(
+        cfg, args.data_root, args.split, batch,
+        num_workers=args.num_workers,
+        preprocessing="tf" if args.tf_preprocessing else "torch")
+    n = getattr(loader, "ds_size", None)
+    if n is None:
+        n = len(loader.ds)
+    return task, loader, n
+
+
+def _pose_eval_loader(args, cfg, batch):
+    from deep_vision_tpu.data.pose import PoseLoader, synthetic_pose_dataset
+    from deep_vision_tpu.tasks.pose import PoseTask
+
+    task = PoseTask()
+    if args.synthetic:
+        samples = synthetic_pose_dataset(args.synthetic_size, cfg.image_size,
+                                         cfg.num_classes, seed=2)
+    else:
+        from deep_vision_tpu.data.records import load_pose_records
+
+        assert args.data_root, "--data-root required without --synthetic"
+        samples = load_pose_records(args.data_root, args.split)
+    loader = PoseLoader(samples, batch, cfg.image_size, cfg.image_size // 4,
+                        cfg.num_classes, train=False)
+    return task, loader, len(samples)
+
+
+def _detection_eval_loader(args, cfg, batch):
     from deep_vision_tpu.data.detection import (
         CenterNetLoader,
         DetectionLoader,
@@ -215,13 +294,10 @@ def _cmd_eval(args, cfg):
         from deep_vision_tpu.tasks.centernet import CenterNetTask
 
         task, loader_cls = CenterNetTask(cfg.num_classes), CenterNetLoader
-    elif cfg.task == "detection":
+    else:
         from deep_vision_tpu.tasks.detection import YoloTask
 
         task, loader_cls = YoloTask(cfg.num_classes), DetectionLoader
-    else:
-        raise SystemExit(
-            f"eval supports detection/centernet configs, not '{cfg.task}'")
     if args.synthetic:
         samples = synthetic_detection_dataset(
             args.synthetic_size, cfg.image_size, min(cfg.num_classes, 3),
@@ -231,16 +307,9 @@ def _cmd_eval(args, cfg):
 
         assert args.data_root, "--data-root required without --synthetic"
         samples = load_detection_records(args.data_root, args.split)
-    batch = args.batch_size or cfg.eval_batch_size
     loader = loader_cls(samples, batch, cfg.num_classes, cfg.image_size,
                         train=False)
-    model, state = _load_state(cfg, args.workdir)
-    trainer = Trainer(cfg, model, task, workdir=args.workdir)
-    metrics = trainer.evaluate(state, loader)
-    print(f"eval[{args.split}] n={len(samples)} "
-          + " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items())))
-    print(f"mAP@0.5 = {metrics.get('mAP', float('nan')):.4f}")
-    return 0
+    return task, loader, len(samples)
 
 
 def _save_grid(imgs, path, cols: int = 4):

@@ -136,7 +136,7 @@ def main(argv=None):
 
         assert args.data_root, "--data-root required without --synthetic"
         labels = os.path.join(args.data_root, "imagenet_2012_metadata.txt")
-        resize = max(cfg.image_size * 256 // 224, cfg.image_size + 8)
+        resize = imagenet_resize_for(cfg.image_size)
         # uint8 host pipeline + device-side jitter/normalize (fused into
         # the jit step): 4× less H2D, ~30% less host CPU per image
         if args.tf_preprocessing and args.host_normalize:
@@ -144,24 +144,21 @@ def main(argv=None):
                              "contradictory pipelines; pass only one")
         preprocessing = "tf" if args.tf_preprocessing else "torch"
         dev_norm = not args.host_normalize and preprocessing == "torch"
-        common = dict(image_size=cfg.image_size, resize=resize,
-                      num_workers=args.num_workers,
+        common = dict(train=True, seed=cfg.seed, image_size=cfg.image_size,
+                      resize=resize, num_workers=args.num_workers,
                       device_normalize=dev_norm, preprocessing=preprocessing)
         if args.data_format == "records":
             # dvrec shard consumption (the reference's TFRecord trainer path)
             train_loader = ImageNetLoader.from_records(
-                args.data_root, "train", cfg.batch_size, train=True,
-                seed=cfg.seed, **common)
-            val_loader = ImageNetLoader.from_records(
-                args.data_root, "val", cfg.eval_batch_size, train=False,
-                **common)
+                args.data_root, "train", cfg.batch_size, **common)
         else:
             train_loader = ImageNetLoader(
                 os.path.join(args.data_root, "train"), labels,
-                cfg.batch_size, train=True, seed=cfg.seed, **common)
-            val_loader = ImageNetLoader(
-                os.path.join(args.data_root, "val"), labels,
-                cfg.eval_batch_size, train=False, **common)
+                cfg.batch_size, **common)
+        val_loader = build_classification_val_loader(
+            cfg, args.data_root, "val", cfg.eval_batch_size,
+            num_workers=args.num_workers, preprocessing=preprocessing,
+            device_normalize=dev_norm, data_format=args.data_format)
         if dev_norm:
             from deep_vision_tpu.ops.preprocess import make_imagenet_preprocess
 
@@ -179,6 +176,52 @@ def main(argv=None):
     final = trainer.evaluate(state, val_loader)
     print("final:", " ".join(f"{k}={v:.4f}" for k, v in final.items()))
     return 0
+
+
+def imagenet_resize_for(image_size: int) -> int:
+    """Shorter-side resize target paired with a given crop size (the
+    256-for-224 ratio, clamped to stay above the crop)."""
+    return max(image_size * 256 // 224, image_size + 8)
+
+
+def build_classification_val_loader(cfg, data_root: str, split: str,
+                                    batch: int, num_workers: int = 4,
+                                    preprocessing: str = "torch",
+                                    device_normalize: bool = False,
+                                    data_format: str | None = None):
+    """One place for the records-vs-folder/labels/resize wiring shared by
+    the train CLI's val loader and ``infer eval`` (so the two can't
+    drift).  ``data_format=None`` autodetects dvrec shards; lenet5/MNIST
+    roots (idx-ubyte files) get the MNIST loader."""
+    import os
+
+    from deep_vision_tpu.data.imagenet import ImageNetLoader
+    from deep_vision_tpu.data.records import list_shards
+
+    import glob as _glob
+
+    # MNIST root sniff: any idx-ubyte naming variant load_mnist accepts
+    # (plain / .gz / dot-idx)
+    if _glob.glob(os.path.join(data_root, "t10k-images*idx3-ubyte*")):
+        from deep_vision_tpu.data.loader import ArrayLoader
+        from deep_vision_tpu.data.mnist import load_mnist
+
+        data = load_mnist(data_root, "train" if split == "train" else "test")
+        loader = ArrayLoader(data, batch, shuffle=False, drop_last=False,
+                             pad_last=True)
+        loader.ds_size = len(next(iter(data.values())))
+        return loader
+    common = dict(train=False, image_size=cfg.image_size,
+                  resize=imagenet_resize_for(cfg.image_size),
+                  num_workers=num_workers, preprocessing=preprocessing,
+                  device_normalize=device_normalize)
+    use_records = data_format == "records" or (
+        data_format is None and list_shards(data_root, split))
+    if use_records:
+        return ImageNetLoader.from_records(data_root, split, batch, **common)
+    labels = os.path.join(data_root, "imagenet_2012_metadata.txt")
+    return ImageNetLoader(os.path.join(data_root, split), labels, batch,
+                          **common)
 
 
 def _load_pretrained_state(args, cfg, trainer, train_loader):

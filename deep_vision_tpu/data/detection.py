@@ -122,23 +122,20 @@ class DetectionLoader:
         return {"image": x, **enc}
 
     def __iter__(self) -> Iterator[dict]:
+        from deep_vision_tpu.data.loader import pad_eval_indices
+
         rng = np.random.default_rng((self.seed, self.epoch))
         idx = np.arange(len(self.samples))
         if self.train:
             rng.shuffle(idx)
         for b in range(len(self)):
-            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
-            n_real = len(sel)
-            if n_real < self.batch_size:
-                # weight-0 fillers keep the batch shape static; loss metrics
-                # and the mAP accumulator both honor the weight mask
-                sel = np.concatenate(
-                    [sel, np.repeat(idx[:1], self.batch_size - n_real)])
+            # weight-0 fillers keep the batch shape static; loss metrics
+            # and the mAP accumulator both honor the weight mask
+            sel, weight, _ = pad_eval_indices(idx, b * self.batch_size,
+                                              self.batch_size)
             items = [self._prepare(self.samples[i], rng) for i in sel]
             batch = {k: np.stack([it[k] for it in items]) for k in items[0]}
             if not self.train:
-                weight = np.zeros(self.batch_size, np.float32)
-                weight[:n_real] = 1.0
                 batch["weight"] = weight
             yield batch
 

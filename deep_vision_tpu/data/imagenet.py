@@ -282,11 +282,10 @@ class ImageNetLoader:
 
     def _batch_args(self, idx, seeds, b):
         """(args, n_real) for batch b — padded to the static batch size."""
-        sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
-        n_real = len(sel)
-        if n_real < self.batch_size:
-            sel = np.concatenate(
-                [sel, np.repeat(idx[:1], self.batch_size - n_real)])
+        from deep_vision_tpu.data.loader import pad_eval_indices
+
+        sel, _, n_real = pad_eval_indices(idx, b * self.batch_size,
+                                          self.batch_size)
         args = [(int(i), int(s)) for i, s in
                 zip(sel, seeds[b * self.batch_size:
                                b * self.batch_size + self.batch_size])]

@@ -18,6 +18,21 @@ import numpy as np
 from deep_vision_tpu.parallel import shard_batch
 
 
+def pad_eval_indices(idx: np.ndarray, start: int, batch_size: int
+                     ) -> tuple[np.ndarray, np.ndarray, int]:
+    """Static-shape eval padding, shared by every loader: slice
+    ``idx[start:start+batch_size]``, pad a short tail by repeating the
+    first index, and return ``(sel, weight, n_real)`` where ``weight`` is
+    the 0/1 mask tasks use to ignore the filler rows."""
+    sel = idx[start:start + batch_size]
+    n_real = len(sel)
+    if 0 < n_real < batch_size:
+        sel = np.concatenate([sel, np.repeat(idx[:1], batch_size - n_real)])
+    weight = np.zeros(batch_size, np.float32)
+    weight[:n_real] = 1.0
+    return sel, weight, n_real
+
+
 class ArrayLoader:
     """In-memory dict-of-arrays dataset → shuffled fixed-size batches.
 

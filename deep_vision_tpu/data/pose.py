@@ -74,7 +74,10 @@ class PoseLoader:
         self.epoch = epoch
 
     def __len__(self) -> int:
-        return len(self.samples) // self.batch_size
+        full = len(self.samples) // self.batch_size
+        if not self.train and len(self.samples) % self.batch_size:
+            return full + 1  # eval covers the FULL set (padded last batch)
+        return full
 
     def _prepare(self, sample: dict, rng: np.random.Generator) -> dict:
         img = sample["image"]
@@ -93,14 +96,22 @@ class PoseLoader:
                 "keypoints": hm_kp.astype(np.float32)}
 
     def __iter__(self) -> Iterator[dict]:
+        from deep_vision_tpu.data.loader import pad_eval_indices
+
         rng = np.random.default_rng((self.seed, self.epoch))
         idx = np.arange(len(self.samples))
         if self.train:
             rng.shuffle(idx)
         for b in range(len(self)):
-            sel = idx[b * self.batch_size:(b + 1) * self.batch_size]
+            # weight-0 fillers keep the batch shape static; the task's
+            # eval metrics mask them out (shared loader contract)
+            sel, weight, _ = pad_eval_indices(idx, b * self.batch_size,
+                                              self.batch_size)
             items = [self._prepare(self.samples[i], rng) for i in sel]
-            yield {k: np.stack([it[k] for it in items]) for k in items[0]}
+            batch = {k: np.stack([it[k] for it in items]) for k in items[0]}
+            if not self.train:
+                batch["weight"] = weight
+            yield batch
 
 
 def synthetic_pose_dataset(n: int, image_size: int = 256,

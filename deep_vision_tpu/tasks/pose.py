@@ -68,12 +68,17 @@ class PoseTask:
     def __init__(self, foreground_weight: float = 81.0):
         self.fg = foreground_weight
 
-    def _stack_loss(self, outputs, labels):
+    def _stack_loss_per_image(self, outputs, labels):
+        """(B,) summed over the stack — per-image so eval can mask
+        weight-0 padding rows."""
         loss = 0.0
         for out in outputs:
             w = (labels > 0).astype(jnp.float32) * self.fg + 1.0
-            loss = loss + (jnp.square(labels - out) * w).mean()
+            loss = loss + (jnp.square(labels - out) * w).mean((1, 2, 3))
         return loss
+
+    def _stack_loss(self, outputs, labels):
+        return self._stack_loss_per_image(outputs, labels).mean()
 
     def loss(self, outputs, batch):
         if not isinstance(outputs, (tuple, list)):
@@ -84,7 +89,9 @@ class PoseTask:
     def eval_metrics(self, outputs, batch):
         if not isinstance(outputs, (tuple, list)):
             outputs = (outputs,)
-        loss = self._stack_loss(outputs, batch["heatmaps"])
-        n = batch["heatmaps"].shape[0]
-        return {"loss": loss * n, "neg_loss": -loss * n,
-                "count": jnp.asarray(n, jnp.float32)}
+        per = self._stack_loss_per_image(outputs, batch["heatmaps"])
+        w = batch.get("weight")
+        if w is None:
+            w = jnp.ones_like(per)
+        return {"loss": (per * w).sum(), "neg_loss": -(per * w).sum(),
+                "count": w.sum()}

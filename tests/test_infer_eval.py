@@ -1,0 +1,27 @@
+"""`infer eval` across task families: restores the best checkpoint and
+reports the task's held-out metrics (classification top-1/5 — the
+reference's ``validate()``; detection mAP@0.5 — upstream's "WIP")."""
+
+import pytest
+
+from deep_vision_tpu.cli import infer, train
+
+
+def test_eval_classification_from_checkpoint(tmp_path, mesh1, capsys):
+    wd = str(tmp_path / "run")
+    rc = train.main(["-m", "lenet5", "--synthetic", "--synthetic-size", "128",
+                     "--epochs", "1", "--batch-size", "32",
+                     "--workdir", wd])
+    assert rc == 0
+    rc = infer.main(["eval", "-m", "lenet5", "--workdir", wd,
+                     "--synthetic", "--synthetic-size", "64",
+                     "--batch-size", "32"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "top1=" in out and "top5=" in out
+
+
+def test_eval_rejects_gan_configs(tmp_path):
+    with pytest.raises(SystemExit, match="does not support"):
+        infer.main(["eval", "-m", "dcgan", "--workdir", str(tmp_path),
+                    "--synthetic"])
