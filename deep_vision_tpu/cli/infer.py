@@ -62,9 +62,9 @@ def _read_image(path, size, channels=3):
                                                                size - 4)))
         return preprocess(img[None])[0][:size, :size]
     img = np.asarray(Image.open(path).convert("RGB"))
-    from deep_vision_tpu.data.transforms import eval_transform
+    from deep_vision_tpu.data.transforms import eval_transform, imagenet_resize_for
 
-    return eval_transform(img, size, max(size * 256 // 224, size + 8))
+    return eval_transform(img, size, imagenet_resize_for(size))
 
 
 def main(argv=None):
@@ -233,8 +233,6 @@ def _cmd_eval(args, cfg):
     metrics = trainer.evaluate(state, loader)
     print(f"eval[{args.split}] n={n} "
           + " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items())))
-    if "mAP" in metrics:
-        print(f"mAP@0.5 = {metrics['mAP']:.4f}")
     return 0
 
 
@@ -255,13 +253,10 @@ def _classification_eval_loader(args, cfg, batch):
 
     # same wiring as the train CLI's val loader (records-vs-folder/MNIST
     # dispatch, resize formula, preprocessing choice) so eval can't drift
-    loader = build_classification_val_loader(
+    loader, n = build_classification_val_loader(
         cfg, args.data_root, args.split, batch,
         num_workers=args.num_workers,
         preprocessing="tf" if args.tf_preprocessing else "torch")
-    n = getattr(loader, "ds_size", None)
-    if n is None:
-        n = len(loader.ds)
     return task, loader, n
 
 

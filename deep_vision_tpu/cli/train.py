@@ -133,6 +133,7 @@ def main(argv=None):
         import os
 
         from deep_vision_tpu.data.imagenet import ImageNetLoader
+        from deep_vision_tpu.data.transforms import imagenet_resize_for
 
         assert args.data_root, "--data-root required without --synthetic"
         labels = os.path.join(args.data_root, "imagenet_2012_metadata.txt")
@@ -155,7 +156,7 @@ def main(argv=None):
             train_loader = ImageNetLoader(
                 os.path.join(args.data_root, "train"), labels,
                 cfg.batch_size, **common)
-        val_loader = build_classification_val_loader(
+        val_loader, _ = build_classification_val_loader(
             cfg, args.data_root, "val", cfg.eval_batch_size,
             num_workers=args.num_workers, preprocessing=preprocessing,
             device_normalize=dev_norm, data_format=args.data_format)
@@ -178,12 +179,6 @@ def main(argv=None):
     return 0
 
 
-def imagenet_resize_for(image_size: int) -> int:
-    """Shorter-side resize target paired with a given crop size (the
-    256-for-224 ratio, clamped to stay above the crop)."""
-    return max(image_size * 256 // 224, image_size + 8)
-
-
 def build_classification_val_loader(cfg, data_root: str, split: str,
                                     batch: int, num_workers: int = 4,
                                     preprocessing: str = "torch",
@@ -192,11 +187,13 @@ def build_classification_val_loader(cfg, data_root: str, split: str,
     """One place for the records-vs-folder/labels/resize wiring shared by
     the train CLI's val loader and ``infer eval`` (so the two can't
     drift).  ``data_format=None`` autodetects dvrec shards; lenet5/MNIST
-    roots (idx-ubyte files) get the MNIST loader."""
+    roots (idx-ubyte files) get the MNIST loader.
+    Returns ``(loader, dataset_size)``."""
     import os
 
     from deep_vision_tpu.data.imagenet import ImageNetLoader
     from deep_vision_tpu.data.records import list_shards
+    from deep_vision_tpu.data.transforms import imagenet_resize_for
 
     import glob as _glob
 
@@ -209,8 +206,7 @@ def build_classification_val_loader(cfg, data_root: str, split: str,
         data = load_mnist(data_root, "train" if split == "train" else "test")
         loader = ArrayLoader(data, batch, shuffle=False, drop_last=False,
                              pad_last=True)
-        loader.ds_size = len(next(iter(data.values())))
-        return loader
+        return loader, len(next(iter(data.values())))
     common = dict(train=False, image_size=cfg.image_size,
                   resize=imagenet_resize_for(cfg.image_size),
                   num_workers=num_workers, preprocessing=preprocessing,
@@ -218,10 +214,13 @@ def build_classification_val_loader(cfg, data_root: str, split: str,
     use_records = data_format == "records" or (
         data_format is None and list_shards(data_root, split))
     if use_records:
-        return ImageNetLoader.from_records(data_root, split, batch, **common)
-    labels = os.path.join(data_root, "imagenet_2012_metadata.txt")
-    return ImageNetLoader(os.path.join(data_root, split), labels, batch,
-                          **common)
+        loader = ImageNetLoader.from_records(data_root, split, batch,
+                                             **common)
+    else:
+        labels = os.path.join(data_root, "imagenet_2012_metadata.txt")
+        loader = ImageNetLoader(os.path.join(data_root, split), labels,
+                                batch, **common)
+    return loader, len(loader.ds)
 
 
 def _load_pretrained_state(args, cfg, trainer, train_loader):

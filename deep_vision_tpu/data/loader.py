@@ -70,17 +70,15 @@ class ArrayLoader:
         idx = rng.permutation(self.n) if self.shuffle else np.arange(self.n)
         end = (self.n // self.batch_size) * self.batch_size if self.drop_last else self.n
         for start in range(0, end, self.batch_size):
-            sel = idx[start:start + self.batch_size]
-            n_real = len(sel)
-            if self.pad_last and n_real < self.batch_size:
-                # pad to the static batch size (no XLA recompile, shard-safe)
-                # with weight=0 fillers so metrics ignore them
-                pad = np.zeros(self.batch_size - n_real, idx.dtype)
-                sel = np.concatenate([sel, pad])
+            if self.pad_last:
+                # static batch size (no XLA recompile, shard-safe) with
+                # weight=0 fillers so metrics ignore them
+                sel, weight, _ = pad_eval_indices(idx[:end], start,
+                                                  self.batch_size)
+            else:
+                sel = idx[start:start + self.batch_size]
             batch = {k: v[sel] for k, v in self.data.items()}
             if self.pad_last:
-                weight = np.zeros(len(sel), np.float32)
-                weight[:n_real] = 1.0
                 batch["weight"] = weight
             if self.transform is not None:
                 batch = self.transform(batch, rng)

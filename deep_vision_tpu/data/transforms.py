@@ -23,6 +23,12 @@ IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
 
+def imagenet_resize_for(image_size: int) -> int:
+    """Shorter-side resize target paired with a crop size (the 256-for-224
+    ratio, clamped above the crop) — single source for train/eval/infer."""
+    return max(image_size * 256 // 224, image_size + 8)
+
+
 def rescale(img: np.ndarray, size: int) -> np.ndarray:
     """Resize so the SHORTER side == size, preserving aspect ratio
     (reference Rescale :72-101)."""
