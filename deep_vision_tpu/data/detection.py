@@ -61,10 +61,9 @@ def random_crop_with_boxes(img: np.ndarray, boxes: np.ndarray,
 
 def resize_square(img: np.ndarray, size: int) -> np.ndarray:
     """Plain square resize (the reference resizes to 416² after crop)."""
-    from PIL import Image
+    from deep_vision_tpu.data.transforms import resize_bilinear
 
-    return np.asarray(Image.fromarray(img).resize((size, size),
-                                                  Image.BILINEAR))
+    return resize_bilinear(img, size, size)
 
 
 def corners_to_xywh(boxes: np.ndarray) -> np.ndarray:

@@ -19,6 +19,39 @@ try:  # PIL ships with the baked-in torch/torchvision stack
 except ImportError:  # pragma: no cover
     Image = None
 
+try:  # cv2 resize is ~2× PIL's — and cv2 is the reference's own backend
+    # (ResNet/pytorch/data_load.py uses cv2 throughout); gated: PIL fallback
+    import cv2 as _cv2
+
+    _cv2.setNumThreads(0)  # workers are already process-parallel
+except ImportError:  # pragma: no cover
+    _cv2 = None
+    print("[transforms] cv2 unavailable — PIL resize fallback (slower, and "
+          "NOT bit-identical: PIL antialiases on downscale, cv2 does not)",
+          flush=True)
+
+
+def resize_bilinear(img: np.ndarray, w: int, h: int) -> np.ndarray:
+    """Bilinear resize to (w, h): cv2 when present, else PIL.
+
+    The two backends are NOT numerically identical (PIL antialiases on
+    downscale); the active backend is announced once at import so accuracy
+    comparisons across machines are attributable.  Accepts uint8 or float
+    HWC arrays; dtype is preserved on both paths."""
+    if _cv2 is not None:
+        return _cv2.resize(img, (w, h), interpolation=_cv2.INTER_LINEAR)
+    if img.dtype == np.uint8:
+        return np.asarray(Image.fromarray(img).resize((w, h),
+                                                      Image.BILINEAR))
+    # float inputs: PIL mode-F per channel keeps full precision
+    chans = [np.asarray(Image.fromarray(img[..., c], mode="F")
+                        .resize((w, h), Image.BILINEAR))
+             for c in range(img.shape[-1])]
+    return np.stack(chans, axis=-1).astype(img.dtype)
+
+
+_resize = resize_bilinear  # module-internal alias
+
 IMAGENET_MEAN = np.array([0.485, 0.456, 0.406], np.float32)
 IMAGENET_STD = np.array([0.229, 0.224, 0.225], np.float32)
 
@@ -39,8 +72,7 @@ def rescale(img: np.ndarray, size: int) -> np.ndarray:
         nh, nw = max(1, int(round(h * size / w))), size
     if (nh, nw) == (h, w):
         return img
-    pil = Image.fromarray(img.astype(np.uint8) if img.dtype != np.uint8 else img)
-    return np.asarray(pil.resize((nw, nh), Image.BILINEAR))
+    return _resize(img, nw, nh)
 
 
 def random_horizontal_flip(img: np.ndarray, rng: np.random.Generator,

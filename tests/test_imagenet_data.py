@@ -292,3 +292,25 @@ def test_record_loader_multiprocess(fake_imagenet, tmp_path):
         assert all(0 <= l < 3 for b in batches for l in b["label"])
     finally:
         loader.close()
+
+
+def test_resize_backends_preserve_dtype():
+    """resize_bilinear keeps dtype on BOTH backends; the PIL fallback must
+    not truncate float images to uint8 (per-channel mode-F path)."""
+    import deep_vision_tpu.data.transforms as T
+
+    img_u8 = np.random.default_rng(0).integers(
+        0, 255, (40, 60, 3), dtype=np.uint8)
+    img_f = img_u8.astype(np.float32) / 255.0
+    for backend_cv2 in (T._cv2, None):
+        saved = T._cv2
+        T._cv2 = backend_cv2
+        try:
+            out_u8 = T.resize_bilinear(img_u8, 30, 20)
+            out_f = T.resize_bilinear(img_f, 30, 20)
+        finally:
+            T._cv2 = saved
+        assert out_u8.shape == (20, 30, 3) and out_u8.dtype == np.uint8
+        assert out_f.shape == (20, 30, 3) and out_f.dtype == np.float32
+        # floats stay in range — a uint8 truncation would zero them out
+        assert 0.2 < float(out_f.mean()) < 0.8
