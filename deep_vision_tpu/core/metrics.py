@@ -17,14 +17,28 @@ from typing import Mapping
 
 
 class MetricLogger:
-    def __init__(self, workdir: str | None = None, filename: str = "metrics.jsonl"):
+    def __init__(self, workdir: str | None = None,
+                 filename: str = "metrics.jsonl", tensorboard: bool = True):
         self.history: dict[str, dict[str, list]] = {}
         self._path = None
+        self._tb = None
+        self._tb_dir = None
         if workdir is not None:
             os.makedirs(workdir, exist_ok=True)
             self._path = os.path.join(workdir, filename)
+            if tensorboard:
+                # lazy: the event file is only created on first log, so
+                # never-logging components don't litter empty files
+                self._tb_dir = os.path.join(workdir, "tensorboard")
 
-    def log(self, name: str, step: int, value: float):
+    def _tb_writer(self):
+        if self._tb is None and self._tb_dir is not None:
+            from deep_vision_tpu.core.tboard import TFEventWriter
+
+            self._tb = TFEventWriter(self._tb_dir)
+        return self._tb
+
+    def _record(self, name: str, step: int, value: float):
         series = self.history.setdefault(name, {"steps": [], "values": []})
         series["steps"].append(int(step))
         series["values"].append(float(value))
@@ -33,9 +47,20 @@ class MetricLogger:
                 f.write(json.dumps({"name": name, "step": int(step),
                                     "value": float(value), "time": time.time()}) + "\n")
 
+    def log(self, name: str, step: int, value: float):
+        self._record(name, step, value)
+        tb = self._tb_writer()
+        if tb is not None:
+            tb.scalar(name, value, step)
+            tb.flush()
+
     def log_dict(self, step: int, metrics: Mapping[str, float]):
         for k, v in metrics.items():
-            self.log(k, step, v)
+            self._record(k, step, v)
+        tb = self._tb_writer()
+        if tb is not None and metrics:
+            tb.scalars(metrics, step)  # one batched event + one flush
+            tb.flush()
 
     def latest(self, name: str) -> float | None:
         s = self.history.get(name)

@@ -25,10 +25,13 @@ try:  # cv2 resize is ~2× PIL's — and cv2 is the reference's own backend
 
     _cv2.setNumThreads(0)  # workers are already process-parallel
 except ImportError:  # pragma: no cover
+    import warnings
+
     _cv2 = None
-    print("[transforms] cv2 unavailable — PIL resize fallback (slower, and "
-          "NOT bit-identical: PIL antialiases on downscale, cv2 does not)",
-          flush=True)
+    warnings.warn(
+        "cv2 unavailable — PIL resize fallback (slower, and NOT "
+        "bit-identical: PIL antialiases on downscale, cv2 does not)",
+        stacklevel=1)
 
 
 def resize_bilinear(img: np.ndarray, w: int, h: int) -> np.ndarray:

@@ -1,0 +1,74 @@
+"""The dependency-free tfevents writer must produce files a STOCK
+TensorBoard reads back exactly — verified with tensorboard's own
+EventFileLoader (the consuming side of the reference's tf.summary.scalar
+logging, YOLO/tensorflow/train.py:159-179)."""
+
+import glob
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.core.metrics import MetricLogger
+from deep_vision_tpu.core.tboard import TFEventWriter, _crc32c
+
+
+def _scalar(v) -> float:
+    """TB >= 2.x data-compat rewrites simple_value into a DT_FLOAT tensor."""
+    if v.HasField("tensor") and v.tensor.float_val:
+        return float(v.tensor.float_val[0])
+    return float(v.simple_value)
+
+
+def test_crc32c_known_vectors():
+    # RFC 3720 test vectors
+    assert _crc32c(b"") == 0x0
+    assert _crc32c(b"123456789") == 0xE3069283
+    assert _crc32c(bytes(32)) == 0x8A9136AA
+
+
+def test_roundtrip_via_tensorboard_reader(tmp_path):
+    loader_mod = pytest.importorskip(
+        "tensorboard.backend.event_processing.event_file_loader")
+
+    w = TFEventWriter(str(tmp_path))
+    w.scalar("train_loss", 1.5, step=1)
+    w.scalar("train_loss", 0.75, step=2)
+    w.scalars({"val_top1": 0.9, "val_top5": 0.99}, step=2)
+    w.close()
+
+    (path,) = glob.glob(str(tmp_path / "events.out.tfevents.*"))
+    events = list(loader_mod.EventFileLoader(path).Load())
+    seen = []
+    for e in events:
+        for v in e.summary.value:
+            seen.append((v.tag, e.step, round(_scalar(v), 4)))
+    assert ("train_loss", 1, 1.5) in seen
+    assert ("train_loss", 2, 0.75) in seen
+    assert ("val_top1", 2, 0.9) in seen
+    assert ("val_top5", 2, 0.99) in seen
+
+
+def test_metric_logger_emits_tensorboard(tmp_path):
+    pytest.importorskip(
+        "tensorboard.backend.event_processing.event_file_loader")
+    from tensorboard.backend.event_processing.event_file_loader import (
+        EventFileLoader,
+    )
+
+    logger = MetricLogger(str(tmp_path))
+    logger.log("loss", 10, 3.25)
+    logger.log_dict(20, {"top1": 0.5})
+    (path,) = glob.glob(str(tmp_path / "tensorboard" / "events.*"))
+    tags = {(v.tag, e.step): _scalar(v)
+            for e in EventFileLoader(path).Load()
+            for v in e.summary.value}
+    assert tags[("loss", 10)] == pytest.approx(3.25)
+    assert tags[("top1", 20)] == pytest.approx(0.5)
+    # JSONL mirror still written
+    assert (tmp_path / "metrics.jsonl").exists()
+
+
+def test_metric_logger_tensorboard_off(tmp_path):
+    logger = MetricLogger(str(tmp_path), tensorboard=False)
+    logger.log("loss", 1, 1.0)
+    assert not glob.glob(str(tmp_path / "tensorboard" / "*"))
