@@ -25,6 +25,10 @@ smoke_%:
 	$(PY) -m deep_vision_tpu.cli.train -m $* --synthetic --epochs 2 \
 		--workdir /tmp/smoke_$*
 
+eval_%:
+	$(PY) -m deep_vision_tpu.cli.infer eval -m $* --data-root $(DATA) \
+		--workdir $(WORKDIR)/$*
+
 list:
 	$(PY) -m deep_vision_tpu.cli.train --list -m x
 
