@@ -51,11 +51,38 @@ def _decode(path: str, draft_size: int | None = None) -> np.ndarray:
         return np.asarray(im.convert("RGB"))  # drops alpha, CMYK→RGB
 
 
-def _decode_bytes(data: bytes, draft_size: int | None = None) -> np.ndarray:
+def _decode_bytes(data: bytes, draft_size: int | None = None,
+                  fast: bool = False) -> np.ndarray:
     import io
 
     from PIL import Image
 
+    if fast:
+        # cv2 JPEG decode is ~20% faster end-to-end and bit-identical to
+        # PIL's (both libjpeg-turbo).  Only safe for SANITIZED sources
+        # (prepare_imagenet re-encodes everything to clean RGB JPEG at
+        # build time) — cv2 silently mis-decodes CMYK, so the folder path
+        # stays on PIL.  A cheap PIL header peek picks the DCT half-size
+        # decode when it still covers the resize target (draft semantics).
+        from deep_vision_tpu.data.transforms import _cv2
+
+        if _cv2 is not None:
+            flag = _cv2.IMREAD_COLOR
+            if draft_size is not None:
+                with Image.open(io.BytesIO(data)) as im:  # header only
+                    w, h = im.size
+                # deepest DCT reduction that still covers the resize
+                # target — the full 1/2–1/8 ladder PIL's draft offers
+                for shift, reduced in ((3, _cv2.IMREAD_REDUCED_COLOR_8),
+                                       (2, _cv2.IMREAD_REDUCED_COLOR_4),
+                                       (1, _cv2.IMREAD_REDUCED_COLOR_2)):
+                    if min(w, h) >> shift >= draft_size:
+                        flag = reduced
+                        break
+            img = _cv2.imdecode(np.frombuffer(data, np.uint8), flag)
+            if img is not None and img.ndim == 3 and img.shape[2] == 3:
+                return _cv2.cvtColor(img, _cv2.COLOR_BGR2RGB)
+            # undecodable by cv2: fall through to the robust PIL path
     with Image.open(io.BytesIO(data)) as im:
         if draft_size is not None:
             im.draft("RGB", (draft_size, draft_size))
@@ -162,7 +189,11 @@ def _load_one(cfg: dict, i: int, seed: int) -> tuple[np.ndarray, np.int32]:
     draft = cfg["resize"] if cfg.get("device_normalize") else None
     if "entries" in cfg:  # dvrec shards: positioned read + decode
         path, off, plen = cfg["entries"][i]
-        img = _decode_bytes(_pread(path, off, plen), draft_size=draft)
+        # cv2 fast decode: records are sanitized RGB JPEG at build time,
+        # and it's gated (like draft) to the device-normalize path — the
+        # host-normalize/tf paths keep their reference-exact PIL decode
+        img = _decode_bytes(_pread(path, off, plen), draft_size=draft,
+                            fast=bool(cfg.get("device_normalize")))
     else:
         img = _decode(os.path.join(cfg["root_dir"], cfg["files"][i]),
                       draft_size=draft)
