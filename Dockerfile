@@ -16,7 +16,8 @@ WORKDIR /app
 # TPU wheels; on a non-TPU host jax falls back to CPU automatically.
 RUN pip install --no-cache-dir \
     "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
-    flax optax orbax-checkpoint chex einops numpy pillow
+    flax optax orbax-checkpoint chex einops numpy pillow \
+    opencv-python-headless
 
 COPY deep_vision_tpu/ deep_vision_tpu/
 

@@ -78,11 +78,6 @@ class Trainer:
         self.scheduler = build_scheduler(
             config.scheduler.name, config.optimizer.learning_rate,
             **config.scheduler.kwargs)
-        self.checkpointer = ckpt_lib.Checkpointer(
-            os.path.join(self.workdir, "checkpoints"),
-            max_to_keep=config.keep_checkpoints)
-        self.best_checkpointer = ckpt_lib.Checkpointer(
-            os.path.join(self.workdir, "checkpoints_best"), max_to_keep=1)
         # optional off-host artifact sync after each checkpoint (the
         # Hourglass GCS-upload role, Hourglass/tensorflow/main.py:21-65)
         self.uploader = None
@@ -90,6 +85,23 @@ class Trainer:
             from deep_vision_tpu.core.upload import ArtifactUploader
 
             self.uploader = ArtifactUploader(upload)
+            # preemption recovery: a fresh host (empty workdir) with a
+            # populated mirror pulls the checkpoints back down before the
+            # Checkpointer (whose Orbax manager scans at construction) and
+            # maybe_resume() look for them — without this, the first
+            # post-checkpoint sync of the fresh run would instead wipe
+            # the mirror's preempt-saved copies (the only ones left)
+            ckpt_dir = os.path.join(self.workdir, "checkpoints")
+            if not os.path.isdir(ckpt_dir) or not os.listdir(ckpt_dir):
+                self.uploader.restore(ckpt_dir, "checkpoints")
+                self.uploader.restore(
+                    os.path.join(self.workdir, "checkpoints_best"),
+                    "checkpoints_best")
+        self.checkpointer = ckpt_lib.Checkpointer(
+            os.path.join(self.workdir, "checkpoints"),
+            max_to_keep=config.keep_checkpoints)
+        self.best_checkpointer = ckpt_lib.Checkpointer(
+            os.path.join(self.workdir, "checkpoints_best"), max_to_keep=1)
         self._has_bn: bool | None = None
         self._jit_train_step = None
         self._jit_eval_step = None

@@ -44,13 +44,16 @@ def _sync_local(src_dir: str, dest_dir: str) -> int:
         shutil.copy2(full, dest)
         n += 1
     # true mirror: drop files pruned locally (max_to_keep rotation),
-    # so the destination doesn't accumulate every checkpoint ever written
-    for full, rel in list(_iter_files(dest_dir)):
-        if rel not in keep:
-            os.remove(full)
-    for root, dirs, files in os.walk(dest_dir, topdown=False):
-        if not dirs and not files and root != dest_dir:
-            os.rmdir(root)
+    # so the destination doesn't accumulate every checkpoint ever written.
+    # Guard: an empty src means a fresh run that has written nothing yet —
+    # never let it wipe a backup it hasn't superseded.
+    if keep:
+        for full, rel in list(_iter_files(dest_dir)):
+            if rel not in keep:
+                os.remove(full)
+        for root, dirs, files in os.walk(dest_dir, topdown=False):
+            if not dirs and not files and root != dest_dir:
+                os.rmdir(root)
     return n
 
 
@@ -71,9 +74,13 @@ def _sync_gcs(src_dir: str, uri: str) -> int:
     bucket = storage.Client().bucket(bucket_name)
     # incremental: list what's already there once, skip same-size blobs
     # (checkpoint files are content-addressed-ish — same size ⇒ same file
-    # for orbax array payloads; a rare same-size edit re-uploads next run)
+    # for orbax array payloads; a rare same-size edit re-uploads next run).
+    # prefix listed with a trailing '/': bare "run/checkpoints" would also
+    # match the SIBLING "run/checkpoints_best/..." blobs and the mirror
+    # loop below would delete them
     existing = {b.name: b.size
-                for b in bucket.list_blobs(prefix=prefix or None)}
+                for b in bucket.list_blobs(
+                    prefix=prefix + "/" if prefix else None)}
     n = 0
     keep = set()
     for full, rel in _iter_files(src_dir):
@@ -83,10 +90,55 @@ def _sync_gcs(src_dir: str, uri: str) -> int:
             continue
         bucket.blob(name).upload_from_filename(full)
         n += 1
-    for name in existing:  # mirror semantics (see _sync_local)
-        if name not in keep:
-            bucket.blob(name).delete()
+    if keep:  # mirror semantics + fresh-run guard (see _sync_local)
+        for name in existing:
+            if name not in keep:
+                bucket.blob(name).delete()
     return n
+
+
+def _restore_gcs(uri: str, local_dir: str) -> int:
+    try:
+        from google.cloud import storage  # type: ignore
+    except ImportError:
+        if shutil.which("gsutil"):
+            os.makedirs(local_dir, exist_ok=True)  # rsync needs the target
+            subprocess.run(["gsutil", "-m", "rsync", "-r", uri, local_dir],
+                           check=True)
+            return -1
+        raise RuntimeError(
+            "gs:// restore needs google-cloud-storage or gsutil; neither "
+            "is available")
+    bucket_name, _, prefix = uri[len("gs://"):].partition("/")
+    bucket = storage.Client().bucket(bucket_name)
+    n = 0
+    # trailing '/' so "run/checkpoints" doesn't also pull the sibling
+    # "run/checkpoints_best/..." blobs into this tree (see _sync_gcs)
+    for blob in bucket.list_blobs(prefix=prefix + "/" if prefix else None):
+        rel = blob.name[len(prefix):].lstrip("/") if prefix else blob.name
+        if not rel:
+            continue
+        dest = os.path.join(local_dir, rel)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        blob.download_to_filename(dest)
+        n += 1
+    return n
+
+
+def restore_dir(dest_uri: str, local_dir: str) -> int:
+    """Pull a previously mirrored tree back into ``local_dir`` (the inverse
+    of :func:`sync_dir`) — the preemption-recovery path: a fresh VM with an
+    empty workdir re-hydrates its checkpoints from the upload URI before
+    resuming.  Returns files copied (-1 if unknown); 0 if the mirror is
+    empty or absent."""
+    if dest_uri.startswith("gs://"):
+        return _restore_gcs(dest_uri, local_dir)
+    src = dest_uri[len("file://"):] if dest_uri.startswith("file://") \
+        else dest_uri
+    if not os.path.isdir(src):
+        return 0
+    os.makedirs(local_dir, exist_ok=True)
+    return _sync_local(src, local_dir)
 
 
 def sync_dir(src_dir: str, dest_uri: str) -> int:
@@ -115,3 +167,18 @@ class ArtifactUploader:
                   f"{self.dest_uri}/{tag}", flush=True)
         except Exception as e:  # noqa: BLE001 — deliberately broad
             print(f"[upload] FAILED for {tag}: {e}", flush=True)
+
+    def restore(self, local_dir: str, tag: str) -> int:
+        """Re-hydrate ``local_dir`` from the mirror (preemption recovery:
+        the VM died, the local disk is gone, the mirror is the only copy).
+        Failures are reported, not fatal — a missing mirror just means a
+        genuinely fresh run."""
+        try:
+            n = restore_dir(f"{self.dest_uri}/{tag}", local_dir)
+            if n:
+                print(f"[upload] restored {n if n >= 0 else '?'} file(s) "
+                      f"← {self.dest_uri}/{tag}", flush=True)
+            return n
+        except Exception as e:  # noqa: BLE001 — deliberately broad
+            print(f"[upload] restore FAILED for {tag}: {e}", flush=True)
+            return 0

@@ -121,8 +121,7 @@ def load_torch_checkpoint(path: str, arch: str = "resnet50",
     return import_torch_resnet(obj, arch, include_fc)
 
 
-def merge_pretrained(variables: dict, imported: dict,
-                     include_fc: bool = True) -> dict:
+def merge_pretrained(variables: dict, imported: dict) -> dict:
     """Overlay imported weights onto freshly-initialized ``variables``
     (validates tree/shape agreement leaf by leaf)."""
     import jax

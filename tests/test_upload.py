@@ -29,6 +29,66 @@ def test_sync_dir_incremental(tmp_path):
     assert (dest / "a.txt").read_text() == "one-changed"
 
 
+def test_sync_fresh_run_never_wipes_mirror(tmp_path):
+    """An empty local dir (fresh run, nothing written yet) must not delete
+    a populated mirror — the mirror may be the only surviving copy after a
+    preemption killed the local disk (ADVICE r2, medium)."""
+    src = tmp_path / "src"
+    src.mkdir()
+    dest = tmp_path / "dest"
+    (dest / "ckpt-5").mkdir(parents=True)
+    (dest / "ckpt-5" / "data").write_text("precious")
+    assert sync_dir(str(src), str(dest)) == 0
+    assert (dest / "ckpt-5" / "data").read_text() == "precious"
+
+
+def test_restore_dir_roundtrip(tmp_path):
+    from deep_vision_tpu.core.upload import restore_dir
+
+    src = tmp_path / "src"
+    (src / "sub").mkdir(parents=True)
+    (src / "sub" / "b.txt").write_text("two")
+    dest = tmp_path / "dest"
+    sync_dir(str(src), str(dest))
+    back = tmp_path / "back"
+    assert restore_dir(f"file://{dest}", str(back)) == 1
+    assert (back / "sub" / "b.txt").read_text() == "two"
+    # absent mirror → 0, no error (genuinely fresh run)
+    assert restore_dir(str(tmp_path / "nope"), str(back / "x")) == 0
+
+
+def test_trainer_restores_from_mirror_on_fresh_host(tmp_path, mesh1):
+    """Preemption recovery: train + upload, wipe the workdir (the VM died),
+    re-create the Trainer with the same upload URI → checkpoints come back
+    from the mirror and the run resumes instead of starting over."""
+    import shutil
+
+    cfg = get_config("lenet5")
+    cfg.total_epochs = 1
+    cfg.batch_size = 32
+    dest = tmp_path / "mirror"
+    workdir = tmp_path / "run"
+    trainer = Trainer(cfg, cfg.model(), ClassificationTask(10), mesh=mesh1,
+                      workdir=str(workdir), upload=str(dest))
+    data = synthetic_mnist(64)
+    train = ArrayLoader(data, cfg.batch_size, seed=1)
+    val = ArrayLoader(data, cfg.batch_size, shuffle=False)
+    trainer.fit(train, val)
+    trainer.checkpointer.close()
+    trainer.best_checkpointer.close()
+    shutil.rmtree(workdir)
+
+    trainer2 = Trainer(cfg, cfg.model(), ClassificationTask(10), mesh=mesh1,
+                       workdir=str(workdir), upload=str(dest))
+    assert trainer2.checkpointer.latest_step() is not None, \
+        "mirror checkpoints not restored onto the fresh host"
+    state = trainer2.init_state(next(iter(train)))
+    state = trainer2.maybe_resume(state)
+    assert trainer2.start_epoch == 2  # continues after epoch 1, not from 0
+    # and the mirror survived the fresh host's first sync
+    assert os.listdir(dest / "checkpoints")
+
+
 def test_trainer_uploads_checkpoints(tmp_path, mesh1):
     """A run with upload=<uri> must land its rolling AND best checkpoints
     at the destination."""
