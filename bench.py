@@ -136,12 +136,20 @@ def bench_train_step(batch: int = 256, size: int = 224, steps: int = 20,
 def bench_pipeline(num_workers: int = 16, batch: int = 256,
                    n_images: int = 4096, jpeg_size: int = 400,
                    image_size: int = 224,
-                   device_normalize: bool = True) -> dict:
-    """Host input-pipeline throughput: synthetic JPEGs on disk through the
-    REAL ImageNetLoader (decode + augment + batch assembly), no device work.
+                   device_normalize: bool = True,
+                   source: str = "raw") -> dict:
+    """Host input-pipeline throughput: synthetic images on disk through the
+    REAL ImageNetLoader (read + [decode] + augment + batch assembly), no
+    device work.
 
     SURVEY §7 hard-part #1: this number must meet or beat the chip's
-    train-step rate or the chip starves.
+    train-step rate or the chip starves.  ``source`` picks the storage:
+
+    - ``raw``     train-ready uint8 dvrec shards (``prepare_data imagenet
+                  --store raw``) — decode-free reads, the production path
+                  for 1-core TPU-VM hosts;
+    - ``records`` sanitized-JPEG dvrec shards (archival format);
+    - ``folder``  flat JPEG dir (the reference's torch-loader layout).
     """
     import os
     import shutil
@@ -168,11 +176,22 @@ def bench_pipeline(num_workers: int = 16, batch: int = 256,
             Image.fromarray(base[i % 8]).save(
                 os.path.join(root, f"{synsets[i % 8]}_{i}.JPEG"), quality=85)
 
-        loader = ImageNetLoader(
-            root, os.path.join(tmp, "labels.txt"), batch, train=True,
-            image_size=image_size, num_workers=num_workers,
-            process_index=0, process_count=1,
-            device_normalize=device_normalize)
+        common = dict(train=True, image_size=image_size,
+                      num_workers=num_workers, process_index=0,
+                      process_count=1, device_normalize=device_normalize)
+        if source in ("raw", "records"):
+            from deep_vision_tpu.data.prep import prepare_imagenet
+
+            recs = os.path.join(tmp, "recs")
+            prepare_imagenet(root, os.path.join(tmp, "labels.txt"), recs,
+                             split="train", num_shards=8,
+                             num_workers=min(8, os.cpu_count() or 1),
+                             store="jpeg" if source == "records" else "raw")
+            loader = ImageNetLoader.from_records(recs, "train", batch,
+                                                 **common)
+        else:
+            loader = ImageNetLoader(
+                root, os.path.join(tmp, "labels.txt"), batch, **common)
         # warm one batch (pool spin-up), then measure a full epoch
         it = iter(loader)
         next(it)
@@ -190,6 +209,7 @@ def bench_pipeline(num_workers: int = 16, batch: int = 256,
         "value": round(img_per_sec, 1),
         "unit": "images/sec/host",
         "vs_baseline": round(img_per_sec / BASELINE_IMG_PER_SEC_PER_CHIP, 2),
+        "source": source,
         "num_workers": num_workers,
         "jpeg_size": jpeg_size,
         "device_normalize": device_normalize,
@@ -204,12 +224,20 @@ def main():
     p.add_argument("--profile", action="store_true")
     p.add_argument("--batch", type=int, default=256)
     p.add_argument("--steps", type=int, default=20)
-    p.add_argument("--num-workers", type=int, default=16)
+    p.add_argument("--num-workers", type=int, default=None,
+                   help="worker processes (default: 0 for --source raw — "
+                   "decode-free reads are faster inline than through pool "
+                   "IPC — else 16)")
     p.add_argument("--host-normalize", action="store_true")
+    p.add_argument("--source", choices=("raw", "records", "folder"),
+                   default="raw", help="--pipeline storage variant")
     args = p.parse_args()
     if args.pipeline:
-        out = bench_pipeline(num_workers=args.num_workers, batch=args.batch,
-                             device_normalize=not args.host_normalize)
+        nw = args.num_workers if args.num_workers is not None \
+            else (0 if args.source == "raw" else 16)
+        out = bench_pipeline(num_workers=nw, batch=args.batch,
+                             device_normalize=not args.host_normalize,
+                             source=args.source)
     else:
         out = bench_train_step(batch=args.batch, steps=args.steps,
                                profile=args.profile)

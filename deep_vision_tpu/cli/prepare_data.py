@@ -44,6 +44,12 @@ def main(argv=None):
     imagenet.add_argument("--bbox-csv", default=None,
                           help="imagenet-bboxes output; boxes go into "
                                "record headers")
+    imagenet.add_argument("--store", choices=("jpeg", "raw"), default="jpeg",
+                          help="raw: decode+rescale at build time, store "
+                               "uint8 — decode-free read path that feeds a "
+                               "TPU from one host core (bigger shards)")
+    imagenet.add_argument("--resize", type=int, default=256,
+                          help="shorter-side rescale target for --store raw")
 
     # XML bbox tree → relative-coords CSV (process_bounding_boxes.py role)
     bboxes = sub.add_parser("imagenet-bboxes")
@@ -97,7 +103,8 @@ def main(argv=None):
     elif args.cmd == "imagenet":
         n = prep.prepare_imagenet(args.src, args.labels, args.out, args.split,
                                   args.num_shards, args.num_workers,
-                                  bbox_csv=args.bbox_csv)
+                                  bbox_csv=args.bbox_csv, store=args.store,
+                                  resize=args.resize)
     elif args.cmd == "imagenet-bboxes":
         stats = prep.process_imagenet_bboxes(args.xml_dir, args.out_csv,
                                              args.synsets)

@@ -106,7 +106,10 @@ class ImageNetRecords:
         from deep_vision_tpu.data.records import list_shards
 
         u32 = struct.Struct("<I")
-        self.entries: list[tuple[str, int, int]] = []
+        # entry = (path, offset, length, shape|None): shape set for
+        # train-ready raw-uint8 payloads (prepare_data --store raw), None
+        # for JPEG payloads that decode at read time
+        self.entries: list[tuple[str, int, int, tuple | None]] = []
         labels: list[int] = []
         shards = list_shards(root, split)
         if not shards:
@@ -122,7 +125,9 @@ class ImageNetRecords:
                     (plen,) = u32.unpack(f.read(4))
                     off = f.tell()
                     f.seek(plen, 1)  # skip payload
-                    self.entries.append((path, off, plen))
+                    shape = tuple(header["shape"]) \
+                        if header.get("enc") == "raw" else None
+                    self.entries.append((path, off, plen, shape))
                     labels.append(int(header["label"]))
         self.labels = np.asarray(labels, np.int32)
 
@@ -141,9 +146,13 @@ def _pread(path: str, off: int, length: int) -> bytes:
     f = _FDS.get(path)
     if f is None:
         while len(_FDS) >= _FDS_MAX:
-            _, old = _FDS.popitem()
+            # evict the least-recently-used (dicts iterate in insertion
+            # order; hits below re-insert, so the front is the coldest)
+            old = _FDS.pop(next(iter(_FDS)))
             old.close()
         f = _FDS[path] = open(path, "rb")
+    else:  # move-to-end on hit → LRU order holds under round-robin reads
+        _FDS[path] = _FDS.pop(path)
     f.seek(off)
     return f.read(length)
 
@@ -187,13 +196,21 @@ def _load_one(cfg: dict, i: int, seed: int) -> tuple[np.ndarray, np.int32]:
     # draft (DCT-domain downscale) only on the fast uint8 path — the
     # --host-normalize path promises reference-exact decode semantics
     draft = cfg["resize"] if cfg.get("device_normalize") else None
-    if "entries" in cfg:  # dvrec shards: positioned read + decode
-        path, off, plen = cfg["entries"][i]
-        # cv2 fast decode: records are sanitized RGB JPEG at build time,
-        # and it's gated (like draft) to the device-normalize path — the
-        # host-normalize/tf paths keep their reference-exact PIL decode
-        img = _decode_bytes(_pread(path, off, plen), draft_size=draft,
-                            fast=bool(cfg.get("device_normalize")))
+    if "entries" in cfg:  # dvrec shards: positioned read (+ decode)
+        path, off, plen, shape = cfg["entries"][i]
+        if shape is not None:
+            # train-ready raw payload: no decode at all — frombuffer and
+            # go straight to crop/flip (the rescale below is a no-op when
+            # the build-time short side matches cfg["resize"])
+            img = np.frombuffer(_pread(path, off, plen),
+                                np.uint8).reshape(shape)
+        else:
+            # cv2 fast decode: records are sanitized RGB JPEG at build
+            # time, and it's gated (like draft) to the device-normalize
+            # path — the host-normalize/tf paths keep their
+            # reference-exact PIL decode
+            img = _decode_bytes(_pread(path, off, plen), draft_size=draft,
+                                fast=bool(cfg.get("device_normalize")))
     else:
         img = _decode(os.path.join(cfg["root_dir"], cfg["files"][i]),
                       draft_size=draft)
@@ -309,7 +326,12 @@ class ImageNetLoader:
         self.epoch = epoch
 
     def __len__(self) -> int:
-        return len(self.host_indices) // self.batch_size
+        full = len(self.host_indices) // self.batch_size
+        # eval iteration yields one extra weight-padded partial batch so
+        # every example is scored exactly once — len() must agree
+        if not self.train and len(self.host_indices) % self.batch_size:
+            return full + 1
+        return full
 
     def _batch_args(self, idx, seeds, b):
         """(args, n_real) for batch b — padded to the static batch size."""

@@ -79,20 +79,65 @@ def sanitize_image(payload: bytes) -> tuple[bytes, str]:
         ImageFile.LOAD_TRUNCATED_IMAGES = old
 
 
-def _encode_imagenet_item(item):
-    """(path, label, synset, human, bboxes) → (header, clean JPEG) or None
-    to drop an undecodable file (records._write_shard skips None)."""
+def decode_image_robust(payload: bytes) -> np.ndarray | None:
+    """One decode with sanitize_image's salvage semantics: any format →
+    RGB uint8 HWC; truncated files partially decode; undecodable → None."""
+    import io
+
+    from PIL import Image, ImageFile
+
+    old = ImageFile.LOAD_TRUNCATED_IMAGES
+    ImageFile.LOAD_TRUNCATED_IMAGES = True
+    try:
+        with Image.open(io.BytesIO(payload)) as im:
+            return np.asarray(im.convert("RGB"))
+    except Exception:
+        return None
+    finally:
+        ImageFile.LOAD_TRUNCATED_IMAGES = old
+
+
+def _encode_imagenet_item(item, store: str = "jpeg", resize: int = 256):
+    """(path, label, synset, human, bboxes) → (header, payload) or None
+    to drop an undecodable file (records._write_shard skips None).
+
+    ``store`` picks the payload encoding:
+
+    - ``jpeg``: the sanitized original JPEG — archival fidelity, decode at
+      read time (the reference TFRecord semantics,
+      build_imagenet_tfrecord.py:472-689);
+    - ``raw``: decode ONCE at build time, aspect-preserving rescale of the
+      shorter side to ``resize``, store raw uint8 HWC — the read path is
+      then decode-free (frombuffer + crop), which is what lets a 1-core
+      TPU-VM host feed the chip (SURVEY §7 hard-part 1).  Train-time
+      augmentation (random crop + flip) is unchanged: it operates on the
+      rescaled image in both paths.
+    """
     path, label, synset, human, bboxes = item
     with open(path, "rb") as f:
         payload = f.read()
-    clean, status = sanitize_image(payload)
-    if status == "bad":
-        print(f"[prep] dropping undecodable image {path}", flush=True)
-        return None
     header = {"label": int(label), "filename": os.path.basename(path),
               "synset": synset, "human": human}
     if bboxes:
         header["bboxes"] = bboxes
+    if store == "raw":
+        # raw stores decoded pixels, so sanitize's JPEG re-encode step is
+        # moot — decode ONCE (salvaging truncated files like
+        # sanitize_image does), rescale, store
+        img = decode_image_robust(payload)
+        if img is None:
+            print(f"[prep] dropping undecodable image {path}", flush=True)
+            return None
+        from deep_vision_tpu.data.transforms import rescale
+
+        img = np.ascontiguousarray(rescale(img, resize))
+        header["shape"] = list(img.shape)
+        header["enc"] = "raw"
+        return header, img.tobytes()
+    clean, status = sanitize_image(payload)
+    if status == "bad":
+        print(f"[prep] dropping undecodable image {path}", flush=True)
+        return None
     if status == "reencoded":
         header["reencoded"] = True
     return header, clean
@@ -331,7 +376,8 @@ def process_imagenet_bboxes(xml_dir: str, out_csv: str,
 
 def prepare_imagenet(src_dir: str, labels_file: str, out_dir: str,
                      split: str = "train", num_shards: int = 64,
-                     num_workers: int = 8, bbox_csv: str | None = None) -> int:
+                     num_workers: int = 8, bbox_csv: str | None = None,
+                     store: str = "jpeg", resize: int = 256) -> int:
     """Flattened synset-prefixed JPEG dir → classification dvrec shards
     (the 1024/128-shard layout of build_imagenet_tfrecord.py, scaled by
     ``num_shards``).
@@ -355,8 +401,12 @@ def prepare_imagenet(src_dir: str, labels_file: str, out_dir: str,
         synset = f.split("_")[0]
         items.append((os.path.join(src_dir, f), label_map[synset], synset,
                       humans.get(synset, ""), boxes.get(f, None)))
+    import functools
+
+    encode = _encode_imagenet_item if store == "jpeg" else functools.partial(
+        _encode_imagenet_item, store=store, resize=resize)
     _, written = R.write_sharded(items, out_dir, split, num_shards,
-                                 _encode_imagenet_item, num_workers)
+                                 encode, num_workers)
     if written < len(items):
         print(f"[prep] dropped {len(items) - written} undecodable file(s) "
               f"of {len(items)}", flush=True)

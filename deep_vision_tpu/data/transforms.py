@@ -186,13 +186,19 @@ def tf_eval_transform(img: np.ndarray, size: int = 224, resize: int = 256
 def train_transform_u8(img: np.ndarray, rng: np.random.Generator,
                        size: int = 224, resize: int = 256) -> np.ndarray:
     """Host half of the device-preprocess split: Rescale → flip → RandomCrop,
-    all uint8 (jitter+normalize run on device — ops/preprocess.py)."""
+    all uint8 (jitter+normalize run on device — ops/preprocess.py).
+
+    Returns a VIEW when no resize was needed (raw records): the one copy
+    happens at batch assembly (np.stack) or pickling — materializing here
+    too would double the pipeline's memory traffic (the 1-core host budget,
+    SURVEY §7 hard-part 1)."""
     img = rescale(img, resize)
     img = random_horizontal_flip(img, rng)
-    return np.ascontiguousarray(random_crop(img, size, rng))
+    return random_crop(img, size, rng)
 
 
 def eval_transform_u8(img: np.ndarray, size: int = 224, resize: int = 256
                       ) -> np.ndarray:
-    """Host half for eval: Rescale → CenterCrop, uint8."""
-    return np.ascontiguousarray(center_crop(rescale(img, resize), size))
+    """Host half for eval: Rescale → CenterCrop, uint8 (view — see
+    train_transform_u8)."""
+    return center_crop(rescale(img, resize), size)
