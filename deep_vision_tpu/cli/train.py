@@ -31,6 +31,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--workdir", default=None)
     p.add_argument("--epochs", type=int, default=None, help="override config")
     p.add_argument("--batch-size", type=int, default=None, help="override config")
+    p.add_argument("--scan-steps", type=int, default=None,
+                   help="train steps per device dispatch (lax.scan "
+                        "multi-step; amortizes host dispatch overhead)")
     p.add_argument("--image-size", type=int, default=None,
                    help="override config (smoke runs at low res)")
     p.add_argument("--mesh", default=None,
@@ -83,6 +86,8 @@ def main(argv=None):
         cfg.total_epochs = args.epochs
     if args.batch_size is not None:
         cfg.batch_size = cfg.eval_batch_size = args.batch_size
+    if args.scan_steps is not None:
+        cfg.scan_steps = args.scan_steps
     if args.image_size is not None:
         cfg.image_size = args.image_size
 

@@ -43,6 +43,11 @@ class TrainConfig:
     # divergence guard: non-finite steps are skipped + counted; the run
     # halts with a clear error once more than this many were skipped
     max_bad_steps: int = 100
+    # multi-step dispatch: run this many train steps per device program
+    # (one lax.scan) — amortizes per-dispatch host overhead (~2ms/step on
+    # a tunneled v5e, worth ~4% throughput at K=40); logging/guard/
+    # preemption work at K-step granularity. 1 = step-per-dispatch.
+    scan_steps: int = 1
     seed: int = 42
     extra: dict = dataclasses.field(default_factory=dict)
 

@@ -4,6 +4,7 @@ from deep_vision_tpu.parallel.mesh import (
     make_mesh,
     replicate,
     shard_batch,
+    shard_batch_stacked,
     batch_sharding,
     replicated_sharding,
 )
@@ -14,6 +15,7 @@ __all__ = [
     "make_mesh",
     "replicate",
     "shard_batch",
+    "shard_batch_stacked",
     "batch_sharding",
     "replicated_sharding",
 ]

@@ -104,3 +104,30 @@ def shard_batch(tree: Any, mesh: Mesh) -> Any:
         return jax.device_put(x, NamedSharding(mesh, P(*spec)))
 
     return jax.tree_util.tree_map(_put, tree)
+
+
+def shard_batch_stacked(tree: Any, mesh: Mesh) -> Any:
+    """:func:`shard_batch` for K-stacked batches: leaves are (K, B, ...)
+    — dim 0 is the scan/step axis (replicated), dim 1 the batch (over
+    ``data``), dim 2 image rows (over ``spatial`` where divisible).  The
+    device layout of each step's slice matches what ``shard_batch`` would
+    produce, so a ``lax.scan`` over dim 0 runs the identical sharded step
+    (the Trainer's ``scan_steps`` multi-step dispatch)."""
+    n_data = mesh.shape[DATA_AXIS]
+    n_spatial = mesh.shape.get(SPATIAL_AXIS, 1)
+
+    def _put(x):
+        if isinstance(x, jax.Array):
+            return x
+        x = np.asarray(x)
+        if x.ndim <= 1:  # scalars / per-step vectors: replicate
+            return jax.device_put(x, replicated_sharding(mesh))
+        if x.shape[1] % n_data != 0:
+            raise ValueError(
+                f"batch dim {x.shape[1]} not divisible by data axis {n_data}")
+        spec = [None, DATA_AXIS] + [None] * (x.ndim - 2)
+        if n_spatial > 1 and x.ndim >= 5 and x.shape[2] % n_spatial == 0:
+            spec[2] = SPATIAL_AXIS
+        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+
+    return jax.tree_util.tree_map(_put, tree)
