@@ -79,6 +79,15 @@ def main(argv=None):
             s.add_argument("--images", nargs="+", required=True)
         if name == "detect":
             s.add_argument("--score-threshold", type=float, default=0.3)
+        if name in ("detect", "pose"):
+            s.add_argument("--out", default=None,
+                           help="write annotated image(s) — boxes/keypoints "
+                                "drawn on the ORIGINAL photo (the demo-"
+                                "notebook role); multiple inputs get "
+                                "-<stem> suffixes")
+            s.add_argument("--names", default=None,
+                           help="class-names file (one per line; default: "
+                                "VOC names for 20-class models)")
         if name == "eval":
             s.add_argument("--data-root", default=None,
                            help="dvrec shards (cli.prepare_data output), "
@@ -139,13 +148,59 @@ def main(argv=None):
         outs = model.apply(variables, x, train=False)
         boxes, scores, classes, valid = postprocess(
             outs, cfg.num_classes, score_threshold=args.score_threshold)
+        names = _class_names(args, cfg)
         for i, f in enumerate(args.images):
             n = int(np.asarray(valid[i]).sum())
             print(f"{f}: {n} detections")
             for j in range(n):
                 b = np.asarray(boxes[i, j]).round(3).tolist()
-                print(f"  class={int(classes[i, j])} "
+                name = names[int(classes[i, j])] if names else \
+                    int(classes[i, j])
+                print(f"  class={name} "
                       f"score={float(scores[i, j]):.3f} box={b}")
+            if args.out:
+                from deep_vision_tpu.viz import draw_detections
+
+                orig = np.asarray(Image.open(f).convert("RGB"))
+                ann = draw_detections(
+                    orig, np.asarray(boxes[i, :n]), np.asarray(scores[i, :n]),
+                    np.asarray(classes[i, :n]), class_names=names)
+                dst = _out_path(args.out, f, i, len(args.images))
+                Image.fromarray(ann).save(dst)
+                print(f"  annotated -> {dst}")
+    elif args.cmd == "pose":
+        # Hourglass demo path (demo_hourglass_pose.ipynb): heatmap argmax
+        # → keypoints drawn on the original photo
+        from PIL import Image
+
+        from deep_vision_tpu.data.detection import resize_square
+        from deep_vision_tpu.tasks.pose import heatmap_argmax
+        from deep_vision_tpu.viz import draw_keypoints
+
+        model, state = _load_state(cfg, args.workdir)
+        raw = [resize_square(np.asarray(Image.open(f).convert("RGB")),
+                             cfg.image_size).astype(np.float32) / 255.0
+               for f in args.images]
+        x = jnp.asarray(np.stack(raw))
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        heat = np.asarray(model.apply(variables, x, train=False)[-1])
+        for i, f in enumerate(args.images):
+            kp_hm = heatmap_argmax(heat[i])            # (K, 2) heatmap px
+            orig = np.asarray(Image.open(f).convert("RGB"))
+            oh, ow = orig.shape[:2]
+            hh, hw = heat.shape[1:3]
+            kp_img = kp_hm * np.array([ow / hw, oh / hh], np.float32)
+            conf = heat[i].max(axis=(0, 1))            # per-joint peak
+            print(f"{f}: " + " ".join(
+                f"j{k}=({kp_img[k, 0]:.0f},{kp_img[k, 1]:.0f})"
+                for k in range(len(kp_img))))
+            if args.out:
+                ann = draw_keypoints(orig, kp_img, visible=(conf > 0.2))
+                dst = _out_path(args.out, f, i, len(args.images))
+                Image.fromarray(ann).save(dst)
+                print(f"  annotated -> {dst}")
     elif args.cmd == "sample":
         import jax
 
@@ -305,6 +360,28 @@ def _detection_eval_loader(args, cfg, batch):
     loader = loader_cls(samples, batch, cfg.num_classes, cfg.image_size,
                         train=False)
     return task, loader, len(samples)
+
+
+def _class_names(args, cfg) -> list[str] | None:
+    """--names file, else VOC names for 20-class models, else None
+    (generic ``class N`` labels)."""
+    if getattr(args, "names", None):
+        with open(args.names) as f:
+            return [ln.strip() for ln in f if ln.strip()]
+    if cfg.num_classes == 20:
+        from deep_vision_tpu.data.prep import VOC_CLASSES
+
+        return list(VOC_CLASSES)
+    return None
+
+
+def _out_path(out: str, src: str, i: int, n: int) -> str:
+    """One input → ``out`` verbatim; several → stem-suffixed siblings."""
+    if n == 1:
+        return out
+    base, ext = os.path.splitext(out)
+    stem = os.path.splitext(os.path.basename(src))[0]
+    return f"{base}-{stem}{ext or '.jpg'}"
 
 
 def _save_grid(imgs, path, cols: int = 4):

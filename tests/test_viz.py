@@ -1,0 +1,95 @@
+"""Result drawing (VERDICT r2 missing #3): the demo-notebook role —
+`infer detect/pose --out annotated.jpg` turns an image into an annotated
+image (YOLO/tensorflow/demo_mscoco.ipynb,
+Hourglass/tensorflow/demo_hourglass_pose.ipynb)."""
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.viz import draw_detections, draw_keypoints
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+def test_draw_detections_marks_pixels():
+    img = np.zeros((200, 300, 3), np.uint8)
+    boxes = np.array([[0.1, 0.2, 0.5, 0.8], [0.6, 0.1, 0.9, 0.4]])
+    out = draw_detections(img, boxes, np.array([0.9, 0.4]),
+                          np.array([3, 7]),
+                          class_names=[f"c{i}" for i in range(20)])
+    assert out.shape == img.shape and out.dtype == np.uint8
+    assert (out != img).any(), "nothing drawn"
+    # box outline lands where the normalized corners say: column x1=0.1*300
+    x1 = int(0.1 * 300)
+    assert (out[int(0.2 * 200):int(0.8 * 200), x1] != 0).any()
+    # input not mutated
+    assert (img == 0).all()
+
+
+def test_draw_detections_respects_min_score():
+    img = np.zeros((64, 64, 3), np.uint8)
+    out = draw_detections(img, np.array([[0.2, 0.2, 0.8, 0.8]]),
+                          np.array([0.1]), np.array([0]), min_score=0.5)
+    assert (out == img).all(), "sub-threshold box drawn"
+
+
+def test_draw_keypoints_skeleton_and_visibility():
+    img = np.zeros((128, 128, 3), np.uint8)
+    kp = np.stack([np.linspace(10, 110, 16), np.linspace(10, 110, 16)], 1)
+    vis = np.ones(16)
+    out = draw_keypoints(img, kp, visible=vis)
+    assert (out != img).any()
+    # hidden joint draws nothing: isolate it (no skeleton) far from others
+    img2 = np.zeros((128, 128, 3), np.uint8)
+    kp2 = np.array([[20.0, 20.0], [100.0, 100.0]])
+    out2 = draw_keypoints(img2, kp2, visible=np.array([1.0, 0.0]),
+                          skeleton=())
+    assert (out2[95:106, 95:106] == 0).all(), "hidden joint drawn"
+    assert (out2[15:26, 15:26] != 0).any(), "visible joint missing"
+
+
+def test_infer_detect_writes_annotated_image(tmp_path):
+    """End-to-end CLI: random-init toy YOLO, threshold 0 → some boxes →
+    --out writes an annotated file (the one-command demo path)."""
+    from deep_vision_tpu.cli import infer
+
+    src = tmp_path / "scene.jpg"
+    rng = np.random.default_rng(0)
+    Image.fromarray(rng.integers(0, 255, (96, 128, 3), dtype=np.uint8)
+                    ).save(src)
+    out = tmp_path / "annotated.jpg"
+    infer.main(["detect", "-m", "yolov3_toy",
+                "--workdir", str(tmp_path / "w"),
+                "--images", str(src), "--score-threshold", "0.0",
+                "--out", str(out)])
+    assert out.exists()
+    assert Image.open(out).size == (128, 96)  # original resolution kept
+
+
+@pytest.mark.slow
+def test_infer_pose_writes_annotated_image(tmp_path):
+    from deep_vision_tpu.cli import infer
+    from deep_vision_tpu.core.config import TrainConfig, register_config
+    from deep_vision_tpu.core.optim import OptimizerConfig
+    from deep_vision_tpu.models.hourglass import StackedHourglass
+
+    import jax.numpy as jnp
+
+    register_config("hg_viz_toy")(lambda: TrainConfig(
+        name="hg_viz_toy",
+        model=lambda: StackedHourglass(num_stack=1, num_heatmap=16,
+                                       filters=16, dtype=jnp.float32),
+        task="pose", batch_size=2, total_epochs=1,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        image_size=64, num_classes=16, half_precision=False))
+    src = tmp_path / "person.jpg"
+    rng = np.random.default_rng(1)
+    Image.fromarray(rng.integers(0, 255, (80, 60, 3), dtype=np.uint8)
+                    ).save(src)
+    out = tmp_path / "pose.jpg"
+    infer.main(["pose", "-m", "hg_viz_toy",
+                "--workdir", str(tmp_path / "w"),
+                "--images", str(src), "--out", str(out)])
+    assert out.exists()
+    assert Image.open(out).size == (60, 80)
