@@ -7,7 +7,12 @@ PY ?= python
 DATA ?= ./data
 WORKDIR ?= ./runs
 
+# fast lane: excludes @slow (convergence / multi-epoch training) so it
+# stays runnable-in-minutes on a 1-core TPU-VM host; test-all runs everything
 test:
+	$(PY) -m pytest tests/ -q -m "not slow"
+
+test-all:
 	$(PY) -m pytest tests/ -q
 
 bench:
@@ -32,4 +37,4 @@ eval_%:
 list:
 	$(PY) -m deep_vision_tpu.cli.train --list -m x
 
-.PHONY: test bench list
+.PHONY: test test-all bench list

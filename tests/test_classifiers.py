@@ -67,6 +67,7 @@ def test_eval_forward_shape(ctor, size):
     assert out.dtype == jnp.float32
 
 
+@pytest.mark.slow
 def test_inception_v1_aux_heads_train_only():
     model = InceptionV1(num_classes=10)
     x = jax.random.normal(jax.random.PRNGKey(1), (2, 128, 128, 3))
@@ -79,6 +80,7 @@ def test_inception_v1_aux_heads_train_only():
     assert all(o.shape == (2, 10) for o in outs)
 
 
+@pytest.mark.slow
 def test_inception_v3_aux_head_train_only():
     model = InceptionV3(num_classes=10)
     x = jax.random.normal(jax.random.PRNGKey(1), (1, 299, 299, 3))

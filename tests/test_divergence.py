@@ -119,6 +119,7 @@ def test_guard_baseline_survives_resume(tmp_path, mesh1):
         guard.check({"bad_steps": 94})  # 4 new > limit 3
 
 
+@pytest.mark.slow
 def test_adversarial_guard_skips_nan(tmp_path, mesh1):
     """The multi-network guard: a NaN batch leaves ALL networks' params
     unchanged and counts one bad step."""

@@ -71,6 +71,7 @@ def test_dcgan_train_step_updates_both_models():
     assert not np.allclose(d0, d1)
 
 
+@pytest.mark.slow
 def test_cyclegan_train_step_four_networks():
     task = CycleGANTask(lambda: CycleGANGenerator(n_blocks=1),
                         lambda: PatchGANDiscriminator(), pool_size=4)
@@ -97,6 +98,7 @@ def test_cyclegan_train_step_four_networks():
     assert prepared2["pool_a2b"].shape == (2, 32, 32, 3)
 
 
+@pytest.mark.slow
 def test_adversarial_trainer_smoke(tmp_path):
     from deep_vision_tpu.core.adversarial import AdversarialTrainer
     from deep_vision_tpu.core.config import get_config

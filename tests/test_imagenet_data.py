@@ -132,6 +132,7 @@ def test_device_normalize_path_matches_host(fake_imagenet):
     np.testing.assert_allclose(out, hb["image"], atol=1e-5)
 
 
+@pytest.mark.slow
 def test_device_preprocess_trains(fake_imagenet, tmp_path, mesh1):
     """End-to-end: uint8 batches through Trainer(preprocess_fn=...) —
     the fused-device path the ImageNet CLI uses by default."""

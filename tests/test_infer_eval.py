@@ -7,6 +7,7 @@ import pytest
 from deep_vision_tpu.cli import infer, train
 
 
+@pytest.mark.slow
 def test_eval_classification_from_checkpoint(tmp_path, mesh1, capsys):
     wd = str(tmp_path / "run")
     rc = train.main(["-m", "lenet5", "--synthetic", "--synthetic-size", "128",

@@ -107,6 +107,7 @@ def test_resnet50_import_forward_parity():
     np.testing.assert_allclose(np.asarray(out), ref, atol=2e-4, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_merge_pretrained_without_head():
     """Fine-tune path: import the backbone, keep a fresh 5-way head."""
     gen = torch.Generator().manual_seed(1)

@@ -5,6 +5,7 @@ backend that works air-gapped."""
 import os
 
 import numpy as np
+import pytest
 
 from deep_vision_tpu.core.config import get_config
 from deep_vision_tpu.core.trainer import Trainer
@@ -57,6 +58,7 @@ def test_restore_dir_roundtrip(tmp_path):
     assert restore_dir(str(tmp_path / "nope"), str(back / "x")) == 0
 
 
+@pytest.mark.slow
 def test_trainer_restores_from_mirror_on_fresh_host(tmp_path, mesh1):
     """Preemption recovery: train + upload, wipe the workdir (the VM died),
     re-create the Trainer with the same upload URI → checkpoints come back
@@ -89,6 +91,7 @@ def test_trainer_restores_from_mirror_on_fresh_host(tmp_path, mesh1):
     assert os.listdir(dest / "checkpoints")
 
 
+@pytest.mark.slow
 def test_trainer_uploads_checkpoints(tmp_path, mesh1):
     """A run with upload=<uri> must land its rolling AND best checkpoints
     at the destination."""
