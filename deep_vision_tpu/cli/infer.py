@@ -89,6 +89,11 @@ def main(argv=None):
                            help="class-names file (one per line; default: "
                                 "VOC names for 20-class models)")
         if name == "eval":
+            s.add_argument("--pretrained", default=None,
+                           help="evaluate imported torch-format weights "
+                                "(.pth) instead of a workdir checkpoint — "
+                                "the import→eval harness; expected numbers "
+                                "per recipe: docs/ACCURACY.md")
             s.add_argument("--data-root", default=None,
                            help="dvrec shards (cli.prepare_data output), "
                                 "flat image dir, or MNIST idx dir")
@@ -278,7 +283,10 @@ def _cmd_eval(args, cfg):
         task, loader, n = _detection_eval_loader(args, cfg, batch)
     else:
         raise SystemExit(f"eval does not support task '{cfg.task}'")
-    model, state = _load_state(cfg, args.workdir)
+    if args.pretrained:
+        model, state = _load_pretrained_state(cfg, args)
+    else:
+        model, state = _load_state(cfg, args.workdir)
     trainer = Trainer(cfg, model, task, workdir=args.workdir)
     # the restored state lives on one device; eval batches shard over the
     # full mesh — replicate or the jit rejects the device mismatch
@@ -289,6 +297,44 @@ def _cmd_eval(args, cfg):
     print(f"eval[{args.split}] n={n} "
           + " ".join(f"{k}={v:.4f}" for k, v in sorted(metrics.items())))
     return 0
+
+
+def _load_pretrained_state(cfg, args):
+    """Fresh state + imported torch-format weights (the import→eval
+    harness, docs/ACCURACY.md): no checkpoint needed, so a user can verify
+    a published recipe's top-1/top-5 straight from its .pth file."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.core.optim import build_optimizer
+    from deep_vision_tpu.core.state import TrainState
+    from deep_vision_tpu.models.pretrained import (
+        STAGE_SIZES,
+        load_torch_checkpoint,
+        merge_pretrained,
+    )
+
+    if args.model not in STAGE_SIZES:
+        raise SystemExit(
+            f"--pretrained supports {sorted(STAGE_SIZES)} (torch-format "
+            f"V1 checkpoints); '{args.model}' has a different param tree")
+    model = cfg.model()
+    x = jnp.zeros((1, cfg.image_size, cfg.image_size, cfg.channels))
+    variables = jax.jit(functools.partial(model.init, train=False))(
+        {"params": jax.random.PRNGKey(0)}, x)
+    imported = load_torch_checkpoint(
+        args.pretrained, args.model, include_fc=cfg.num_classes == 1000)
+    merged = merge_pretrained(
+        {"params": variables["params"],
+         "batch_stats": variables.get("batch_stats", {})}, imported)
+    print(f"[eval] imported {args.model} weights from {args.pretrained}")
+    state = TrainState.create(
+        apply_fn=model.apply, params=merged["params"],
+        tx=build_optimizer(cfg.optimizer),
+        batch_stats=merged["batch_stats"])
+    return model, state
 
 
 def _classification_eval_loader(args, cfg, batch):

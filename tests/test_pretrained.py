@@ -132,6 +132,31 @@ def test_merge_pretrained_without_head():
     assert out.shape == (1, 5)
 
 
+def test_eval_pretrained_harness(tmp_path, capsys):
+    """The import→eval harness (docs/ACCURACY.md): `infer eval
+    --pretrained x.pth` must run a full evaluation from a torch-format
+    checkpoint with no workdir checkpoint — the command a user points at
+    real ImageNet val to verify the published numbers."""
+    from deep_vision_tpu.cli import infer
+    from deep_vision_tpu.core.config import get_config
+
+    gen = torch.Generator().manual_seed(3)
+    with torch.no_grad():
+        net = TorchResNet50(num_classes=get_config("resnet50").num_classes)
+        for p in net.parameters():
+            p.copy_(torch.randn(p.shape, generator=gen) * 0.05)
+        net.eval()
+    pth = tmp_path / "w.pth"
+    torch.save(net.state_dict(), pth)
+
+    infer.main(["eval", "-m", "resnet50", "--workdir", str(tmp_path / "w"),
+                "--pretrained", str(pth), "--synthetic",
+                "--synthetic-size", "8", "--batch-size", "8"])
+    out = capsys.readouterr().out
+    assert "imported resnet50 weights" in out
+    assert "top1=" in out and "eval[" in out
+
+
 def test_import_rejects_wrong_shape():
     gen = torch.Generator().manual_seed(2)
     with torch.no_grad():
