@@ -142,7 +142,7 @@ _FDS: dict = {}
 _FDS_MAX = 64
 
 
-def _pread(path: str, off: int, length: int) -> bytes:
+def _get_fd(path: str):
     f = _FDS.get(path)
     if f is None:
         while len(_FDS) >= _FDS_MAX:
@@ -153,6 +153,11 @@ def _pread(path: str, off: int, length: int) -> bytes:
         f = _FDS[path] = open(path, "rb")
     else:  # move-to-end on hit → LRU order holds under round-robin reads
         _FDS[path] = _FDS.pop(path)
+    return f
+
+
+def _pread(path: str, off: int, length: int) -> bytes:
+    f = _get_fd(path)
     f.seek(off)
     return f.read(length)
 
@@ -353,6 +358,79 @@ class ImageNetLoader:
             batch["weight"] = weight
         return batch
 
+    def _native_batch(self, args, n_real) -> dict | None:
+        """Whole-batch assembly through the C++ reader (data/native):
+        positioned reads + crop + flip fused into one call, RNG-exact with
+        the Python path (same per-item Generator draw order).  Returns
+        None — caller falls back — unless every item is a raw payload at
+        the loader's resize on the device-normalize path and the native
+        library is available."""
+        if not self._cfg.get("device_normalize") or "entries" not in self._cfg:
+            return None
+        from deep_vision_tpu.data.native import load as load_native
+
+        lib = load_native()
+        if lib is None:
+            return None
+        import ctypes
+
+        entries = self._cfg["entries"]
+        size, resize = self.image_size, self.resize
+        n = len(args)
+        fds = np.empty(n, np.int32)
+        offs = np.empty(n, np.int64)
+        hs = np.empty(n, np.int32)
+        ws = np.empty(n, np.int32)
+        tops = np.empty(n, np.int32)
+        lefts = np.empty(n, np.int32)
+        flips = np.zeros(n, np.uint8)
+        labels = np.empty(n, np.int32)
+        max_payload = 0
+        for j, (i, seed) in enumerate(args):
+            path, off, plen, shape = entries[i]
+            if shape is None:
+                return None  # JPEG payload: decode path handles it
+            h, w = int(shape[0]), int(shape[1])
+            if min(h, w) != resize or h < size or w < size:
+                return None  # stored at a different resize: rescale needed
+            if self.train:
+                # EXACT draw order of train_transform_u8: flip, then
+                # crop top, then crop left, from default_rng(seed)
+                r = np.random.default_rng(seed)
+                flips[j] = r.random() < 0.5
+                tops[j] = r.integers(0, h - size + 1)
+                lefts[j] = r.integers(0, w - size + 1)
+            else:
+                tops[j] = (h - size) // 2
+                lefts[j] = (w - size) // 2
+            fds[j] = _get_fd(path).fileno()
+            offs[j] = off
+            hs[j], ws[j] = h, w
+            labels[j] = self._cfg["labels"][i]
+            max_payload = max(max_payload, plen)
+        out = np.empty((n, size, size, 3), np.uint8)
+        if getattr(self, "_scratch", None) is None or \
+                len(self._scratch) < max_payload:
+            self._scratch = np.empty(max_payload, np.uint8)
+
+        def p(a, t):
+            return a.ctypes.data_as(ctypes.POINTER(t))
+
+        rc = lib.dvrec_assemble_batch(
+            p(fds, ctypes.c_int32), p(offs, ctypes.c_int64),
+            p(hs, ctypes.c_int32), p(ws, ctypes.c_int32),
+            p(tops, ctypes.c_int32), p(lefts, ctypes.c_int32),
+            p(flips, ctypes.c_uint8), n, size,
+            p(out, ctypes.c_uint8), p(self._scratch, ctypes.c_uint8))
+        if rc != 0:
+            return None  # short read etc. — let the Python path report
+        batch = {"image": out, "label": labels}
+        if not self.train:
+            weight = np.zeros(self.batch_size, np.float32)
+            weight[:n_real] = 1.0
+            batch["weight"] = weight
+        return batch
+
     def __iter__(self) -> Iterator[dict]:
         from collections import deque
 
@@ -369,8 +447,11 @@ class ImageNetLoader:
         if self._pool is None:
             for b in range(n_batches):
                 args, n_real = self._batch_args(idx, seeds, b)
-                yield self._assemble([_load_one(self._cfg, *a) for a in args],
-                                     n_real)
+                batch = self._native_batch(args, n_real)
+                if batch is None:
+                    batch = self._assemble(
+                        [_load_one(self._cfg, *a) for a in args], n_real)
+                yield batch
             return
         # overlapped decode: keep `prefetch_batches` async batches in flight
         # so workers decode batch N+1..N+k while the chip trains on batch N

@@ -1,0 +1,87 @@
+"""ctypes loader for the native dvrec reader (dvrec_reader.cc).
+
+Compiles the shared object on first use with the system C++ toolchain
+(g++/cc) into ``~/.cache/deep_vision_tpu`` (keyed by source hash, so
+edits rebuild automatically) and exposes the two entry points.  Every
+caller must treat ``load() is None`` as "no toolchain" and keep the
+numpy fallback — the native path is an accelerator, not a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+
+_SRC = os.path.join(os.path.dirname(__file__), "dvrec_reader.cc")
+_LIB = None
+_TRIED = False
+
+
+def _build() -> str | None:
+    with open(_SRC, "rb") as f:
+        tag = hashlib.sha256(f.read()).hexdigest()[:16]
+    cache = os.environ.get(
+        "DEEP_VISION_TPU_CACHE",
+        os.path.join(os.path.expanduser("~"), ".cache", "deep_vision_tpu"))
+    out = os.path.join(cache, f"dvrec_reader_{tag}.so")
+    if os.path.exists(out):
+        return out
+    cxx = os.environ.get("CXX") or shutil.which("g++") or shutil.which("c++")
+    if cxx is None:
+        return None
+    os.makedirs(cache, exist_ok=True)
+    tmp = out + f".tmp{os.getpid()}"
+    try:
+        subprocess.run(
+            [cxx, "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+            check=True, capture_output=True, timeout=120)
+        os.replace(tmp, out)  # atomic: concurrent builders race safely
+        return out
+    except Exception:
+        if os.path.exists(tmp):
+            os.remove(tmp)
+        return None
+
+
+def load() -> ctypes.CDLL | None:
+    """The compiled library, or None when no toolchain is available."""
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    if os.environ.get("DEEP_VISION_TPU_NO_NATIVE"):
+        return None
+    path = _build()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.dvrec_assemble_batch.restype = ctypes.c_int32
+        lib.dvrec_assemble_batch.argtypes = [
+            ctypes.POINTER(ctypes.c_int32),   # fds
+            ctypes.POINTER(ctypes.c_int64),   # offsets
+            ctypes.POINTER(ctypes.c_int32),   # heights
+            ctypes.POINTER(ctypes.c_int32),   # widths
+            ctypes.POINTER(ctypes.c_int32),   # tops
+            ctypes.POINTER(ctypes.c_int32),   # lefts
+            ctypes.POINTER(ctypes.c_uint8),   # flips
+            ctypes.c_int32,                   # n
+            ctypes.c_int32,                   # crop
+            ctypes.POINTER(ctypes.c_uint8),   # out
+            ctypes.POINTER(ctypes.c_uint8),   # scratch
+        ]
+        lib.dvrec_scan_shard.restype = ctypes.c_int64
+        lib.dvrec_scan_shard.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+        ]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB

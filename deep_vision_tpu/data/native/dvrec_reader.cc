@@ -1,0 +1,106 @@
+// Native half of the raw-dvrec read path (SURVEY §7 hard-part 1: feed the
+// chip from one host core).  The role the reference's data loaders get from
+// torch/TF's C++ internals (ResNet/pytorch/train.py:229-234 DataLoader
+// workers), done dvrec-native: one call assembles a whole training batch —
+// positioned reads straight from the shard files, crop + horizontal flip
+// fused into the copy into the caller's preallocated (B, S, S, 3) buffer.
+// No decode (payloads are raw uint8 from `prepare_data --store raw`), no
+// per-image Python, no intermediate copies.
+//
+// Built by data/native/__init__.py with the system C++ toolchain (g++ via
+// cc) into a shared object loaded with ctypes; the Python path remains the
+// fallback wherever a toolchain is missing.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Assemble one batch of crops from raw-uint8 dvrec payloads.
+//   fds:        per-item open file descriptors (shard files)
+//   offsets:    per-item payload byte offsets
+//   heights/widths: per-item stored image dims (channels fixed at 3)
+//   tops/lefts: per-item crop origin (in the flipped image when flip=1)
+//   flips:      per-item horizontal-flip flag
+//   crop:       output square side S
+//   out:        (n, S, S, 3) uint8, C-contiguous
+//   scratch:    caller-provided buffer of at least max_payload bytes
+// Returns 0 on success, -(i+1) if the read for item i failed.
+int dvrec_assemble_batch(const int32_t* fds, const int64_t* offsets,
+                         const int32_t* heights, const int32_t* widths,
+                         const int32_t* tops, const int32_t* lefts,
+                         const uint8_t* flips, int32_t n, int32_t crop,
+                         uint8_t* out, uint8_t* scratch) {
+  const int64_t row_out = static_cast<int64_t>(crop) * 3;
+  for (int32_t i = 0; i < n; ++i) {
+    const int64_t h = heights[i], w = widths[i];
+    const int64_t payload = h * w * 3;
+    int64_t done = 0;
+    while (done < payload) {
+      ssize_t got = pread(fds[i], scratch + done, payload - done,
+                          offsets[i] + done);
+      if (got <= 0) return -(i + 1);
+      done += got;
+    }
+    uint8_t* dst = out + static_cast<int64_t>(i) * crop * row_out;
+    const int64_t top = tops[i], left = lefts[i];
+    if (!flips[i]) {
+      for (int64_t r = 0; r < crop; ++r) {
+        const uint8_t* src = scratch + ((top + r) * w + left) * 3;
+        memcpy(dst + r * row_out, src, row_out);
+      }
+    } else {
+      // crop coordinates address the FLIPPED image (matching
+      // transforms.train_transform_u8: flip THEN crop): flipped column
+      // left+c maps to stored column w-1-(left+c)
+      for (int64_t r = 0; r < crop; ++r) {
+        const uint8_t* src_row = scratch + (top + r) * w * 3;
+        uint8_t* dst_row = dst + r * row_out;
+        for (int64_t c = 0; c < crop; ++c) {
+          const uint8_t* px = src_row + (w - 1 - left - c) * 3;
+          dst_row[c * 3 + 0] = px[0];
+          dst_row[c * 3 + 1] = px[1];
+          dst_row[c * 3 + 2] = px[2];
+        }
+      }
+    }
+  }
+  return 0;
+}
+
+// Scan a dvrec shard's record framing without parsing JSON:
+// fills (offset, header_len, payload_len) triples so Python touches each
+// header once and seeks past payloads for free. Returns record count, or
+// -1 on open failure, -2 on truncated framing, -(3) if caps exceeded.
+int64_t dvrec_scan_shard(const char* path, int64_t* offsets,
+                         int64_t* header_lens, int64_t* payload_lens,
+                         int64_t cap) {
+  int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  int64_t n = 0, pos = 0;
+  unsigned char u32[4];
+  while (true) {
+    ssize_t got = pread(fd, u32, 4, pos);
+    if (got == 0) break;  // clean EOF
+    if (got != 4) { close(fd); return -2; }
+    const int64_t hlen = u32[0] | (u32[1] << 8) | (u32[2] << 16) |
+                         (static_cast<int64_t>(u32[3]) << 24);
+    if (pread(fd, u32, 4, pos + 4 + hlen) != 4) { close(fd); return -2; }
+    const int64_t plen = u32[0] | (u32[1] << 8) | (u32[2] << 16) |
+                         (static_cast<int64_t>(u32[3]) << 24);
+    if (n >= cap) { close(fd); return -3; }
+    offsets[n] = pos + 4;         // header start
+    header_lens[n] = hlen;
+    payload_lens[n] = plen;
+    ++n;
+    pos += 8 + hlen + plen;
+  }
+  close(fd);
+  return n;
+}
+
+}  // extern "C"

@@ -328,6 +328,45 @@ def test_raw_record_loader_train_and_eval_len(fake_imagenet, tmp_path):
     assert len(ev) == len(list(ev)) == 5  # 18 → 4 full + 1 padded
 
 
+def test_native_reader_matches_python_path(fake_imagenet, tmp_path,
+                                           monkeypatch):
+    """The C++ batch assembler (data/native/dvrec_reader.cc) must be
+    BIT-EXACT with the Python read path — same per-item RNG draw order
+    (flip, crop top, crop left), same crops, train and eval — so turning
+    it on cannot change a training trajectory."""
+    from deep_vision_tpu.data import native, prep
+
+    if native.load() is None:
+        pytest.skip("no C++ toolchain")
+    root, labels = fake_imagenet
+    out = str(tmp_path / "recs_raw")
+    prep.prepare_imagenet(root, labels, out, "train", num_shards=2,
+                          num_workers=1, store="raw", resize=40)
+
+    def batches(train):
+        loader = ImageNetLoader.from_records(
+            out, "train", batch_size=4, train=train, image_size=32,
+            resize=40, num_workers=0, process_index=0, process_count=1,
+            device_normalize=True, seed=7)
+        return list(loader)
+
+    native_train = batches(True)
+    native_eval = batches(False)
+    assert any(b["image"].flags["C_CONTIGUOUS"] for b in native_train)
+    # force the pure-Python path and compare byte-for-byte
+    monkeypatch.setattr(
+        "deep_vision_tpu.data.imagenet.ImageNetLoader._native_batch",
+        lambda self, args, n_real: None)
+    py_train = batches(True)
+    py_eval = batches(False)
+    assert len(native_train) == len(py_train) > 0
+    for nb, pb in zip(native_train + native_eval, py_train + py_eval):
+        np.testing.assert_array_equal(nb["label"], pb["label"])
+        np.testing.assert_array_equal(nb["image"], pb["image"])
+        if "weight" in pb:
+            np.testing.assert_array_equal(nb["weight"], pb["weight"])
+
+
 def test_record_loader_multiprocess(fake_imagenet, tmp_path):
     from deep_vision_tpu.data import prep
 
