@@ -2,9 +2,9 @@
 
 Compiles the shared object on first use with the system C++ toolchain
 (g++/cc) into ``~/.cache/deep_vision_tpu`` (keyed by source hash, so
-edits rebuild automatically) and exposes the two entry points.  Every
-caller must treat ``load() is None`` as "no toolchain" and keep the
-numpy fallback — the native path is an accelerator, not a dependency.
+edits rebuild automatically) and exposes the entry point.  Every caller
+must treat ``load() is None`` as "no toolchain" and keep the numpy
+fallback — the native path is an accelerator, not a dependency.
 """
 
 from __future__ import annotations
@@ -72,14 +72,6 @@ def load() -> ctypes.CDLL | None:
             ctypes.c_int32,                   # crop
             ctypes.POINTER(ctypes.c_uint8),   # out
             ctypes.POINTER(ctypes.c_uint8),   # scratch
-        ]
-        lib.dvrec_scan_shard.restype = ctypes.c_int64
-        lib.dvrec_scan_shard.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.POINTER(ctypes.c_int64),
-            ctypes.c_int64,
         ]
         _LIB = lib
     except OSError:

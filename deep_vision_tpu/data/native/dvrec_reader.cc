@@ -5,7 +5,7 @@
 // positioned reads straight from the shard files, crop + horizontal flip
 // fused into the copy into the caller's preallocated (B, S, S, 3) buffer.
 // No decode (payloads are raw uint8 from `prepare_data --store raw`), no
-// per-image Python, no intermediate copies.
+// per-image Python, no intermediate copies.  One entry point, one job.
 //
 // Built by data/native/__init__.py with the system C++ toolchain (g++ via
 // cc) into a shared object loaded with ctypes; the Python path remains the
@@ -70,37 +70,6 @@ int dvrec_assemble_batch(const int32_t* fds, const int64_t* offsets,
     }
   }
   return 0;
-}
-
-// Scan a dvrec shard's record framing without parsing JSON:
-// fills (offset, header_len, payload_len) triples so Python touches each
-// header once and seeks past payloads for free. Returns record count, or
-// -1 on open failure, -2 on truncated framing, -(3) if caps exceeded.
-int64_t dvrec_scan_shard(const char* path, int64_t* offsets,
-                         int64_t* header_lens, int64_t* payload_lens,
-                         int64_t cap) {
-  int fd = open(path, O_RDONLY);
-  if (fd < 0) return -1;
-  int64_t n = 0, pos = 0;
-  unsigned char u32[4];
-  while (true) {
-    ssize_t got = pread(fd, u32, 4, pos);
-    if (got == 0) break;  // clean EOF
-    if (got != 4) { close(fd); return -2; }
-    const int64_t hlen = u32[0] | (u32[1] << 8) | (u32[2] << 16) |
-                         (static_cast<int64_t>(u32[3]) << 24);
-    if (pread(fd, u32, 4, pos + 4 + hlen) != 4) { close(fd); return -2; }
-    const int64_t plen = u32[0] | (u32[1] << 8) | (u32[2] << 16) |
-                         (static_cast<int64_t>(u32[3]) << 24);
-    if (n >= cap) { close(fd); return -3; }
-    offsets[n] = pos + 4;         // header start
-    header_lens[n] = hlen;
-    payload_lens[n] = plen;
-    ++n;
-    pos += 8 + hlen + plen;
-  }
-  close(fd);
-  return n;
 }
 
 }  // extern "C"
