@@ -15,6 +15,8 @@ Modes:
     python bench.py --pipeline   # host input-pipeline throughput (JPEG
                                  # decode+augment through ImageNetLoader)
     python bench.py --profile    # also write a jax.profiler trace
+    python bench.py --task yolo  # one task's train step at production shape
+    python bench.py --all        # every task, one subprocess each
 """
 
 from __future__ import annotations
@@ -107,15 +109,9 @@ def bench_train_step(batch: int = 256, size: int = 224, steps: int = 20,
     # constants) comes from XLA's cost analysis of the SINGLE-step
     # executable — the scan executable reports its loop body only once
     # regardless of trip count, so it can't be used directly.
-    step_flops = None
-    try:
-        cost = jax.jit(one_step).lower(state, x, y).compile().cost_analysis()
-        if cost:
-            ca = cost[0] if isinstance(cost, (list, tuple)) else cost
-            step_flops = float(ca.get("flops", 0.0)) or None
-    except Exception:
-        pass
+    step_flops = _cost_flops(jax.jit(one_step).lower(state, x, y).compile())
     compiled = train_block.lower(state, x, y).compile()
+    hbm_gib = _hbm_gib(compiled)
 
     # warmup (device_get, not block_until_ready: the latter can return
     # early through the axon tunnel)
@@ -154,7 +150,233 @@ def bench_train_step(batch: int = 256, size: int = 224, steps: int = 20,
         out["device_kind"] = jax.devices()[0].device_kind
         out["batch"] = batch
         out["scan_steps"] = K
+    if hbm_gib:
+        out["hbm_gib"] = hbm_gib
     return out
+
+
+def _peak_hbm_gib() -> float | None:
+    """Process-lifetime peak device-memory use, GiB (per-model when each
+    task bench runs in its own process — what ``--all`` does).  Returns
+    None where the runtime doesn't expose allocator stats (the tunneled
+    axon client does not)."""
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        peak = stats.get("peak_bytes_in_use")
+        return round(peak / 2**30, 2) if peak else None
+    except Exception:
+        return None
+
+
+def _hbm_gib(compiled) -> float | None:
+    """Static HBM footprint of one executable from XLA's own memory
+    analysis: live arguments + outputs (minus donated aliases) + compiler
+    temp arena.  Available even when allocator stats are not."""
+    try:
+        ma = compiled.memory_analysis()
+        b = (ma.argument_size_in_bytes + ma.output_size_in_bytes
+             - ma.alias_size_in_bytes + ma.temp_size_in_bytes)
+        return round(b / 2**30, 2) if b else None
+    except Exception:
+        return None
+
+
+def _cost_flops(compiled) -> float | None:
+    """FLOPs of one executable per XLA's cost analysis (honest MFU
+    numerator — no hand-derived constants)."""
+    try:
+        cost = compiled.cost_analysis()
+        ca = cost[0] if isinstance(cost, (list, tuple)) else cost
+        return float(ca.get("flops", 0.0)) or None
+    except Exception:
+        return None
+
+
+def _finish(out: dict, compiled, dt: float, n_steps: int, batch_size: int,
+            baseline: float | None = None) -> None:
+    """Shared result assembly for the task benches."""
+    rate = n_steps * batch_size / dt
+    out["value"] = round(rate, 1)
+    if baseline:
+        out["vs_baseline"] = round(rate / baseline, 2)
+    step_flops = _cost_flops(compiled)
+    if step_flops:
+        out["tflops_per_chip"] = round(step_flops * n_steps / dt / 1e12, 1)
+    hbm = _hbm_gib(compiled)
+    if hbm:
+        out["hbm_gib"] = hbm
+    out["ms_per_step"] = round(dt / n_steps * 1e3, 1)
+    out["batch"] = batch_size
+
+
+def _time_step(compiled, args, steps: int, loss_of):
+    """Warm once, then time ``steps`` sequential dispatches, draining the
+    async chain through a scalar fetch (block_until_ready can return early
+    through the axon tunnel)."""
+    out = compiled(*args)
+    float(jax.device_get(loss_of(out)))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = compiled(*(out[:1] + args[1:]))
+    float(jax.device_get(loss_of(out)))
+    return time.perf_counter() - t0
+
+
+def bench_task(name: str, steps: int | None = None) -> dict:
+    """Train-step throughput for one non-classification task at the
+    REFERENCE's production shapes (VERDICT r02 item 4):
+
+    - ``yolo``       YOLOv3-Darknet53 416², per-chip batch 16 (the
+                     reference's per-GPU batch, YOLO/tensorflow/train.py:282)
+    - ``hourglass``  Stacked Hourglass-104 256² batch 16, 16 joints @64²
+    - ``cyclegan``   ResNet-9 G ×2 + PatchGAN D ×2, 256² batch 1
+                     (CycleGAN/tensorflow/train.py batch_size=1)
+    - ``dcgan``      28²×1 MNIST GAN, batch 256 (DCGAN/tensorflow/main.py)
+
+    Each model trains bf16-compute / f32-params like the ResNet bench; the
+    step is the same math the Trainer/AdversarialTrainer jits.  Reports
+    images/sec/chip and process-peak HBM.
+    """
+    import numpy as np
+
+    from deep_vision_tpu.core.optim import OptimizerConfig, build_optimizer
+    from deep_vision_tpu.core.state import TrainState
+
+    rng = jax.random.PRNGKey(0)
+    out: dict = {"metric": f"{name}_train_images_per_sec_per_chip",
+                 "unit": "images/sec/chip"}
+
+    def single_state_run(model, task, batch, opt, n_steps, batch_size,
+                         baseline=None):
+        variables = jax.jit(functools.partial(model.init, train=False))(
+            {"params": rng}, batch["image"][:1])
+        state = TrainState.create(
+            apply_fn=model.apply, params=variables["params"],
+            tx=build_optimizer(opt),
+            batch_stats=variables.get("batch_stats", {}), rng=rng)
+
+        def one_step(state, batch):
+            def loss_fn(params):
+                outputs, new_vars = state.apply_fn(
+                    {"params": params, "batch_stats": state.batch_stats},
+                    batch["image"], train=True, mutable=["batch_stats"])
+                loss, _ = task.loss(outputs, batch)
+                return loss, new_vars["batch_stats"]
+
+            (loss, bs), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(state.params)
+            return state.apply_gradients(grads, batch_stats=bs), loss
+
+        compiled = jax.jit(one_step, donate_argnums=0).lower(
+            state, batch).compile()
+        dt = _time_step(compiled, (state, batch), n_steps, lambda o: o[1])
+        _finish(out, compiled, dt, n_steps, batch_size, baseline)
+
+    if name == "yolo":
+        from deep_vision_tpu.models.yolo import YoloV3
+        from deep_vision_tpu.tasks.detection import MAX_BOXES, YoloTask
+
+        B, S = 16, 416
+        npr = np.random.default_rng(0)
+        batch = {"image": jnp.asarray(
+                     npr.normal(size=(B, S, S, 3)).astype(np.float32)),
+                 "boxes": jnp.asarray(np.clip(
+                     npr.uniform(0, 1, (B, MAX_BOXES, 4)), 0, 1)
+                     .astype(np.float32)),
+                 "boxes_mask": jnp.asarray(
+                     (np.arange(MAX_BOXES) < 8)[None]
+                     .repeat(B, 0).astype(np.float32))}
+        for s, g in enumerate((52, 26, 13)):
+            y = np.zeros((B, g, g, 3, 85), np.float32)
+            # a few positive cells so every loss branch executes
+            y[:, g // 2, g // 2, 0, 0:4] = (0.5, 0.5, 0.1, 0.1)
+            y[:, g // 2, g // 2, 0, 4] = 1.0
+            y[:, g // 2, g // 2, 0, 5] = 1.0
+            batch[f"y_true_{s}"] = jnp.asarray(y)
+        # reference: ~180 img/s aggregate on 8×V100 ⇒ 22.5 img/s/chip
+        single_state_run(
+            YoloV3(num_classes=80, dtype=jnp.bfloat16), YoloTask(80), batch,
+            OptimizerConfig(name="sgd", learning_rate=1e-3, momentum=0.9),
+            steps or 20, B, baseline=22.5)
+    elif name == "hourglass":
+        from deep_vision_tpu.models.hourglass import StackedHourglass
+        from deep_vision_tpu.tasks.pose import PoseTask
+
+        B = 16
+        batch = {"image": jax.random.normal(rng, (B, 256, 256, 3)),
+                 "heatmaps": jnp.clip(
+                     jax.random.normal(rng, (B, 64, 64, 16)), 0, 1)}
+        single_state_run(
+            StackedHourglass(num_stack=4, num_heatmap=16,
+                             dtype=jnp.bfloat16),
+            PoseTask(), batch,
+            OptimizerConfig(name="adam", learning_rate=2.5e-4),
+            steps or 20, B)
+    elif name in ("cyclegan", "dcgan"):
+        if name == "cyclegan":
+            from deep_vision_tpu.models import gan as gan_models
+            from deep_vision_tpu.tasks.gan import CycleGANTask
+
+            B = 1
+            task = CycleGANTask(
+                lambda: gan_models.CycleGANGenerator(dtype=jnp.bfloat16),
+                lambda: gan_models.PatchGANDiscriminator(
+                    dtype=jnp.bfloat16))
+            host = {"image_a": np.random.default_rng(0).normal(
+                        size=(B, 256, 256, 3)).astype(np.float32),
+                    "image_b": np.random.default_rng(1).normal(
+                        size=(B, 256, 256, 3)).astype(np.float32)}
+            n_steps = steps or 40
+        else:
+            from deep_vision_tpu.models.gan import (DCGANDiscriminator,
+                                                    DCGANGenerator)
+            from deep_vision_tpu.tasks.gan import DCGANTask
+
+            B = 256
+            task = DCGANTask(DCGANGenerator(dtype=jnp.bfloat16),
+                             DCGANDiscriminator(dtype=jnp.bfloat16))
+            host = {"image": np.random.default_rng(0).normal(
+                size=(B, 28, 28, 1)).astype(np.float32)}
+            n_steps = steps or 200
+        states = task.init_states(rng, host)
+        batch = jax.tree_util.tree_map(
+            jnp.asarray, task.host_prepare(dict(host)))
+        compiled = jax.jit(task.train_step, donate_argnums=0).lower(
+            states, batch, rng).compile()
+        dt = _time_step(compiled, (states, batch, rng), n_steps,
+                        lambda o: next(iter(o[2].values())))
+        _finish(out, compiled, dt, n_steps, B)
+    else:
+        raise SystemExit(f"unknown --task {name}")
+    peak = _peak_hbm_gib()
+    if peak:
+        out["peak_hbm_gib"] = peak
+    out["device_kind"] = jax.devices()[0].device_kind
+    return out
+
+
+def bench_all() -> list[dict]:
+    """Run every task bench in its own subprocess (fresh process ⇒
+    per-model peak-HBM stats and no cross-compile interference)."""
+    import subprocess
+    import sys
+
+    results, failed = [], []
+    for task in ("resnet50", "yolo", "hourglass", "cyclegan", "dcgan"):
+        cmd = [sys.executable, __file__] + (
+            [] if task == "resnet50" else ["--task", task])
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        line = next((ln for ln in reversed(proc.stdout.splitlines())
+                     if ln.startswith("{")), None)
+        if line is None:
+            failed.append(task)
+            print(f"# {task} FAILED:\n{proc.stderr[-2000:]}", flush=True)
+            continue
+        results.append(json.loads(line))
+        print(line, flush=True)
+    if failed:
+        raise SystemExit(f"task benches failed: {', '.join(failed)}")
+    return results
 
 
 def bench_pipeline(num_workers: int = 16, batch: int = 256,
@@ -247,9 +469,10 @@ def main():
                    help="measure host input-pipeline throughput instead")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--batch", type=int, default=256)
-    p.add_argument("--steps", type=int, default=80,
-                   help="total train steps to time (rounded down to whole "
-                        "scan blocks)")
+    p.add_argument("--steps", type=int, default=None,
+                   help="total train steps to time (default: 80 for the "
+                        "ResNet bench, rounded down to whole scan blocks; "
+                        "per-task defaults for --task)")
     p.add_argument("--scan-steps", type=int, default=40,
                    help="steps per device dispatch (1 = per-step dispatch)")
     p.add_argument("--num-workers", type=int, default=None,
@@ -259,7 +482,20 @@ def main():
     p.add_argument("--host-normalize", action="store_true")
     p.add_argument("--source", choices=("raw", "records", "folder"),
                    default="raw", help="--pipeline storage variant")
+    p.add_argument("--task", choices=("yolo", "hourglass", "cyclegan",
+                                      "dcgan"), default=None,
+                   help="bench one non-classification task's train step at "
+                        "its reference production shape")
+    p.add_argument("--all", action="store_true",
+                   help="bench every task (one subprocess each; one JSON "
+                        "line per task)")
     args = p.parse_args()
+    if args.all:
+        bench_all()
+        return
+    if args.task:
+        print(json.dumps(bench_task(args.task, steps=args.steps)))
+        return
     if args.pipeline:
         nw = args.num_workers if args.num_workers is not None \
             else (0 if args.source == "raw" else 16)
@@ -267,7 +503,7 @@ def main():
                              device_normalize=not args.host_normalize,
                              source=args.source)
     else:
-        out = bench_train_step(batch=args.batch, steps=args.steps,
+        out = bench_train_step(batch=args.batch, steps=args.steps or 80,
                                profile=args.profile,
                                scan_steps=args.scan_steps)
     print(json.dumps(out))
