@@ -24,48 +24,83 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deep_vision_tpu.parallel.mesh import SPATIAL_AXIS  # single source
 
 
-def halo_exchange(x, halo: int, axis_name: str = SPATIAL_AXIS):
-    """Per-shard (B, H_shard, W, C) → (B, H_shard + 2·halo, W, C).
+def _same_pad(dim: int, k: int, s: int) -> tuple[int, int]:
+    """XLA's SAME padding split (low, high) for one dimension: total
+    padding so out = ceil(dim/s), remainder goes to the high side."""
+    total = max((-(-dim // s) - 1) * s + k - dim, 0)
+    return total // 2, total - total // 2
 
-    Neighbour rows arrive via two ring ppermutes; the outermost shards get
-    zero rows instead (SAME zero-padding semantics at the true image edge).
+
+def halo_exchange(x, halo: int, halo_bottom: int | None = None,
+                  axis_name: str = SPATIAL_AXIS):
+    """Per-shard (B, H_shard, W, C) → (B, top + H_shard + bottom, W, C).
+
+    ``halo`` rows arrive from the shard above and ``halo_bottom``
+    (default: same) from the shard below, via two ring ppermutes; the
+    outermost shards get zero rows instead (SAME zero-padding semantics
+    at the true image edge).  Asymmetric halos are what SAME-under-stride
+    requires (XLA puts the odd padding row on the high side).
     """
+    top = halo
+    bottom = halo if halo_bottom is None else halo_bottom
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
-    top_rows = x[:, :halo]     # my first rows → neighbour above's bottom halo
-    bot_rows = x[:, -halo:]    # my last rows → neighbour below's top halo
     fwd = [(i, (i + 1) % n) for i in range(n)]
     bwd = [(i, (i - 1) % n) for i in range(n)]
-    from_above = jax.lax.ppermute(bot_rows, axis_name, fwd)  # shard i-1's tail
-    from_below = jax.lax.ppermute(top_rows, axis_name, bwd)  # shard i+1's head
-    from_above = jnp.where(idx == 0, jnp.zeros_like(from_above), from_above)
-    from_below = jnp.where(idx == n - 1, jnp.zeros_like(from_below),
-                           from_below)
-    return jnp.concatenate([from_above, x, from_below], axis=1)
+    parts = []
+    if top:
+        bot_rows = x[:, -top:]   # my last rows → neighbour below's top halo
+        from_above = jax.lax.ppermute(bot_rows, axis_name, fwd)
+        parts.append(jnp.where(idx == 0, jnp.zeros_like(from_above),
+                               from_above))
+    parts.append(x)
+    if bottom:
+        top_rows = x[:, :bottom]  # my first rows → neighbour above's bottom
+        from_below = jax.lax.ppermute(top_rows, axis_name, bwd)
+        parts.append(jnp.where(idx == n - 1, jnp.zeros_like(from_below),
+                               from_below))
+    return jnp.concatenate(parts, axis=1) if len(parts) > 1 else x
 
 
 def spatial_conv(x, kernel, mesh: Mesh, strides=(1, 1)):
-    """Stride-1 SAME conv2d with x row-sharded over the ``spatial`` axis.
+    """SAME conv2d with x row-sharded over the ``spatial`` axis.
 
     x: GLOBAL (B, H, W, Cin) array (sharded or not — it is device_put to
     P(None, "spatial")); kernel: (kh, kw, Cin, Cout) replicated.  Returns
     the global result, identical to an unsharded SAME conv.
 
-    Strided convs are rejected: XLA's SAME rule pads asymmetrically under
-    stride, which a symmetric halo cannot reproduce — downsample with a
-    stride-1 halo conv followed by pooling, or reshard first.
+    Strides are supported by mapping XLA's asymmetric SAME-under-stride
+    padding onto an asymmetric halo: each shard fetches ``pad_top`` rows
+    from above and ``pad_bottom`` from below, then runs a VALID strided
+    conv on its slab — output rows land exactly on this shard's slice of
+    the global output.  Requires the per-shard row count to be a multiple
+    of the row stride (so shard boundaries fall on output rows) and each
+    halo to fit in one neighbour (max SAME pad side ≤ rows/shard, i.e.
+    roughly kh ≤ 2·rows + stride).
     """
-    if tuple(strides) != (1, 1):
+    sh, sw = tuple(strides)
+    kh, kw = kernel.shape[0], kernel.shape[1]
+    n_sp = mesh.shape[SPATIAL_AXIS]
+    H, W = x.shape[1], x.shape[2]
+    rows = H // n_sp
+    if H % n_sp:
+        raise ValueError(f"H={H} not divisible by spatial={n_sp}")
+    if rows % sh:
         raise ValueError(
-            f"spatial_conv supports strides=(1,1) only, got {strides}")
-    kh = kernel.shape[0]
-    halo = (kh - 1) // 2
+            f"rows/shard={rows} not divisible by row stride {sh}: shard "
+            f"boundaries would fall between output rows — reshard first")
+    pad_top, pad_bottom = _same_pad(H, kh, sh)
+    if max(pad_top, pad_bottom) > rows:
+        raise ValueError(
+            f"halo {max(pad_top, pad_bottom)} exceeds rows/shard={rows}: "
+            f"kernel too tall for this mesh")
+    pad_w = _same_pad(W, kw, sw)
 
     def shard_fn(xs, ks):
-        padded = halo_exchange(xs, halo) if halo else xs
+        padded = halo_exchange(xs, pad_top, pad_bottom)
         return jax.lax.conv_general_dilated(
-            padded, ks, window_strides=strides,
-            padding=((0, 0), ((ks.shape[1] - 1) // 2,) * 2),
+            padded, ks, window_strides=(sh, sw),
+            padding=((0, 0), pad_w),
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
 
     try:

@@ -53,11 +53,32 @@ def test_make_pod_mesh_single_slice():
     assert mesh2.shape == {"data": 4, "model": 2}
 
 
-def test_spatial_conv_rejects_strides(spatial_mesh):
-    x = jnp.zeros((1, 16, 8, 2))
+@pytest.mark.parametrize("kh,strides", [
+    (3, (2, 2)),   # ResNet downsample 3×3/2 (SAME pads the bottom row only)
+    (1, (2, 2)),   # bottleneck projection 1×1/2 (no padding at all)
+    (7, (2, 2)),   # ResNet stem 7×7/2 (pad 2 above, 3 below)
+    (5, (2, 1)),   # mixed row/col strides
+])
+def test_spatial_conv_strided_matches_unsharded(spatial_mesh, kh, strides):
+    """SAME-under-stride pads asymmetrically; the asymmetric halo must
+    reproduce it exactly (every conv shape ResNet uses)."""
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.normal(size=(2, 64, 16, 3)).astype(np.float32))
+    k = jnp.asarray(rng.normal(size=(kh, 3, 3, 4)).astype(np.float32) * 0.1)
+    got = spatial_conv(x, k, spatial_mesh, strides=strides)
+    want = _reference_conv(x, k, strides=strides)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_spatial_conv_rejects_misaligned_stride(spatial_mesh):
+    # 8 shards × 4 rows each; stride 3 doesn't divide the shard rows, so
+    # output rows would straddle shard boundaries
+    x = jnp.zeros((1, 32, 8, 2))
     k = jnp.zeros((3, 3, 2, 2))
-    with pytest.raises(ValueError, match="strides"):
-        spatial_conv(x, k, spatial_mesh, strides=(2, 2))
+    with pytest.raises(ValueError, match="stride"):
+        spatial_conv(x, k, spatial_mesh, strides=(3, 1))
 
 
 @pytest.mark.slow
