@@ -23,6 +23,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deep_vision_tpu.parallel.mesh import SPATIAL_AXIS  # single source
 
+try:
+    from jax import shard_map
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map
+
 
 def _same_pad(dim: int, k: int, s: int) -> tuple[int, int]:
     """XLA's SAME padding split (low, high) for one dimension: total
@@ -32,14 +37,15 @@ def _same_pad(dim: int, k: int, s: int) -> tuple[int, int]:
 
 
 def halo_exchange(x, halo: int, halo_bottom: int | None = None,
-                  axis_name: str = SPATIAL_AXIS):
+                  axis_name: str = SPATIAL_AXIS, fill_value=0.0):
     """Per-shard (B, H_shard, W, C) → (B, top + H_shard + bottom, W, C).
 
     ``halo`` rows arrive from the shard above and ``halo_bottom``
     (default: same) from the shard below, via two ring ppermutes; the
-    outermost shards get zero rows instead (SAME zero-padding semantics
-    at the true image edge).  Asymmetric halos are what SAME-under-stride
-    requires (XLA puts the odd padding row on the high side).
+    outermost shards get ``fill_value`` rows instead (SAME-padding
+    semantics at the true image edge: 0 for convolution, -inf for max
+    pooling).  Asymmetric halos are what SAME-under-stride requires
+    (XLA puts the odd padding row on the high side).
     """
     top = halo
     bottom = halo if halo_bottom is None else halo_bottom
@@ -51,15 +57,64 @@ def halo_exchange(x, halo: int, halo_bottom: int | None = None,
     if top:
         bot_rows = x[:, -top:]   # my last rows → neighbour below's top halo
         from_above = jax.lax.ppermute(bot_rows, axis_name, fwd)
-        parts.append(jnp.where(idx == 0, jnp.zeros_like(from_above),
+        parts.append(jnp.where(idx == 0,
+                               jnp.full_like(from_above, fill_value),
                                from_above))
     parts.append(x)
     if bottom:
         top_rows = x[:, :bottom]  # my first rows → neighbour above's bottom
         from_below = jax.lax.ppermute(top_rows, axis_name, bwd)
-        parts.append(jnp.where(idx == n - 1, jnp.zeros_like(from_below),
+        parts.append(jnp.where(idx == n - 1,
+                               jnp.full_like(from_below, fill_value),
                                from_below))
     return jnp.concatenate(parts, axis=1) if len(parts) > 1 else x
+
+
+def _check_row_split(H: int, n_sp: int, sh: int, kh: int):
+    """Shared divisibility/halo validation; returns (rows, pad_t, pad_b)."""
+    rows = H // n_sp
+    if H % n_sp:
+        raise ValueError(f"H={H} not divisible by spatial={n_sp}")
+    if rows % sh:
+        raise ValueError(
+            f"rows/shard={rows} not divisible by row stride {sh}: shard "
+            f"boundaries would fall between output rows — reshard first")
+    pad_top, pad_bottom = _same_pad(H, kh, sh)
+    if max(pad_top, pad_bottom) > rows:
+        raise ValueError(
+            f"halo {max(pad_top, pad_bottom)} exceeds rows/shard={rows}: "
+            f"window too tall for this mesh")
+    return rows, pad_top, pad_bottom
+
+
+def spatial_max_pool(x, window=(2, 2), strides=None, *, mesh: Mesh):
+    """SAME max-pool with x row-sharded over the ``spatial`` axis — the
+    companion to :func:`spatial_conv` (ResNet stem 3×3/2 pool, Hourglass
+    2×2/2 downsamples).  Identical to the unsharded ``nn.max_pool(...,
+    padding="SAME")``.  Edge halos fill with -inf (the max identity), so
+    true-edge windows see exactly XLA's SAME padding.
+    """
+    wh, ww = tuple(window)
+    sh, sw = tuple(strides) if strides is not None else (wh, ww)
+    H, W = x.shape[1], x.shape[2]
+    _, pad_top, pad_bottom = _check_row_split(H, mesh.shape[SPATIAL_AXIS],
+                                              sh, wh)
+    pad_w = _same_pad(W, ww, sw)
+    neg_inf = jnp.array(-jnp.inf, x.dtype)
+
+    def shard_fn(xs):
+        padded = halo_exchange(xs, pad_top, pad_bottom,
+                               fill_value=-jnp.inf)
+        return jax.lax.reduce_window(
+            padded, neg_inf, jax.lax.max, (1, wh, ww, 1), (1, sh, sw, 1),
+            ((0, 0), (0, 0), pad_w, (0, 0)))
+
+    fn = shard_map(shard_fn, mesh=mesh,
+                   in_specs=P(None, SPATIAL_AXIS, None, None),
+                   out_specs=P(None, SPATIAL_AXIS, None, None))
+    x = jax.device_put(x, NamedSharding(mesh, P(None, SPATIAL_AXIS,
+                                                None, None)))
+    return fn(x)
 
 
 def spatial_conv(x, kernel, mesh: Mesh, strides=(1, 1)):
@@ -80,20 +135,9 @@ def spatial_conv(x, kernel, mesh: Mesh, strides=(1, 1)):
     """
     sh, sw = tuple(strides)
     kh, kw = kernel.shape[0], kernel.shape[1]
-    n_sp = mesh.shape[SPATIAL_AXIS]
     H, W = x.shape[1], x.shape[2]
-    rows = H // n_sp
-    if H % n_sp:
-        raise ValueError(f"H={H} not divisible by spatial={n_sp}")
-    if rows % sh:
-        raise ValueError(
-            f"rows/shard={rows} not divisible by row stride {sh}: shard "
-            f"boundaries would fall between output rows — reshard first")
-    pad_top, pad_bottom = _same_pad(H, kh, sh)
-    if max(pad_top, pad_bottom) > rows:
-        raise ValueError(
-            f"halo {max(pad_top, pad_bottom)} exceeds rows/shard={rows}: "
-            f"kernel too tall for this mesh")
+    _, pad_top, pad_bottom = _check_row_split(H, mesh.shape[SPATIAL_AXIS],
+                                              sh, kh)
     pad_w = _same_pad(W, kw, sw)
 
     def shard_fn(xs, ks):
@@ -102,11 +146,6 @@ def spatial_conv(x, kernel, mesh: Mesh, strides=(1, 1)):
             padded, ks, window_strides=(sh, sw),
             padding=((0, 0), pad_w),
             dimension_numbers=("NHWC", "HWIO", "NHWC"))
-
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
 
     fn = shard_map(shard_fn, mesh=mesh,
                    in_specs=(P(None, SPATIAL_AXIS, None, None), P()),

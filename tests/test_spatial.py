@@ -72,6 +72,24 @@ def test_spatial_conv_strided_matches_unsharded(spatial_mesh, kh, strides):
                                rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.parametrize("window,strides", [
+    ((2, 2), None),      # Hourglass downsample (stride defaults to window)
+    ((3, 3), (2, 2)),    # ResNet stem pool (SAME pads bottom row only)
+    ((3, 3), (1, 1)),    # YOLO-tiny style stride-1 pool
+])
+def test_spatial_max_pool_matches_unsharded(spatial_mesh, window, strides):
+    from flax import linen as nn
+
+    from deep_vision_tpu.parallel.spatial import spatial_max_pool
+
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(2, 64, 16, 3)).astype(np.float32))
+    got = spatial_max_pool(x, window, strides, mesh=spatial_mesh)
+    want = nn.max_pool(x, window, strides or window, padding="SAME")
+    assert got.shape == want.shape
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_spatial_conv_rejects_misaligned_stride(spatial_mesh):
     # 8 shards × 4 rows each; stride 3 doesn't divide the shard rows, so
     # output rows would straddle shard boundaries
