@@ -90,6 +90,34 @@ def test_spatial_max_pool_matches_unsharded(spatial_mesh, window, strides):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+def test_spatial_ops_compose_resnet_stem(spatial_mesh):
+    """With strided convs + pooling, the explicit API runs a real model's
+    downsampling path: ResNet stem (7×7/2 conv → 3×3/2 max-pool) followed
+    by a 3×3 block conv, sharded 8 ways, matching the unsharded pipeline.
+    224 rows → 112 → 56: every stage keeps rows divisible by the mesh."""
+    from flax import linen as nn
+
+    from deep_vision_tpu.parallel.spatial import spatial_max_pool
+
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(1, 224, 32, 3)).astype(np.float32))
+    k_stem = jnp.asarray(
+        rng.normal(size=(7, 7, 3, 8)).astype(np.float32) * 0.05)
+    k_block = jnp.asarray(
+        rng.normal(size=(3, 3, 8, 8)).astype(np.float32) * 0.05)
+
+    got = spatial_conv(x, k_stem, spatial_mesh, strides=(2, 2))
+    got = spatial_max_pool(got, (3, 3), (2, 2), mesh=spatial_mesh)
+    got = spatial_conv(got, k_block, spatial_mesh)
+
+    want = _reference_conv(x, k_stem, strides=(2, 2))
+    want = nn.max_pool(want, (3, 3), (2, 2), padding="SAME")
+    want = _reference_conv(want, k_block)
+    assert got.shape == want.shape
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_spatial_conv_rejects_misaligned_stride(spatial_mesh):
     # 8 shards × 4 rows each; stride 3 doesn't divide the shard rows, so
     # output rows would straddle shard boundaries
