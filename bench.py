@@ -222,7 +222,8 @@ def _time_step(compiled, args, steps: int, loss_of):
     return time.perf_counter() - t0
 
 
-def bench_task(name: str, steps: int | None = None) -> dict:
+def bench_task(name: str, steps: int | None = None,
+               batch: int | None = None) -> dict:
     """Train-step throughput for one non-classification task at the
     REFERENCE's production shapes (VERDICT r02 item 4):
 
@@ -276,7 +277,7 @@ def bench_task(name: str, steps: int | None = None) -> dict:
         from deep_vision_tpu.models.yolo import YoloV3
         from deep_vision_tpu.tasks.detection import MAX_BOXES, YoloTask
 
-        B, S = 16, 416
+        B, S = batch or 16, 416
         npr = np.random.default_rng(0)
         batch = {"image": jnp.asarray(
                      npr.normal(size=(B, S, S, 3)).astype(np.float32)),
@@ -302,7 +303,7 @@ def bench_task(name: str, steps: int | None = None) -> dict:
         from deep_vision_tpu.models.hourglass import StackedHourglass
         from deep_vision_tpu.tasks.pose import PoseTask
 
-        B = 16
+        B = batch or 16
         batch = {"image": jax.random.normal(rng, (B, 256, 256, 3)),
                  "heatmaps": jnp.clip(
                      jax.random.normal(rng, (B, 64, 64, 16)), 0, 1)}
@@ -317,7 +318,7 @@ def bench_task(name: str, steps: int | None = None) -> dict:
             from deep_vision_tpu.models import gan as gan_models
             from deep_vision_tpu.tasks.gan import CycleGANTask
 
-            B = 1
+            B = batch or 1
             task = CycleGANTask(
                 lambda: gan_models.CycleGANGenerator(dtype=jnp.bfloat16),
                 lambda: gan_models.PatchGANDiscriminator(
@@ -332,7 +333,7 @@ def bench_task(name: str, steps: int | None = None) -> dict:
                                                     DCGANGenerator)
             from deep_vision_tpu.tasks.gan import DCGANTask
 
-            B = 256
+            B = batch or 256
             task = DCGANTask(DCGANGenerator(dtype=jnp.bfloat16),
                              DCGANDiscriminator(dtype=jnp.bfloat16))
             host = {"image": np.random.default_rng(0).normal(
@@ -351,6 +352,75 @@ def bench_task(name: str, steps: int | None = None) -> dict:
     peak = _peak_hbm_gib()
     if peak:
         out["peak_hbm_gib"] = peak
+    out["device_kind"] = jax.devices()[0].device_kind
+    return out
+
+
+def bench_infer(name: str = "resnet50", steps: int | None = None,
+                batch: int | None = None) -> dict:
+    """Forward-only (serving) throughput:
+
+    - ``resnet50``  batch-256 bf16 classification forward;
+    - ``yolo``      batch-16 416² forward INCLUDING the full on-device
+                    postprocess (3-scale decode + score filter + batched
+                    NMS, ops/boxes.py) — the reference runs NMS in host
+                    Python per image (YOLO/tensorflow/postprocess.py).
+    """
+    import numpy as np
+
+    rng = jax.random.PRNGKey(0)
+    if name == "resnet50":
+        from deep_vision_tpu.models.resnet import ResNet50
+
+        B = batch or 256
+        model = ResNet50(dtype=jnp.bfloat16)
+        x = jax.random.normal(rng, (B, 224, 224, 3), jnp.float32)
+        variables = jax.jit(functools.partial(model.init, train=False))(
+            {"params": rng}, x[:1])
+
+        def fwd(variables, x):
+            logits = model.apply(variables, x, train=False)
+            return jnp.argmax(logits, -1)
+
+    elif name == "yolo":
+        from deep_vision_tpu.models.yolo import YoloV3
+        from deep_vision_tpu.tasks.detection import YoloTask
+
+        B = batch or 16
+        model = YoloV3(num_classes=80, dtype=jnp.bfloat16)
+        task = YoloTask(80)
+        x = jax.random.normal(rng, (B, 416, 416, 3), jnp.float32)
+        variables = jax.jit(functools.partial(model.init, train=False))(
+            {"params": rng}, x[:1])
+
+        def fwd(variables, x):
+            from deep_vision_tpu.tasks.detection import postprocess
+
+            outputs = model.apply(variables, x, train=False)
+            boxes, scores, classes, valid = postprocess(
+                outputs, 80, anchors=np.asarray(task.anchors),
+                masks=task.masks)
+            return scores
+
+    else:
+        raise SystemExit(f"unknown --infer target {name}")
+
+    compiled = jax.jit(fwd).lower(variables, x).compile()
+    n_steps = steps or (20 if name == "yolo" else 40)
+    out_first = compiled(variables, x)
+    float(jax.device_get(out_first.reshape(-1)[0]))
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        o = compiled(variables, x)
+    float(jax.device_get(o.reshape(-1)[0]))
+    dt = time.perf_counter() - t0
+    out = {"metric": f"{name}_infer_images_per_sec_per_chip",
+           "value": round(n_steps * B / dt, 1),
+           "unit": "images/sec/chip",
+           "ms_per_batch": round(dt / n_steps * 1e3, 1), "batch": B}
+    hbm = _hbm_gib(compiled)
+    if hbm:
+        out["hbm_gib"] = hbm
     out["device_kind"] = jax.devices()[0].device_kind
     return out
 
@@ -468,7 +538,10 @@ def main():
     p.add_argument("--pipeline", action="store_true",
                    help="measure host input-pipeline throughput instead")
     p.add_argument("--profile", action="store_true")
-    p.add_argument("--batch", type=int, default=256)
+    p.add_argument("--batch", type=int, default=None,
+                   help="per-chip batch (default: 256 for the ResNet "
+                        "bench/pipeline; per-model defaults for "
+                        "--task/--infer)")
     p.add_argument("--steps", type=int, default=None,
                    help="total train steps to time (default: 80 for the "
                         "ResNet bench, rounded down to whole scan blocks; "
@@ -489,21 +562,30 @@ def main():
     p.add_argument("--all", action="store_true",
                    help="bench every task (one subprocess each; one JSON "
                         "line per task)")
+    p.add_argument("--infer", choices=("resnet50", "yolo"), default=None,
+                   help="forward-only serving throughput (yolo includes "
+                        "on-device decode + NMS)")
     args = p.parse_args()
     if args.all:
         bench_all()
         return
+    if args.infer:
+        print(json.dumps(bench_infer(args.infer, steps=args.steps,
+                                     batch=args.batch)))
+        return
     if args.task:
-        print(json.dumps(bench_task(args.task, steps=args.steps)))
+        print(json.dumps(bench_task(args.task, steps=args.steps,
+                                    batch=args.batch)))
         return
     if args.pipeline:
         nw = args.num_workers if args.num_workers is not None \
             else (0 if args.source == "raw" else 16)
-        out = bench_pipeline(num_workers=nw, batch=args.batch,
+        out = bench_pipeline(num_workers=nw, batch=args.batch or 256,
                              device_normalize=not args.host_normalize,
                              source=args.source)
     else:
-        out = bench_train_step(batch=args.batch, steps=args.steps or 80,
+        out = bench_train_step(batch=args.batch or 256,
+                               steps=args.steps or 80,
                                profile=args.profile,
                                scan_steps=args.scan_steps)
     print(json.dumps(out))

@@ -68,6 +68,64 @@ def test_batched_nms_shapes():
     assert idx.shape == (4, 10) and valid.shape == (4, 10)
 
 
+def test_batched_nms_topk_preselect_matches_exhaustive():
+    """postprocess feeds NMS only the top-k scored boxes (the full N×N
+    IoU matrix OOMs at 416²/batch 16); with k ≫ max_outputs the selected
+    detections must be identical to exhaustive NMS."""
+    rng = np.random.default_rng(7)
+    N, K, TOPK = 200, 10, 50
+    boxes = rng.uniform(0, 1, (N, 4)).astype(np.float32)
+    boxes = np.concatenate(
+        [boxes[:, :2], boxes[:, :2] + 0.05 + boxes[:, 2:] * 0.1], -1)
+    scores = rng.uniform(0, 1, (N,)).astype(np.float32)
+
+    full_idx, full_sel, full_valid = nms_single(
+        jnp.asarray(boxes), jnp.asarray(scores), max_outputs=K)
+
+    top_scores, top_idx = jax.lax.top_k(jnp.asarray(scores), TOPK)
+    top_boxes = jnp.asarray(boxes)[top_idx]
+    sub_idx, sub_sel, sub_valid = nms_single(top_boxes, top_scores,
+                                             max_outputs=K)
+    np.testing.assert_array_equal(np.asarray(full_valid),
+                                  np.asarray(sub_valid))
+    np.testing.assert_allclose(np.asarray(full_sel), np.asarray(sub_sel))
+    # indices map back through the top-k gather
+    np.testing.assert_array_equal(
+        np.asarray(full_idx) * np.asarray(full_valid),
+        np.asarray(top_idx)[np.asarray(sub_idx)] * np.asarray(sub_valid))
+
+
+def test_postprocess_topk_equals_full_nms():
+    """End-to-end: postprocess with the default top-512 preselect must
+    return exactly what exhaustive NMS (pre_nms_top_k=all) returns on
+    random, non-degenerate raw outputs — guards the gather wiring."""
+    rng = np.random.default_rng(9)
+    B = 2
+    outputs = [jnp.asarray(rng.normal(size=(B, g, g, 3, 8))
+                           .astype(np.float32)) for g in (8, 4, 2)]
+    n_all = sum(g * g * 3 for g in (8, 4, 2))
+    got = D.postprocess(outputs, 3, max_outputs=20, pre_nms_top_k=64)
+    want = D.postprocess(outputs, 3, max_outputs=20, pre_nms_top_k=n_all)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-6, atol=1e-7)
+
+
+def test_postprocess_real_shapes_stay_small():
+    """416² COCO shapes (10,647 candidates/image): postprocess must not
+    materialize the exhaustive IoU matrix — regression guard for the
+    batch-16 eval OOM."""
+    B = 2
+    outputs = [jnp.zeros((B, g, g, 3, 85), jnp.float32)
+               for g in (52, 26, 13)]
+    boxes, scores, classes, valid = D.postprocess(outputs, 80)
+    assert boxes.shape == (B, 100, 4) and scores.shape == (B, 100)
+    mem = jax.jit(lambda o: D.postprocess(o, 80)).lower(
+        outputs).compile().memory_analysis()
+    if mem is not None:  # CPU backend may not report
+        assert mem.temp_size_in_bytes < 512 * 2**20, mem.temp_size_in_bytes
+
+
 def test_find_best_anchor():
     # exactly the largest anchor → index 8; tiny box → index 0
     wh = np.array([[373 / 416, 326 / 416], [8 / 416, 10 / 416]])
