@@ -301,17 +301,38 @@ def _main_detection(args, cfg, mesh):
         from deep_vision_tpu.data.records import load_detection_records
 
         assert args.data_root, "--data-root required without --synthetic"
-        train_samples = load_detection_records(args.data_root, "train")
-        val_samples = load_detection_records(args.data_root, "val")
+        # train split decodes in the worker pool (bounded memory); the val
+        # split is revisited every epoch with no pool, so cache decodes
+        train_samples = load_detection_records(
+            args.data_root, "train", cache_decoded=args.num_workers == 0)
+        val_samples = load_detection_records(args.data_root, "val",
+                                             cache_decoded=True)
+    # uint8 host batches + on-device /255 by default (4× smaller H2D,
+    # no host f32 convert); --host-normalize restores the all-host path
+    dev_norm = not args.host_normalize
+    preprocess_fn = None
+    if dev_norm:
+        from deep_vision_tpu.ops.preprocess import make_scale_preprocess
+
+        preprocess_fn = make_scale_preprocess()
     train_loader = LoaderCls(train_samples, cfg.batch_size,
                              cfg.num_classes, cfg.image_size,
-                             train=True, seed=cfg.seed)
+                             train=True, seed=cfg.seed,
+                             device_normalize=dev_norm,
+                             # synthetic samples are in-memory (no decode)
+                             # — a pool only adds pickle traffic
+                             num_workers=0 if args.synthetic
+                             else args.num_workers)
     val_loader = LoaderCls(val_samples, cfg.batch_size,
-                           cfg.num_classes, cfg.image_size, train=False)
+                           cfg.num_classes, cfg.image_size, train=False,
+                           device_normalize=dev_norm)
     trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir,
-                      upload=args.upload)
-    state = trainer.fit(train_loader, val_loader, resume=args.resume)
-    final = trainer.evaluate(state, val_loader)
+                      preprocess_fn=preprocess_fn, upload=args.upload)
+    try:
+        state = trainer.fit(train_loader, val_loader, resume=args.resume)
+        final = trainer.evaluate(state, val_loader)
+    finally:
+        train_loader.close()
     print("final:", " ".join(f"{k}={v:.4f}" for k, v in final.items()))
     return 0
 
@@ -333,8 +354,11 @@ def _main_pose(args, cfg, mesh):
         from deep_vision_tpu.data.records import load_pose_records
 
         assert args.data_root, "--data-root required without --synthetic"
-        train_samples = load_pose_records(args.data_root, "train")
-        val_samples = load_pose_records(args.data_root, "val")
+        # PoseLoader has no worker pool: keep the decode-once semantics
+        train_samples = load_pose_records(args.data_root, "train",
+                                          cache_decoded=True)
+        val_samples = load_pose_records(args.data_root, "val",
+                                        cache_decoded=True)
     train_loader = PoseLoader(train_samples, cfg.batch_size, cfg.image_size,
                               heatmap_size, cfg.num_classes, train=True,
                               seed=cfg.seed)

@@ -72,17 +72,94 @@ def corners_to_xywh(boxes: np.ndarray) -> np.ndarray:
     return np.concatenate([xy, wh], axis=1)
 
 
+def _augment_resize(sample: dict, rng: np.random.Generator,
+                    image_size: int, augment: bool, crop: bool,
+                    device_normalize: bool):
+    """Shared prep front half: flip[/crop] → resize → (uint8 | f32/255).
+    With ``device_normalize`` the image stays uint8 (4× smaller H2D
+    payload; the /255 scale runs inside the jitted step,
+    ops/preprocess.py)."""
+    img = sample["image"]
+    boxes = np.asarray(sample["boxes"], np.float32).reshape(-1, 4)
+    classes = np.asarray(sample["classes"], np.int64).reshape(-1)
+    if augment and len(boxes):
+        if rng.random() < 0.5:
+            img = img[:, ::-1]
+            boxes = flip_boxes_lr(boxes)
+        if crop and rng.random() < 0.5:
+            img, boxes, keep = random_crop_with_boxes(img, boxes, rng)
+            classes = classes[keep]
+    img = resize_square(img, image_size)
+    x = img if device_normalize else img.astype(np.float32) / 255.0
+    return x, boxes, classes
+
+
+def prepare_yolo_sample(sample: dict, rng: np.random.Generator, *,
+                        num_classes: int, image_size: int, grids,
+                        augment: bool, device_normalize: bool = False
+                        ) -> dict:
+    x, boxes, classes = _augment_resize(sample, rng, image_size, augment,
+                                        crop=True,
+                                        device_normalize=device_normalize)
+    enc = encode_labels(corners_to_xywh(boxes), classes, num_classes,
+                        grids=grids)
+    return {"image": x, **enc}
+
+
+def prepare_centernet_sample(sample: dict, rng: np.random.Generator, *,
+                             num_classes: int, image_size: int, grids,
+                             augment: bool, device_normalize: bool = False
+                             ) -> dict:
+    from deep_vision_tpu.tasks.centernet import encode_centernet_labels
+
+    x, boxes, classes = _augment_resize(sample, rng, image_size, augment,
+                                        crop=False,
+                                        device_normalize=device_normalize)
+    enc = encode_centernet_labels(
+        corners_to_xywh(boxes), classes, num_classes,
+        grid=image_size // 4)
+    return {"image": x, **enc}
+
+
+# worker-side state: initialized once per worker process (the 0-worker
+# path calls the prepare function inline with the same per-item rng, so
+# pooled and sequential iteration yield IDENTICAL batches)
+_DET_WORKER: dict = {}
+
+
+def _det_worker_init(cfg: dict):
+    _DET_WORKER.update(cfg)
+
+
+def _det_prepare(args: tuple) -> dict:
+    i, epoch = args
+    w = _DET_WORKER
+    rng = np.random.default_rng((w["seed"], epoch, int(i)))
+    return w["prepare"](w["samples"][i], rng, **w["kwargs"])
+
+
 class DetectionLoader:
     """Batch iterator over an in-memory/detection-record dataset.
 
     ``samples``: sequence of dicts (see module docstring) or a callable
     ``index -> sample`` plus ``length``.
+
+    Per-item augmentation rng derives from ``(seed, epoch, sample_index)``
+    — deterministic and independent of iteration order or worker count.
+    ``num_workers`` > 0 preps samples in a process pool (forkserver;
+    samples ship to workers once at pool creation); lazy record samples
+    decode in the workers, parallelizing the JPEG decode that dominates
+    the cold-epoch cost.
     """
+
+    PREPARE = staticmethod(prepare_yolo_sample)
 
     def __init__(self, samples: Sequence[dict], batch_size: int,
                  num_classes: int, image_size: int = 416,
                  grids: Sequence[int] | None = None,
-                 train: bool = True, seed: int = 0, augment: bool = True):
+                 train: bool = True, seed: int = 0, augment: bool = True,
+                 device_normalize: bool = False, num_workers: int = 0,
+                 prefetch_batches: int = 2):
         self.samples = samples
         self.batch_size = batch_size
         self.num_classes = num_classes
@@ -92,7 +169,31 @@ class DetectionLoader:
         self.train = train
         self.seed = seed
         self.augment = augment and train
+        self.device_normalize = device_normalize
+        self.num_workers = num_workers
+        self.prefetch_batches = max(1, prefetch_batches)
         self.epoch = 0
+        self._pool = None
+        if num_workers > 0:
+            import multiprocessing as mp
+
+            # forkserver, NOT fork: the JAX runtime has live threads by
+            # loader-construction time (same rationale as ImageNetLoader)
+            try:
+                ctx = mp.get_context("forkserver")
+            except ValueError:
+                ctx = mp.get_context("spawn")
+            self._pool = ctx.Pool(
+                num_workers, initializer=_det_worker_init,
+                initargs=(dict(samples=samples, seed=seed,
+                               prepare=type(self).PREPARE,
+                               kwargs=self._prep_kwargs()),))
+
+    def _prep_kwargs(self) -> dict:
+        return dict(num_classes=self.num_classes,
+                    image_size=self.image_size, grids=self.grids,
+                    augment=self.augment,
+                    device_normalize=self.device_normalize)
 
     def set_epoch(self, epoch: int):
         self.epoch = epoch
@@ -103,62 +204,63 @@ class DetectionLoader:
             return full + 1  # eval covers the FULL set (padded last batch)
         return full
 
-    def _prepare(self, sample: dict, rng: np.random.Generator) -> dict:
-        img = sample["image"]
-        boxes = np.asarray(sample["boxes"], np.float32).reshape(-1, 4)
-        classes = np.asarray(sample["classes"], np.int64).reshape(-1)
-        if self.augment and len(boxes):
-            if rng.random() < 0.5:
-                img = img[:, ::-1]
-                boxes = flip_boxes_lr(boxes)
-            if rng.random() < 0.5:
-                img, boxes, keep = random_crop_with_boxes(img, boxes, rng)
-                classes = classes[keep]
-        img = resize_square(img, self.image_size)
-        x = img.astype(np.float32) / 255.0  # yolo uses [0,1] inputs
-        enc = encode_labels(corners_to_xywh(boxes), classes,
-                            self.num_classes, grids=self.grids)
-        return {"image": x, **enc}
+    def _prepare_indexed(self, i: int, epoch: int) -> dict:
+        rng = np.random.default_rng((self.seed, epoch, int(i)))
+        return type(self).PREPARE(self.samples[i], rng,
+                                  **self._prep_kwargs())
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
 
     def __iter__(self) -> Iterator[dict]:
+        from collections import deque
+
         from deep_vision_tpu.data.loader import pad_eval_indices
 
-        rng = np.random.default_rng((self.seed, self.epoch))
+        order = np.random.default_rng((self.seed, self.epoch))
         idx = np.arange(len(self.samples))
         if self.train:
-            rng.shuffle(idx)
-        for b in range(len(self)):
-            # weight-0 fillers keep the batch shape static; loss metrics
-            # and the mAP accumulator both honor the weight mask
-            sel, weight, _ = pad_eval_indices(idx, b * self.batch_size,
-                                              self.batch_size)
-            items = [self._prepare(self.samples[i], rng) for i in sel]
-            batch = {k: np.stack([it[k] for it in items]) for k in items[0]}
-            if not self.train:
-                batch["weight"] = weight
-            yield batch
+            order.shuffle(idx)
+        # weight-0 fillers keep the batch shape static; loss metrics
+        # and the mAP accumulator both honor the weight mask
+        plan = [pad_eval_indices(idx, b * self.batch_size, self.batch_size)
+                for b in range(len(self))]
+        if self._pool is not None:
+            # keep prefetch_batches async batches in flight so worker
+            # decode overlaps the consumer's device step
+            chunk = max(1, self.batch_size // (2 * self.num_workers))
+            pending: deque = deque()
+            submit = 0
+            for b in range(len(plan)):
+                while submit < len(plan) and len(pending) < \
+                        self.prefetch_batches:
+                    args = [(int(i), self.epoch) for i in plan[submit][0]]
+                    pending.append(self._pool.map_async(
+                        _det_prepare, args, chunksize=chunk))
+                    submit += 1
+                items = pending.popleft().get()
+                yield self._assemble(items, plan[b][1])
+        else:
+            for sel, weight, _ in plan:
+                items = [self._prepare_indexed(int(i), self.epoch)
+                         for i in sel]
+                yield self._assemble(items, weight)
+
+    def _assemble(self, items: list, weight) -> dict:
+        batch = {k: np.stack([it[k] for it in items]) for k in items[0]}
+        if not self.train:
+            batch["weight"] = weight
+        return batch
 
 
 class CenterNetLoader(DetectionLoader):
     """Same sample format/augmentation, CenterNet target encoding
     (tasks.centernet.encode_centernet_labels) at stride-4 resolution."""
 
-    def _prepare(self, sample: dict, rng: np.random.Generator) -> dict:
-        from deep_vision_tpu.tasks.centernet import encode_centernet_labels
-
-        img = sample["image"]
-        boxes = np.asarray(sample["boxes"], np.float32).reshape(-1, 4)
-        classes = np.asarray(sample["classes"], np.int64).reshape(-1)
-        if self.augment and len(boxes):
-            if rng.random() < 0.5:
-                img = img[:, ::-1]
-                boxes = flip_boxes_lr(boxes)
-        img = resize_square(img, self.image_size)
-        x = img.astype(np.float32) / 255.0
-        enc = encode_centernet_labels(
-            corners_to_xywh(boxes), classes, self.num_classes,
-            grid=self.image_size // 4)
-        return {"image": x, **enc}
+    PREPARE = staticmethod(prepare_centernet_sample)
 
 
 def synthetic_detection_dataset(n: int, image_size: int = 416,

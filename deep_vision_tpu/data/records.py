@@ -128,13 +128,39 @@ def encode_detection_sample(sample: dict) -> tuple[dict, bytes]:
     return header, payload
 
 
-class _LazySample(dict):
-    """Dict-like sample that decodes its JPEG payload on first "image"
-    access; subclasses parse their eager header fields in ``_parse``."""
+def scan_records(path: str) -> Iterator[tuple[dict, int, int]]:
+    """Headers + (payload_offset, payload_len), WITHOUT reading payloads —
+    shard scan is header-sized, not dataset-sized."""
+    with open(path, "rb") as f:
+        while True:
+            raw = f.read(4)
+            if len(raw) < 4:
+                return
+            (hlen,) = _U32.unpack(raw)
+            header = json.loads(f.read(hlen))
+            (plen,) = _U32.unpack(f.read(4))
+            off = f.tell()
+            f.seek(plen, 1)
+            yield header, off, plen
 
-    def __init__(self, header: dict, payload: bytes):
+
+class _LazySample(dict):
+    """Dict-like sample holding (shard path, offset, length) — "image"
+    access does a positioned read + JPEG decode.  The sample itself is a
+    few hundred bytes, so a COCO-scale dataset costs ~MBs in the parent
+    process and pickles cheaply to loader workers (the payload bytes
+    never live in Python memory).
+
+    ``cache_decoded=True`` keeps the decoded array on the sample after
+    first access — an explicit opt-in for small datasets on big-RAM
+    hosts; the default re-decodes per access so worker/parent memory
+    stays bounded regardless of epochs (torch-DataLoader semantics).
+    Subclasses parse their eager header fields in ``_parse``."""
+
+    def __init__(self, header: dict, src: tuple, cache_decoded: bool):
         super().__init__()
-        self._payload = payload
+        self._src = src
+        self._cache = cache_decoded
         self._parse(header)
 
     def _parse(self, header: dict):
@@ -144,20 +170,29 @@ class _LazySample(dict):
         if key == "image" and not dict.__contains__(self, "image"):
             from PIL import Image
 
-            img = np.asarray(Image.open(io.BytesIO(self._payload)).convert("RGB"))
-            dict.__setitem__(self, "image", img)
+            path, off, plen = self._src
+            fd = os.open(path, os.O_RDONLY)
+            try:
+                payload = os.pread(fd, plen, off)
+            finally:
+                os.close(fd)
+            img = np.asarray(Image.open(io.BytesIO(payload)).convert("RGB"))
+            if self._cache:
+                dict.__setitem__(self, "image", img)
+            return img
         return dict.__getitem__(self, key)
 
     def __contains__(self, key):
         return key == "image" or dict.__contains__(self, key)
 
 
-def _load_lazy_records(root: str, split: str, sample_cls) -> list[dict]:
+def _load_lazy_records(root: str, split: str, sample_cls,
+                       cache_decoded: bool = False) -> list[dict]:
     shards = list_shards(root, split)
     if not shards:
         raise FileNotFoundError(f"no {split}-*.dvrec under {root}")
-    return [sample_cls(header, payload)
-            for s in shards for header, payload in read_records(s)]
+    return [sample_cls(header, (s, off, plen), cache_decoded)
+            for s in shards for header, off, plen in scan_records(s)]
 
 
 class _LazyDetectionSample(_LazySample):
@@ -210,10 +245,15 @@ def write_pose_records(samples: Sequence[dict], out_dir: str, split: str,
                          encode_pose_sample, num_workers)
 
 
-def load_pose_records(root: str, split: str) -> list[dict]:
-    return _load_lazy_records(root, split, _LazyPoseSample)
+def load_pose_records(root: str, split: str,
+                      cache_decoded: bool = False) -> list[dict]:
+    return _load_lazy_records(root, split, _LazyPoseSample, cache_decoded)
 
 
-def load_detection_records(root: str, split: str) -> list[dict]:
-    """All shards → list of lazy samples (JPEGs decode on access)."""
-    return _load_lazy_records(root, split, _LazyDetectionSample)
+def load_detection_records(root: str, split: str,
+                           cache_decoded: bool = False) -> list[dict]:
+    """All shards → list of offset-based lazy samples (positioned read +
+    JPEG decode on "image" access; see ``_LazySample`` for the memory
+    contract)."""
+    return _load_lazy_records(root, split, _LazyDetectionSample,
+                              cache_decoded)

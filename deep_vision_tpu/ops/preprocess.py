@@ -54,6 +54,23 @@ def jitter_normalize(images, rng, train: bool,
     return (x - jnp.asarray(mean)) / jnp.asarray(std)
 
 
+def make_scale_preprocess():
+    """Trainer ``preprocess_fn`` for [0,1]-input tasks (YOLO, CenterNet):
+    uint8 image batches scale to float32/255 inside the jitted step (4×
+    smaller H2D payload — the loaders' ``device_normalize`` path); float
+    batches (host-normalized) pass through untouched."""
+
+    def fn(batch: dict, rng, train: bool) -> dict:
+        img = batch["image"]
+        if img.dtype != jnp.uint8:
+            return batch
+        out = dict(batch)
+        out["image"] = img.astype(jnp.float32) / 255.0
+        return out
+
+    return fn
+
+
 def make_imagenet_preprocess(brightness: float = 0.2, contrast: float = 0.2,
                              saturation: float = 0.2):
     """Trainer ``preprocess_fn``: applied to uint8 image batches inside the
