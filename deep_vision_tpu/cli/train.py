@@ -39,7 +39,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh", default=None,
                    help="mesh spec like 'data=8' or 'data=4,model=2'")
     p.add_argument("--num-workers", type=int, default=16,
-                   help="decode/augment worker processes (ImageNet path)")
+                   help="decode/augment worker processes (ImageNet, "
+                        "detection, and pose loaders; 0 = inline prep, "
+                        "which also switches record datasets to "
+                        "decode-once caching)")
     p.add_argument("--host-normalize", action="store_true",
                    help="float32 jitter+normalize on the HOST (reference "
                         "semantics) instead of fused device preprocessing")
@@ -354,20 +357,33 @@ def _main_pose(args, cfg, mesh):
         from deep_vision_tpu.data.records import load_pose_records
 
         assert args.data_root, "--data-root required without --synthetic"
-        # PoseLoader has no worker pool: keep the decode-once semantics
-        train_samples = load_pose_records(args.data_root, "train",
-                                          cache_decoded=True)
+        # train split decodes in the worker pool (bounded memory); the val
+        # split is revisited every epoch with no pool, so cache decodes
+        train_samples = load_pose_records(
+            args.data_root, "train", cache_decoded=args.num_workers == 0)
         val_samples = load_pose_records(args.data_root, "val",
                                         cache_decoded=True)
+    dev_norm = not args.host_normalize
+    preprocess_fn = None
+    if dev_norm:
+        from deep_vision_tpu.ops.preprocess import make_scale_preprocess
+
+        preprocess_fn = make_scale_preprocess()
     train_loader = PoseLoader(train_samples, cfg.batch_size, cfg.image_size,
                               heatmap_size, cfg.num_classes, train=True,
-                              seed=cfg.seed)
+                              seed=cfg.seed, device_normalize=dev_norm,
+                              num_workers=0 if args.synthetic
+                              else args.num_workers)
     val_loader = PoseLoader(val_samples, cfg.batch_size, cfg.image_size,
-                            heatmap_size, cfg.num_classes, train=False)
+                            heatmap_size, cfg.num_classes, train=False,
+                            device_normalize=dev_norm)
     trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir,
-                      upload=args.upload)
-    state = trainer.fit(train_loader, val_loader, resume=args.resume)
-    final = trainer.evaluate(state, val_loader)
+                      preprocess_fn=preprocess_fn, upload=args.upload)
+    try:
+        state = trainer.fit(train_loader, val_loader, resume=args.resume)
+        final = trainer.evaluate(state, val_loader)
+    finally:
+        train_loader.close()
     print("final:", " ".join(f"{k}={v:.4f}" for k, v in final.items()))
     return 0
 

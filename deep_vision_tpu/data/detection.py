@@ -14,6 +14,7 @@ from typing import Iterator, Sequence
 
 import numpy as np
 
+from deep_vision_tpu.data.loader import PreppedSampleLoader
 from deep_vision_tpu.data.transforms import rescale
 from deep_vision_tpu.tasks.detection import encode_labels
 
@@ -121,35 +122,12 @@ def prepare_centernet_sample(sample: dict, rng: np.random.Generator, *,
     return {"image": x, **enc}
 
 
-# worker-side state: initialized once per worker process (the 0-worker
-# path calls the prepare function inline with the same per-item rng, so
-# pooled and sequential iteration yield IDENTICAL batches)
-_DET_WORKER: dict = {}
-
-
-def _det_worker_init(cfg: dict):
-    _DET_WORKER.update(cfg)
-
-
-def _det_prepare(args: tuple) -> dict:
-    i, epoch = args
-    w = _DET_WORKER
-    rng = np.random.default_rng((w["seed"], epoch, int(i)))
-    return w["prepare"](w["samples"][i], rng, **w["kwargs"])
-
-
-class DetectionLoader:
+class DetectionLoader(PreppedSampleLoader):
     """Batch iterator over an in-memory/detection-record dataset.
 
     ``samples``: sequence of dicts (see module docstring) or a callable
-    ``index -> sample`` plus ``length``.
-
-    Per-item augmentation rng derives from ``(seed, epoch, sample_index)``
-    — deterministic and independent of iteration order or worker count.
-    ``num_workers`` > 0 preps samples in a process pool (forkserver;
-    samples ship to workers once at pool creation); lazy record samples
-    decode in the workers, parallelizing the JPEG decode that dominates
-    the cold-epoch cost.
+    ``index -> sample`` plus ``length``.  Pool/prefetch/rng semantics:
+    :class:`~deep_vision_tpu.data.loader.PreppedSampleLoader`.
     """
 
     PREPARE = staticmethod(prepare_yolo_sample)
@@ -160,100 +138,20 @@ class DetectionLoader:
                  train: bool = True, seed: int = 0, augment: bool = True,
                  device_normalize: bool = False, num_workers: int = 0,
                  prefetch_batches: int = 2):
-        self.samples = samples
-        self.batch_size = batch_size
         self.num_classes = num_classes
         self.image_size = image_size
         self.grids = tuple(grids) if grids else (
             image_size // 8, image_size // 16, image_size // 32)
-        self.train = train
-        self.seed = seed
         self.augment = augment and train
         self.device_normalize = device_normalize
-        self.num_workers = num_workers
-        self.prefetch_batches = max(1, prefetch_batches)
-        self.epoch = 0
-        self._pool = None
-        if num_workers > 0:
-            import multiprocessing as mp
-
-            # forkserver, NOT fork: the JAX runtime has live threads by
-            # loader-construction time (same rationale as ImageNetLoader)
-            try:
-                ctx = mp.get_context("forkserver")
-            except ValueError:
-                ctx = mp.get_context("spawn")
-            self._pool = ctx.Pool(
-                num_workers, initializer=_det_worker_init,
-                initargs=(dict(samples=samples, seed=seed,
-                               prepare=type(self).PREPARE,
-                               kwargs=self._prep_kwargs()),))
+        super().__init__(samples, batch_size, train, seed, num_workers,
+                         prefetch_batches)
 
     def _prep_kwargs(self) -> dict:
         return dict(num_classes=self.num_classes,
                     image_size=self.image_size, grids=self.grids,
                     augment=self.augment,
                     device_normalize=self.device_normalize)
-
-    def set_epoch(self, epoch: int):
-        self.epoch = epoch
-
-    def __len__(self) -> int:
-        full = len(self.samples) // self.batch_size
-        if not self.train and len(self.samples) % self.batch_size:
-            return full + 1  # eval covers the FULL set (padded last batch)
-        return full
-
-    def _prepare_indexed(self, i: int, epoch: int) -> dict:
-        rng = np.random.default_rng((self.seed, epoch, int(i)))
-        return type(self).PREPARE(self.samples[i], rng,
-                                  **self._prep_kwargs())
-
-    def close(self):
-        if self._pool is not None:
-            self._pool.terminate()
-            self._pool.join()
-            self._pool = None
-
-    def __iter__(self) -> Iterator[dict]:
-        from collections import deque
-
-        from deep_vision_tpu.data.loader import pad_eval_indices
-
-        order = np.random.default_rng((self.seed, self.epoch))
-        idx = np.arange(len(self.samples))
-        if self.train:
-            order.shuffle(idx)
-        # weight-0 fillers keep the batch shape static; loss metrics
-        # and the mAP accumulator both honor the weight mask
-        plan = [pad_eval_indices(idx, b * self.batch_size, self.batch_size)
-                for b in range(len(self))]
-        if self._pool is not None:
-            # keep prefetch_batches async batches in flight so worker
-            # decode overlaps the consumer's device step
-            chunk = max(1, self.batch_size // (2 * self.num_workers))
-            pending: deque = deque()
-            submit = 0
-            for b in range(len(plan)):
-                while submit < len(plan) and len(pending) < \
-                        self.prefetch_batches:
-                    args = [(int(i), self.epoch) for i in plan[submit][0]]
-                    pending.append(self._pool.map_async(
-                        _det_prepare, args, chunksize=chunk))
-                    submit += 1
-                items = pending.popleft().get()
-                yield self._assemble(items, plan[b][1])
-        else:
-            for sel, weight, _ in plan:
-                items = [self._prepare_indexed(int(i), self.epoch)
-                         for i in sel]
-                yield self._assemble(items, weight)
-
-    def _assemble(self, items: list, weight) -> dict:
-        batch = {k: np.stack([it[k] for it in items]) for k in items[0]}
-        if not self.train:
-            batch["weight"] = weight
-        return batch
 
 
 class CenterNetLoader(DetectionLoader):

@@ -33,6 +33,123 @@ def pad_eval_indices(idx: np.ndarray, start: int, batch_size: int
     return sel, weight, n_real
 
 
+# -- worker-side state for PreppedSampleLoader pools (one dict per worker
+# process; the 0-worker path calls PREPARE inline with the same per-item
+# rng, so pooled and sequential iteration yield IDENTICAL batches) -------
+_PREP_WORKER: dict = {}
+
+
+def _prep_worker_init(cfg: dict):
+    _PREP_WORKER.update(cfg)
+
+
+def _prep_one(args: tuple) -> dict:
+    i, epoch = args
+    w = _PREP_WORKER
+    rng = np.random.default_rng((w["seed"], epoch, int(i)))
+    return w["prepare"](w["samples"][i], rng, **w["kwargs"])
+
+
+class PreppedSampleLoader:
+    """Shared machinery for per-sample-prep loaders (detection, pose):
+    epoch shuffling, static eval padding, per-item augmentation rng
+    derived from ``(seed, epoch, sample_index)`` — deterministic and
+    independent of iteration order or worker count — and an optional
+    forkserver worker pool with ``prefetch_batches`` async batches in
+    flight so worker decode overlaps the consumer's device step.
+
+    Subclasses set ``PREPARE`` to a module-level (picklable) function
+    ``prepare(sample, rng, **kwargs)`` and implement ``_prep_kwargs``;
+    their own fields must be assigned BEFORE calling ``super().__init__``
+    (pool creation snapshots ``_prep_kwargs()``).
+    """
+
+    PREPARE: Callable
+
+    def __init__(self, samples, batch_size: int, train: bool, seed: int,
+                 num_workers: int = 0, prefetch_batches: int = 2):
+        self.samples = samples
+        self.batch_size = batch_size
+        self.train = train
+        self.seed = seed
+        self.num_workers = num_workers
+        self.prefetch_batches = max(1, prefetch_batches)
+        self.epoch = 0
+        self._pool = None
+        if num_workers > 0:
+            import multiprocessing as mp
+
+            # forkserver, NOT fork: the JAX runtime has live threads by
+            # loader-construction time (same rationale as ImageNetLoader)
+            try:
+                ctx = mp.get_context("forkserver")
+            except ValueError:
+                ctx = mp.get_context("spawn")
+            self._pool = ctx.Pool(
+                num_workers, initializer=_prep_worker_init,
+                initargs=(dict(samples=samples, seed=seed,
+                               prepare=type(self).PREPARE,
+                               kwargs=self._prep_kwargs()),))
+
+    def _prep_kwargs(self) -> dict:
+        raise NotImplementedError
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        full = len(self.samples) // self.batch_size
+        if not self.train and len(self.samples) % self.batch_size:
+            return full + 1  # eval covers the FULL set (padded last batch)
+        return full
+
+    def _prepare_indexed(self, i: int, epoch: int) -> dict:
+        rng = np.random.default_rng((self.seed, epoch, int(i)))
+        return type(self).PREPARE(self.samples[i], rng,
+                                  **self._prep_kwargs())
+
+    def close(self):
+        if self._pool is not None:
+            self._pool.terminate()
+            self._pool.join()
+            self._pool = None
+
+    def _assemble(self, items: list, weight) -> dict:
+        batch = {k: np.stack([it[k] for it in items]) for k in items[0]}
+        if not self.train:
+            # weight-0 fillers keep the batch shape static; loss metrics
+            # and host evaluators honor the mask (shared loader contract)
+            batch["weight"] = weight
+        return batch
+
+    def __iter__(self) -> Iterator[dict]:
+        from collections import deque
+
+        order = np.random.default_rng((self.seed, self.epoch))
+        idx = np.arange(len(self.samples))
+        if self.train:
+            order.shuffle(idx)
+        plan = [pad_eval_indices(idx, b * self.batch_size, self.batch_size)
+                for b in range(len(self))]
+        if self._pool is not None:
+            chunk = max(1, self.batch_size // (2 * self.num_workers))
+            pending: deque = deque()
+            submit = 0
+            for b in range(len(plan)):
+                while submit < len(plan) and len(pending) < \
+                        self.prefetch_batches:
+                    args = [(int(i), self.epoch) for i in plan[submit][0]]
+                    pending.append(self._pool.map_async(
+                        _prep_one, args, chunksize=chunk))
+                    submit += 1
+                yield self._assemble(pending.popleft().get(), plan[b][1])
+        else:
+            for sel, weight, _ in plan:
+                items = [self._prepare_indexed(int(i), self.epoch)
+                         for i in sel]
+                yield self._assemble(items, weight)
+
+
 class ArrayLoader:
     """In-memory dict-of-arrays dataset → shuffled fixed-size batches.
 

@@ -13,6 +13,7 @@ from typing import Iterator, Sequence
 import numpy as np
 
 from deep_vision_tpu.data.detection import resize_square
+from deep_vision_tpu.data.loader import PreppedSampleLoader
 from deep_vision_tpu.tasks.pose import make_heatmaps
 
 MPII_NUM_KEYPOINTS = 16
@@ -46,15 +47,41 @@ def crop_roi(img: np.ndarray, keypoints: np.ndarray, scale: float,
     return crop, out
 
 
-class PoseLoader:
-    """Batch iterator: crop → resize 256² → [0,1] floats + 64² heatmaps."""
+def prepare_pose_sample(sample: dict, rng: np.random.Generator, *,
+                        image_size: int, heatmap_size: int,
+                        flip_perm: np.ndarray, augment: bool,
+                        device_normalize: bool = False) -> dict:
+    img = sample["image"]
+    kp = np.asarray(sample["keypoints"], np.float32)
+    crop, norm_kp = crop_roi(img, kp, float(sample.get("scale", 1.0)))
+    if augment and rng.random() < 0.5:
+        crop = crop[:, ::-1]
+        # mirror x AND swap symmetric joints (left wrist ↔ right wrist)
+        norm_kp = norm_kp[flip_perm].copy()
+        norm_kp[:, 0] = 1.0 - norm_kp[:, 0]
+    img = resize_square(crop, image_size)
+    x = img if device_normalize else img.astype(np.float32) / 255.0
+    hm_kp = np.concatenate(
+        [norm_kp[:, :2] * heatmap_size, norm_kp[:, 2:3]], 1)
+    heat = make_heatmaps(hm_kp, heatmap_size, heatmap_size)
+    return {"image": x, "heatmaps": heat,
+            "keypoints": hm_kp.astype(np.float32)}
+
+
+class PoseLoader(PreppedSampleLoader):
+    """Batch iterator: crop → resize 256² → [0,1] floats (or uint8 with
+    ``device_normalize``) + 64² heatmaps.  Pool/prefetch/rng semantics:
+    :class:`~deep_vision_tpu.data.loader.PreppedSampleLoader`."""
+
+    PREPARE = staticmethod(prepare_pose_sample)
 
     def __init__(self, samples: Sequence[dict], batch_size: int,
                  image_size: int = 256, heatmap_size: int = 64,
                  num_keypoints: int = MPII_NUM_KEYPOINTS,
                  train: bool = True, seed: int = 0,
-                 flip_pairs: Sequence[tuple[int, int]] | None = MPII_FLIP_PAIRS):
-        self.samples = samples
+                 flip_pairs: Sequence[tuple[int, int]] | None = MPII_FLIP_PAIRS,
+                 device_normalize: bool = False, num_workers: int = 0,
+                 prefetch_batches: int = 2):
         # channel permutation applied on horizontal flip (left/right swap)
         perm = np.arange(num_keypoints)
         if flip_pairs:
@@ -62,56 +89,18 @@ class PoseLoader:
                 if a < num_keypoints and b < num_keypoints:
                     perm[a], perm[b] = perm[b], perm[a]
         self.flip_perm = perm
-        self.batch_size = batch_size
         self.image_size = image_size
         self.heatmap_size = heatmap_size
         self.num_keypoints = num_keypoints
-        self.train = train
-        self.seed = seed
-        self.epoch = 0
+        self.device_normalize = device_normalize
+        super().__init__(samples, batch_size, train, seed, num_workers,
+                         prefetch_batches)
 
-    def set_epoch(self, epoch: int):
-        self.epoch = epoch
-
-    def __len__(self) -> int:
-        full = len(self.samples) // self.batch_size
-        if not self.train and len(self.samples) % self.batch_size:
-            return full + 1  # eval covers the FULL set (padded last batch)
-        return full
-
-    def _prepare(self, sample: dict, rng: np.random.Generator) -> dict:
-        img = sample["image"]
-        kp = np.asarray(sample["keypoints"], np.float32)
-        crop, norm_kp = crop_roi(img, kp, float(sample.get("scale", 1.0)))
-        if self.train and rng.random() < 0.5:
-            crop = crop[:, ::-1]
-            # mirror x AND swap symmetric joints (left wrist ↔ right wrist)
-            norm_kp = norm_kp[self.flip_perm].copy()
-            norm_kp[:, 0] = 1.0 - norm_kp[:, 0]
-        x = resize_square(crop, self.image_size).astype(np.float32) / 255.0
-        hm_kp = np.concatenate(
-            [norm_kp[:, :2] * self.heatmap_size, norm_kp[:, 2:3]], 1)
-        heat = make_heatmaps(hm_kp, self.heatmap_size, self.heatmap_size)
-        return {"image": x, "heatmaps": heat,
-                "keypoints": hm_kp.astype(np.float32)}
-
-    def __iter__(self) -> Iterator[dict]:
-        from deep_vision_tpu.data.loader import pad_eval_indices
-
-        rng = np.random.default_rng((self.seed, self.epoch))
-        idx = np.arange(len(self.samples))
-        if self.train:
-            rng.shuffle(idx)
-        for b in range(len(self)):
-            # weight-0 fillers keep the batch shape static; the task's
-            # eval metrics mask them out (shared loader contract)
-            sel, weight, _ = pad_eval_indices(idx, b * self.batch_size,
-                                              self.batch_size)
-            items = [self._prepare(self.samples[i], rng) for i in sel]
-            batch = {k: np.stack([it[k] for it in items]) for k in items[0]}
-            if not self.train:
-                batch["weight"] = weight
-            yield batch
+    def _prep_kwargs(self) -> dict:
+        return dict(image_size=self.image_size,
+                    heatmap_size=self.heatmap_size,
+                    flip_perm=self.flip_perm, augment=self.train,
+                    device_normalize=self.device_normalize)
 
 
 def synthetic_pose_dataset(n: int, image_size: int = 256,

@@ -52,15 +52,9 @@ class RecordWriter:
 
 def read_records(path: str) -> Iterator[tuple[dict, bytes]]:
     with open(path, "rb") as f:
-        while True:
-            raw = f.read(4)
-            if len(raw) < 4:
-                return
-            (hlen,) = _U32.unpack(raw)
-            header = json.loads(f.read(hlen))
-            (plen,) = _U32.unpack(f.read(4))
-            payload = f.read(plen)
-            yield header, payload
+        for header, off, plen in scan_records(path):
+            f.seek(off)
+            yield header, f.read(plen)
 
 
 def shard_name(out_dir: str, split: str, i: int, n: int) -> str:

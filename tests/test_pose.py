@@ -97,3 +97,41 @@ def test_pose_loader_shapes():
     assert batch["image"].shape == (2, 64, 64, 3)
     assert batch["heatmaps"].shape == (2, 16, 16, 4)
     assert batch["heatmaps"].max() <= 12.0
+
+
+def test_pose_loader_pool_matches_sequential():
+    """Shared PreppedSampleLoader contract: pooled and sequential pose
+    iteration are byte-identical (per-item rng), flips included."""
+    from deep_vision_tpu.data.pose import PoseLoader, synthetic_pose_dataset
+
+    samples = synthetic_pose_dataset(6, image_size=64, num_keypoints=16)
+    seq = PoseLoader(samples, batch_size=3, image_size=64, heatmap_size=16,
+                     train=True, seed=4)
+    pooled = PoseLoader(samples, batch_size=3, image_size=64,
+                        heatmap_size=16, train=True, seed=4, num_workers=2)
+    try:
+        for a, b in zip(seq, pooled):
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+    finally:
+        pooled.close()
+
+
+def test_pose_loader_device_normalize_parity():
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.data.pose import PoseLoader, synthetic_pose_dataset
+    from deep_vision_tpu.ops.preprocess import make_scale_preprocess
+
+    samples = synthetic_pose_dataset(4, image_size=64, num_keypoints=16)
+    host = PoseLoader(samples, batch_size=4, image_size=64, heatmap_size=16,
+                      train=True, seed=6)
+    dev = PoseLoader(samples, batch_size=4, image_size=64, heatmap_size=16,
+                     train=True, seed=6, device_normalize=True)
+    hb, db = next(iter(host)), next(iter(dev))
+    assert db["image"].dtype == np.uint8
+    out = make_scale_preprocess()({"image": jnp.asarray(db["image"])},
+                                  None, True)
+    np.testing.assert_allclose(np.asarray(out["image"]), hb["image"],
+                               atol=1e-6)
+    np.testing.assert_array_equal(hb["heatmaps"], db["heatmaps"])
