@@ -566,6 +566,9 @@ def main():
                    help="forward-only serving throughput (yolo includes "
                         "on-device decode + NMS)")
     args = p.parse_args()
+    from deep_vision_tpu.core.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
     if args.all:
         bench_all()
         return

@@ -116,6 +116,10 @@ def main(argv=None):
             s.add_argument("--out", default="model.stablehlo")
     args = p.parse_args(argv)
 
+    from deep_vision_tpu.core.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
     from deep_vision_tpu.core.config import get_config
 
     cfg = get_config(args.model)

@@ -84,6 +84,10 @@ def main(argv=None):
         print("\n".join(list_configs()))
         return 0
 
+    from deep_vision_tpu.core.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+
     cfg = get_config(args.model)
     if args.epochs is not None:
         cfg.total_epochs = args.epochs

@@ -1,0 +1,16 @@
+"""Persistent-compile-cache plumbing (core/compile_cache.py)."""
+
+import jax
+
+from deep_vision_tpu.core.compile_cache import enable_compile_cache
+
+
+def test_enable_sets_jax_config(tmp_path):
+    p = enable_compile_cache(str(tmp_path / "xla"))
+    assert p == str(tmp_path / "xla")
+    assert jax.config.jax_compilation_cache_dir == p
+
+
+def test_env_opt_out(tmp_path, monkeypatch):
+    monkeypatch.setenv("DEEP_VISION_TPU_NO_COMPILE_CACHE", "1")
+    assert enable_compile_cache(str(tmp_path / "xla2")) is None
