@@ -77,6 +77,10 @@ class ImagePool:
 class DCGANTask:
     """models: generator (noise→image), discriminator (image→logit)."""
 
+    # no host state between steps → the AdversarialTrainer may scan K
+    # steps per dispatch (core/adversarial.py train_multi)
+    scan_safe = True
+
     def __init__(self, generator, discriminator, latent_dim: int = 100,
                  opt: OptimizerConfig | None = None):
         self.generator = generator
@@ -151,6 +155,10 @@ class DCGANTask:
 
 class CycleGANTask:
     """models: gen_a2b, gen_b2a, disc_a, disc_b."""
+
+    # the per-step host ImagePool exchange (host_prepare/host_update)
+    # is semantic — scanning would replay stale pools, so: per-step
+    scan_safe = False
 
     LAMBDA_CYCLE = 10.0  # train.py:16
     LAMBDA_ID = 5.0      # train.py:17

@@ -1,11 +1,21 @@
 """Persistent-compile-cache plumbing (core/compile_cache.py)."""
 
 import jax
+import pytest
 
 from deep_vision_tpu.core.compile_cache import enable_compile_cache
 
 
-def test_enable_sets_jax_config(tmp_path):
+@pytest.fixture
+def restore_cache_config():
+    prev_dir = jax.config.jax_compilation_cache_dir
+    prev_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    yield
+    jax.config.update("jax_compilation_cache_dir", prev_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", prev_min)
+
+
+def test_enable_sets_jax_config(tmp_path, restore_cache_config):
     p = enable_compile_cache(str(tmp_path / "xla"))
     assert p == str(tmp_path / "xla")
     assert jax.config.jax_compilation_cache_dir == p
