@@ -18,6 +18,12 @@ test-all:
 bench:
 	$(PY) bench.py
 
+bench-all:
+	$(PY) bench.py --all
+
+bench-pipeline:
+	$(PY) bench.py --pipeline
+
 train_%:
 	$(PY) -m deep_vision_tpu.cli.train -m $* --data-root $(DATA) \
 		--workdir $(WORKDIR)/$*

@@ -432,9 +432,15 @@ def bench_all() -> list[dict]:
     import sys
 
     results, failed = [], []
-    for task in ("resnet50", "yolo", "hourglass", "cyclegan", "dcgan"):
-        cmd = [sys.executable, __file__] + (
-            [] if task == "resnet50" else ["--task", task])
+    for task in ("resnet50", "yolo", "hourglass", "cyclegan", "dcgan",
+                 "infer:resnet50", "infer:yolo"):
+        if task == "resnet50":
+            extra = []
+        elif task.startswith("infer:"):
+            extra = ["--infer", task.split(":", 1)[1]]
+        else:
+            extra = ["--task", task]
+        cmd = [sys.executable, __file__] + extra
         proc = subprocess.run(cmd, capture_output=True, text=True)
         line = next((ln for ln in reversed(proc.stdout.splitlines())
                      if ln.startswith("{")), None)
