@@ -229,6 +229,8 @@ def bench_task(name: str, steps: int | None = None,
 
     - ``yolo``       YOLOv3-Darknet53 416², per-chip batch 16 (the
                      reference's per-GPU batch, YOLO/tensorflow/train.py:282)
+    - ``centernet``  CenterNet (2-stack hourglass) 256² batch 32
+                     (zoo/centernet.py — the stack the reference left broken)
     - ``hourglass``  Stacked Hourglass-104 256² batch 16, 16 joints @64²
     - ``cyclegan``   ResNet-9 G ×2 + PatchGAN D ×2, 256² batch 1
                      (CycleGAN/tensorflow/train.py batch_size=1)
@@ -299,6 +301,27 @@ def bench_task(name: str, steps: int | None = None,
             YoloV3(num_classes=80, dtype=jnp.bfloat16), YoloTask(80), batch,
             OptimizerConfig(name="sgd", learning_rate=1e-3, momentum=0.9),
             steps or 20, B, baseline=22.5)
+    elif name == "centernet":
+        from deep_vision_tpu.models.centernet import CenterNet
+        from deep_vision_tpu.tasks.centernet import (CenterNetTask,
+                                                     encode_centernet_labels)
+
+        B, S = batch or 32, 256  # zoo/centernet.py: batch 32 @ 256²
+        npr = np.random.default_rng(0)
+        enc = [encode_centernet_labels(
+            np.array([[0.3 + 0.4 * npr.random(), 0.3 + 0.4 * npr.random(),
+                       0.2, 0.2]], np.float32),
+            np.array([int(npr.integers(0, 80))]), 80, grid=S // 4)
+            for _ in range(B)]
+        batch = {k: jnp.asarray(np.stack([e[k] for e in enc]))
+                 for k in enc[0]}
+        batch["image"] = jnp.asarray(
+            npr.normal(size=(B, S, S, 3)).astype(np.float32))
+        single_state_run(
+            CenterNet(num_classes=80, dtype=jnp.bfloat16),
+            CenterNetTask(80), batch,
+            OptimizerConfig(name="adam", learning_rate=2.5e-4),
+            steps or 20, B)
     elif name == "hourglass":
         from deep_vision_tpu.models.hourglass import StackedHourglass
         from deep_vision_tpu.tasks.pose import PoseTask
@@ -432,8 +455,8 @@ def bench_all() -> list[dict]:
     import sys
 
     results, failed = [], []
-    for task in ("resnet50", "yolo", "hourglass", "cyclegan", "dcgan",
-                 "infer:resnet50", "infer:yolo"):
+    for task in ("resnet50", "yolo", "centernet", "hourglass", "cyclegan",
+                 "dcgan", "infer:resnet50", "infer:yolo"):
         if task == "resnet50":
             extra = []
         elif task.startswith("infer:"):
@@ -561,8 +584,8 @@ def main():
     p.add_argument("--host-normalize", action="store_true")
     p.add_argument("--source", choices=("raw", "records", "folder"),
                    default="raw", help="--pipeline storage variant")
-    p.add_argument("--task", choices=("yolo", "hourglass", "cyclegan",
-                                      "dcgan"), default=None,
+    p.add_argument("--task", choices=("yolo", "centernet", "hourglass",
+                                      "cyclegan", "dcgan"), default=None,
                    help="bench one non-classification task's train step at "
                         "its reference production shape")
     p.add_argument("--all", action="store_true",
