@@ -209,21 +209,27 @@ def _finish(out: dict, compiled, dt: float, n_steps: int, batch_size: int,
     out["batch"] = batch_size
 
 
-def _time_step(compiled, args, steps: int, loss_of):
+def _time_step(compiled, args, steps: int, loss_of, profile: bool = False):
     """Warm once, then time ``steps`` sequential dispatches, draining the
     async chain through a scalar fetch (block_until_ready can return early
     through the axon tunnel)."""
     out = compiled(*args)
     float(jax.device_get(loss_of(out)))
+    if profile:
+        jax.profiler.start_trace("/tmp/bench_profile")
     t0 = time.perf_counter()
     for _ in range(steps):
         out = compiled(*(out[:1] + args[1:]))
     float(jax.device_get(loss_of(out)))
-    return time.perf_counter() - t0
+    dt = time.perf_counter() - t0
+    if profile:
+        jax.profiler.stop_trace()
+        print("# trace written to /tmp/bench_profile")
+    return dt
 
 
 def bench_task(name: str, steps: int | None = None,
-               batch: int | None = None) -> dict:
+               batch: int | None = None, profile: bool = False) -> dict:
     """Train-step throughput for one non-classification task at the
     REFERENCE's production shapes (VERDICT r02 item 4):
 
@@ -272,7 +278,8 @@ def bench_task(name: str, steps: int | None = None,
 
         compiled = jax.jit(one_step, donate_argnums=0).lower(
             state, batch).compile()
-        dt = _time_step(compiled, (state, batch), n_steps, lambda o: o[1])
+        dt = _time_step(compiled, (state, batch), n_steps, lambda o: o[1],
+                        profile=profile)
         _finish(out, compiled, dt, n_steps, batch_size, baseline)
 
     if name == "yolo":
@@ -368,7 +375,7 @@ def bench_task(name: str, steps: int | None = None,
         compiled = jax.jit(task.train_step, donate_argnums=0).lower(
             states, batch, rng).compile()
         dt = _time_step(compiled, (states, batch, rng), n_steps,
-                        lambda o: next(iter(o[2].values())))
+                        lambda o: next(iter(o[2].values())), profile=profile)
         _finish(out, compiled, dt, n_steps, B)
     else:
         raise SystemExit(f"unknown --task {name}")
@@ -607,7 +614,8 @@ def main():
         return
     if args.task:
         print(json.dumps(bench_task(args.task, steps=args.steps,
-                                    batch=args.batch)))
+                                    batch=args.batch,
+                                    profile=args.profile)))
         return
     if args.pipeline:
         nw = args.num_workers if args.num_workers is not None \

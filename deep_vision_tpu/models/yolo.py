@@ -8,7 +8,7 @@ TPU-first notes:
   (sigmoid + grid offsets + anchor scaling) lives in
   ``tasks.detection.decode_boxes`` so the train graph and the eval graph
   share one codec;
-- upsample is ``jnp.repeat`` ×2 (nearest) — a layout op XLA folds into the
+- upsample is nearest ×2 via ``jax.image.resize`` — folds into the
   following conv;
 - all three scales come from ONE trace; no dynamic shapes anywhere.
 """
@@ -18,6 +18,7 @@ from __future__ import annotations
 from functools import partial
 from typing import Any, Sequence
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from flax import linen as nn
@@ -104,7 +105,10 @@ class Darknet53(nn.Module):
 
 
 def _upsample2(x):
-    return jnp.repeat(jnp.repeat(x, 2, axis=1), 2, axis=2)
+    # nearest-neighbor ×2 (see models/hourglass.py _up2: resize compiles
+    # with fewer layout copies than double jnp.repeat)
+    n, h, w, c = x.shape
+    return jax.image.resize(x, (n, 2 * h, 2 * w, c), "nearest")
 
 
 class YoloConvBlock(nn.Module):
