@@ -63,6 +63,7 @@ def test_nonfinite_step_is_skipped(tmp_path, mesh1):
     assert changed
 
 
+@pytest.mark.slow
 def test_lr_blowup_halts(tmp_path, mesh1):
     """An absurd LR drives the weights past float32 range (inf logits →
     nan loss) within a few steps; the epoch loop must halt with a clear

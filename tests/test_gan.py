@@ -126,6 +126,7 @@ def test_adversarial_trainer_smoke(tmp_path):
     assert img.shape == (2, 28, 28, 1)
 
 
+@pytest.mark.slow
 def test_adversarial_scan_steps_dcgan(tmp_path):
     """DCGAN (scan_safe) under scan_steps=2: 5 batches → 2 scanned groups
     + 1 ragged per-step tail, guard sees every step, losses stay finite."""

@@ -132,6 +132,7 @@ def test_merge_pretrained_without_head():
     assert out.shape == (1, 5)
 
 
+@pytest.mark.slow
 def test_eval_pretrained_harness(tmp_path, capsys):
     """The import→eval harness (docs/ACCURACY.md): `infer eval
     --pretrained x.pth` must run a full evaluation from a torch-format
@@ -158,13 +159,15 @@ def test_eval_pretrained_harness(tmp_path, capsys):
 
 
 def test_import_rejects_wrong_shape():
-    gen = torch.Generator().manual_seed(2)
     with torch.no_grad():
         net = TorchResNet50(num_classes=10)
-    sd = net.state_dict()
-    imported = import_torch_resnet(sd, "resnet50")
-    model = ResNet50(num_classes=7)  # head mismatch: 10 vs 7
-    fresh = model.init({"params": jax.random.PRNGKey(0)},
-                       jnp.zeros((1, 64, 64, 3)), train=False)
+    imported = import_torch_resnet(net.state_dict(), "resnet50")
+    # a freshly-initialized model with a 7-class head (vs the checkpoint's
+    # 10): same tree, different Dense_0 shapes — no flax init needed, the
+    # mismatch check is pure tree/shape validation
+    fresh = jax.tree_util.tree_map(np.asarray, imported)
+    fresh["params"]["Dense_0"] = {
+        "kernel": np.zeros((2048, 7), np.float32),
+        "bias": np.zeros((7,), np.float32)}
     with pytest.raises(ValueError, match="shape mismatch"):
-        merge_pretrained(dict(fresh), imported)
+        merge_pretrained(fresh, imported)

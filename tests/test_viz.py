@@ -49,6 +49,7 @@ def test_draw_keypoints_skeleton_and_visibility():
     assert (out2[15:26, 15:26] != 0).any(), "visible joint missing"
 
 
+@pytest.mark.slow
 def test_infer_detect_writes_annotated_image(tmp_path):
     """End-to-end CLI: random-init toy YOLO, threshold 0 → some boxes →
     --out writes an annotated file (the one-command demo path)."""
