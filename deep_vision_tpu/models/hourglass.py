@@ -99,6 +99,42 @@ class HourglassModule(nn.Module):
         return up1 + _up2(low)
 
 
+class HourglassStack(nn.Module):
+    """ONE hourglass stack as a standalone same-shape map — the pipeline
+    stage unit for :func:`deep_vision_tpu.parallel.pipeline.pipeline_apply`.
+
+    Maps a (B, H, W, filters) feature carry to (new_carry, heatmaps):
+    hourglass → residual → 1×1 linear layer → heatmap head → prediction
+    re-injection (hourglass104.py:138-157).  Unlike
+    :class:`StackedHourglass` (which skips re-injection on the final
+    stack), every stack is structurally identical — pipeline stages must
+    share one parameter tree structure; the last stack's re-injection
+    convs simply go unused downstream.
+    """
+
+    num_heatmap: int = 16
+    filters: int = 256
+    num_residual: int = 1
+    order: int = 4
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        y = HourglassModule(self.order, self.filters, self.num_residual,
+                            self.dtype)(x, train)
+        for _ in range(self.num_residual):
+            y = PreActBottleneck(self.filters, self.dtype)(y, train)
+        y = nn.Conv(self.filters, (1, 1), kernel_init=conv_kernel_init,
+                    dtype=self.dtype)(y)
+        y = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9, dtype=self.dtype)(y))
+        heat = nn.Conv(self.num_heatmap, (1, 1),
+                       kernel_init=conv_kernel_init, dtype=self.dtype)(y)
+        new_x = x + nn.Conv(self.filters, (1, 1), dtype=self.dtype)(y) \
+            + nn.Conv(self.filters, (1, 1), dtype=self.dtype)(heat)
+        return new_x, heat.astype(jnp.float32)
+
+
 class StackedHourglass(nn.Module):
     """256²×3 input → ``num_stack`` heatmap predictions at 64² — the full
     Hourglass-104 when num_stack=4 (hourglass104.py:113-159)."""

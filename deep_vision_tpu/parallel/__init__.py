@@ -8,10 +8,20 @@ from deep_vision_tpu.parallel.mesh import (
     batch_sharding,
     replicated_sharding,
 )
+from deep_vision_tpu.parallel.pipeline import (
+    PIPE_AXIS,
+    pipeline_apply,
+    stack_stages,
+    unstack_stages,
+)
 
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "PIPE_AXIS",
+    "pipeline_apply",
+    "stack_stages",
+    "unstack_stages",
     "make_mesh",
     "replicate",
     "shard_batch",
