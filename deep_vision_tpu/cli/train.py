@@ -34,6 +34,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scan-steps", type=int, default=None,
                    help="train steps per device dispatch (lax.scan "
                         "multi-step; amortizes host dispatch overhead)")
+    p.add_argument("--grad-accum", type=int, default=None,
+                   help="gradient-accumulation microbatches per optimizer "
+                        "update (full recipe batch on a fraction of HBM)")
     p.add_argument("--image-size", type=int, default=None,
                    help="override config (smoke runs at low res)")
     p.add_argument("--mesh", default=None,
@@ -95,6 +98,8 @@ def main(argv=None):
         cfg.batch_size = cfg.eval_batch_size = args.batch_size
     if args.scan_steps is not None:
         cfg.scan_steps = args.scan_steps
+    if args.grad_accum is not None:
+        cfg.grad_accum_steps = args.grad_accum
     if args.image_size is not None:
         cfg.image_size = args.image_size
 

@@ -38,6 +38,11 @@ class AdversarialTrainer:
     def __init__(self, config: TrainConfig, task, mesh=None,
                  workdir: str | None = None, upload: str | None = None):
         self.config = config
+        if getattr(config, "grad_accum_steps", 1) > 1:
+            raise NotImplementedError(
+                "grad_accum_steps applies to the single-optimizer Trainer "
+                "only; adversarial steps update G and D from the same "
+                "forward, so accumulate by lowering batch_size instead")
         self.task = task  # owns models, optimizers, and the step math
         self.mesh = mesh if mesh is not None else make_mesh()
         self.workdir = workdir or os.path.join("runs", config.name)

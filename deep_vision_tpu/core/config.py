@@ -48,6 +48,13 @@ class TrainConfig:
     # a tunneled v5e, worth ~4% throughput at K=40); logging/guard/
     # preemption work at K-step granularity. 1 = step-per-dispatch.
     scan_steps: int = 1
+    # gradient accumulation: split each global batch into this many
+    # sequential microbatches inside the jitted step, averaging grads
+    # before the single optimizer update — the full recipe batch on a
+    # fraction of the HBM.  (The reference's answer to OOM was shrinking
+    # the batch mid-run: ResNet/pytorch/train.py:141-148, VGG README's
+    # "batch 128→64".)  1 = off.
+    grad_accum_steps: int = 1
     seed: int = 42
     extra: dict = dataclasses.field(default_factory=dict)
 

@@ -161,30 +161,83 @@ class Trainer:
         task, has_bn = self.task, self._has_bn
         preprocess_fn = self.preprocess_fn
 
+        accum = max(1, getattr(self.config, "grad_accum_steps", 1))
+
+        def grad_one(apply_fn, params, batch_stats, dropout_rng, batch):
+            """loss/grads/BN-update for ONE (micro)batch."""
+
+            def loss_fn(params):
+                variables = {"params": params}
+                if has_bn:
+                    variables["batch_stats"] = batch_stats
+                out = apply_fn(
+                    variables, batch["image"], train=True,
+                    rngs={"dropout": dropout_rng},
+                    mutable=["batch_stats"] if has_bn else False)
+                if has_bn:
+                    out, new_vars = out
+                    new_bs = new_vars["batch_stats"]
+                else:
+                    new_bs = batch_stats
+                loss, aux = task.loss(out, batch)
+                return loss, (new_bs, aux)
+
+            (loss, (new_bs, aux)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            return loss, new_bs, aux, grads
+
         def train_step(state: TrainState, batch: dict):
             step_rng = jax.random.fold_in(state.rng, state.step)
             if preprocess_fn is not None:
                 batch = preprocess_fn(
                     batch, jax.random.fold_in(step_rng, 1), train=True)
 
-            def loss_fn(params):
-                variables = {"params": params}
-                if has_bn:
-                    variables["batch_stats"] = state.batch_stats
-                out = state.apply_fn(
-                    variables, batch["image"], train=True,
-                    rngs={"dropout": step_rng},
-                    mutable=["batch_stats"] if has_bn else False)
-                if has_bn:
-                    out, new_vars = out
-                    new_bs = new_vars["batch_stats"]
-                else:
-                    new_bs = state.batch_stats
-                loss, aux = task.loss(out, batch)
-                return loss, (new_bs, aux)
+            if accum == 1:
+                loss, new_bs, aux, grads = grad_one(
+                    state.apply_fn, state.params, state.batch_stats,
+                    step_rng, batch)
+            else:
+                # gradient accumulation: A sequential microbatches, one
+                # optimizer update.  Interleaved split (microbatch a =
+                # batch[a::A]) keeps every microbatch evenly spread over
+                # the data-sharded batch dim, so each micro-step is the
+                # same all-devices data-parallel step — GSPMD sees a
+                # local reshape, no resharding.  Mean-reduced losses make
+                # the averaged grads EXACTLY the full-batch grads for
+                # BN-free models (tests/test_grad_accum.py); with BN,
+                # stats thread through microbatches sequentially.
+                b = jax.tree_util.tree_leaves(batch)[0].shape[0]
+                if b % accum:
+                    raise ValueError(
+                        f"global batch {b} not divisible by "
+                        f"grad_accum_steps={accum}")
 
-            (loss, (new_bs, aux)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state.params)
+                def split(x):
+                    return jnp.swapaxes(
+                        x.reshape(x.shape[0] // accum, accum,
+                                  *x.shape[1:]), 0, 1)
+
+                micro = jax.tree_util.tree_map(split, batch)
+                gzero = jax.tree_util.tree_map(jnp.zeros_like, state.params)
+
+                def body(carry, xs):
+                    bs, gsum = carry
+                    mb, i = xs
+                    l, bs, a, g = grad_one(
+                        state.apply_fn, state.params, bs,
+                        jax.random.fold_in(step_rng, 2 + i), mb)
+                    gsum = jax.tree_util.tree_map(jnp.add, gsum, g)
+                    return (bs, gsum), (l, a)
+
+                (new_bs, gsum), (losses, auxes) = jax.lax.scan(
+                    body, (state.batch_stats, gzero),
+                    (micro, jnp.arange(accum)))
+                grads = jax.tree_util.tree_map(
+                    lambda g: g / accum, gsum)
+                loss = jnp.mean(losses)
+                aux = jax.tree_util.tree_map(
+                    lambda a: jnp.mean(a, axis=0), auxes)
+
             # divergence guard: a non-finite loss/grad step is skipped (not
             # applied) and counted; the epoch loop halts past
             # config.max_bad_steps (reference context: the NaN val losses
