@@ -43,8 +43,20 @@ def _load_state(cfg, workdir):
         if os.path.isdir(d):
             ckpt = ckpt_lib.Checkpointer(d)
             if ckpt.latest_step() is not None:
-                state, _ = ckpt.restore(state)
-                print(f"[infer] restored from {d} step {ckpt.latest_step()}")
+                if ckpt.has_state_key("ema_params"):
+                    # serve the averaged copy — the weights eval scored
+                    # and the deployment artifact (README: params EMA)
+                    state = state.replace(
+                        ema_params=jax.tree_util.tree_map(
+                            jnp.array, state.params))
+                    state, _ = ckpt.restore(state)
+                    state = state.replace(params=state.ema_params)
+                    print(f"[infer] restored from {d} step "
+                          f"{ckpt.latest_step()} (EMA weights)")
+                else:
+                    state, _ = ckpt.restore(state)
+                    print(f"[infer] restored from {d} step "
+                          f"{ckpt.latest_step()}")
                 break
     else:
         print("[infer] WARNING: no checkpoint found, using random init")

@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--grad-accum", type=int, default=None,
                    help="gradient-accumulation microbatches per optimizer "
                         "update (full recipe batch on a fraction of HBM)")
+    p.add_argument("--ema-decay", type=float, default=None,
+                   help="params EMA decay (e.g. 0.9999); eval/serving "
+                        "use the averaged copy")
     p.add_argument("--image-size", type=int, default=None,
                    help="override config (smoke runs at low res)")
     p.add_argument("--mesh", default=None,
@@ -100,6 +103,8 @@ def main(argv=None):
         cfg.scan_steps = args.scan_steps
     if args.grad_accum is not None:
         cfg.grad_accum_steps = args.grad_accum
+    if args.ema_decay is not None:
+        cfg.ema_decay = args.ema_decay
     if args.image_size is not None:
         cfg.image_size = args.image_size
 

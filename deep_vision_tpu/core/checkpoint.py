@@ -27,6 +27,12 @@ class Checkpointer:
             options=ocp.CheckpointManagerOptions(
                 max_to_keep=max_to_keep, create=True
             ),
+            # explicit handlers so item_metadata works BEFORE any
+            # save/restore registers them (has_state_key introspection)
+            item_handlers={
+                "state": ocp.StandardCheckpointHandler(),
+                "extras": ocp.JsonCheckpointHandler(),
+            },
         )
 
     def save(self, step: int, state: TrainState, extras: dict | None = None,
@@ -45,6 +51,20 @@ class Checkpointer:
 
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
+
+    def has_state_key(self, key: str, step: int | None = None) -> bool:
+        """True iff the stored state payload carries a NON-EMPTY ``key``
+        subtree (e.g. ``ema_params``) — lets callers reconcile state
+        fields the checkpoint may pre- or post-date before restoring."""
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return False
+        try:
+            meta = self._mgr.item_metadata(step)["state"]["state"]
+        except (KeyError, TypeError):
+            return False
+        return isinstance(meta, dict) and bool(meta.get(key))
 
     def _restore_payload(self, step: int, template: dict) -> tuple[dict, dict]:
         """Restore ``template``-shaped payload + extras; keys the stored

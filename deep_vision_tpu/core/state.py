@@ -67,6 +67,10 @@ class TrainState(struct.PyTreeNode):
     # train.py:126-130; we skip the bad update, count it, and let the host
     # loop halt past config.max_bad_steps)
     bad_steps: jax.Array
+    # exponential moving average of params ({} when disabled): the eval/
+    # serving copy of modern recipes.  Updated by the Trainer each applied
+    # step: ema = d·ema + (1−d)·params
+    ema_params: core.FrozenDict[str, Any] | dict
     apply_fn: Callable = struct.field(pytree_node=False)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
 
@@ -94,6 +98,7 @@ class TrainState(struct.PyTreeNode):
             params=sel(self.params, old.params),
             opt_state=sel(self.opt_state, old.opt_state),
             batch_stats=sel(self.batch_stats, old.batch_stats),
+            ema_params=sel(self.ema_params, old.ema_params),
             bad_steps=old.bad_steps + (~ok).astype(jnp.int32),
         )
 
@@ -105,7 +110,8 @@ class TrainState(struct.PyTreeNode):
         return self.apply_gradients(grads, **changes).keep_if(ok, self)
 
     @classmethod
-    def create(cls, *, apply_fn, params, tx, batch_stats=None, rng=None) -> "TrainState":
+    def create(cls, *, apply_fn, params, tx, batch_stats=None, rng=None,
+               ema: bool = False) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
@@ -113,6 +119,8 @@ class TrainState(struct.PyTreeNode):
             batch_stats=batch_stats if batch_stats is not None else {},
             rng=rng if rng is not None else jax.random.PRNGKey(0),
             bad_steps=jnp.zeros((), jnp.int32),
+            ema_params=(jax.tree_util.tree_map(jnp.array, params)
+                        if ema else {}),
             apply_fn=apply_fn,
             tx=tx,
         )
@@ -126,6 +134,7 @@ class TrainState(struct.PyTreeNode):
             "batch_stats": self.batch_stats,
             "rng": self.rng,
             "bad_steps": self.bad_steps,
+            "ema_params": self.ema_params,
         }
 
     def load_dict(self, payload: dict) -> "TrainState":

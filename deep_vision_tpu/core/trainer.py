@@ -65,6 +65,11 @@ class Trainer:
                  workdir: str | None = None, preprocess_fn=None,
                  upload: str | None = None):
         self.config = config
+        ema = float(getattr(config, "ema_decay", 0.0))
+        if not 0.0 <= ema < 1.0:
+            raise ValueError(
+                f"ema_decay={ema} must be in [0, 1): 1.0 would freeze the "
+                f"EMA at its init forever, >1 diverges")
         self.model = model
         self.task = task
         # optional device-side input preprocessing run INSIDE the jitted
@@ -135,7 +140,8 @@ class Trainer:
         self._has_bn = "batch_stats" in variables
         state = TrainState.create(
             apply_fn=self.model.apply, params=params, tx=self.tx,
-            batch_stats=batch_stats, rng=state_rng)
+            batch_stats=batch_stats, rng=state_rng,
+            ema=getattr(self.config, "ema_decay", 0.0) > 0)
         return replicate(state, self.mesh)
 
     def maybe_resume(self, state: TrainState) -> TrainState:
@@ -143,7 +149,20 @@ class Trainer:
         ``-c`` flag, ResNet/pytorch/train.py:381-388)."""
         if self.checkpointer.latest_step() is None:
             return state
-        state, extras = self.checkpointer.restore(state)
+        # reconcile EMA with what the checkpoint actually stores: enabling
+        # --ema-decay on a checkpoint trained without it must seed the EMA
+        # from the RESTORED params (not the fresh random init the template
+        # carries, and not crash on a {} / missing stored subtree)
+        ema_on = float(getattr(self.config, "ema_decay", 0.0)) > 0
+        if ema_on and not self.checkpointer.has_state_key("ema_params"):
+            state, extras = self.checkpointer.restore(
+                state.replace(ema_params={}))
+            state = state.replace(ema_params=jax.tree_util.tree_map(
+                jnp.array, state.params))
+            print("[resume] checkpoint has no EMA — seeded from restored "
+                  "params")
+        else:
+            state, extras = self.checkpointer.restore(state)
         self.start_epoch = int(extras.get("epoch", 0)) + 1
         if "scheduler" in extras:
             self.scheduler.load_state_dict(extras["scheduler"])
@@ -162,6 +181,7 @@ class Trainer:
         preprocess_fn = self.preprocess_fn
 
         accum = max(1, getattr(self.config, "grad_accum_steps", 1))
+        ema_decay = float(getattr(self.config, "ema_decay", 0.0))
 
         def grad_one(apply_fn, params, batch_stats, dropout_rng, batch):
             """loss/grads/BN-update for ONE (micro)batch."""
@@ -244,6 +264,13 @@ class Trainer:
             # Hourglass/tensorflow/train.py:126-130 only TODO'd about)
             new_state = state.apply_gradients_if_finite(
                 loss, grads, batch_stats=new_bs)
+            if ema_decay:
+                # guard-aware: a skipped step reverted params, so the EMA
+                # merely re-averages toward the unchanged weights
+                new_state = new_state.replace(
+                    ema_params=jax.tree_util.tree_map(
+                        lambda e, p: ema_decay * e + (1 - ema_decay) * p,
+                        new_state.ema_params, new_state.params))
             metrics = {"loss": loss, "bad_steps": new_state.bad_steps, **aux}
             return new_state, metrics
 
@@ -257,7 +284,16 @@ class Trainer:
             if preprocess_fn is not None:
                 batch = preprocess_fn(batch, jax.random.PRNGKey(0),
                                       train=False)
-            variables = {"params": state.params}
+            # modern-recipe semantics: with EMA on, validation/serving
+            # scores the averaged copy (what gets deployed), not the raw
+            # last-step weights.  Emptiness is pytree structure — static
+            # at trace time — so a state without an EMA copy (old
+            # checkpoint, external caller) falls back to raw params
+            # instead of crashing.
+            use_ema = ema_decay and bool(
+                jax.tree_util.tree_leaves(state.ema_params))
+            variables = {"params": state.ema_params if use_ema
+                         else state.params}
             if has_bn:
                 variables["batch_stats"] = state.batch_stats
             out = state.apply_fn(variables, batch["image"], train=False)
