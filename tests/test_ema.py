@@ -22,6 +22,7 @@ def _trainer(tmp_path, mesh, decay):
                    mesh=mesh, workdir=str(tmp_path))
 
 
+@pytest.mark.slow
 def test_ema_tracks_param_trajectory(tmp_path, mesh1):
     """After k steps, ema == d·ema + (1−d)·params applied per step to the
     actual param trajectory (verified against a host-side replay)."""
@@ -72,6 +73,7 @@ def test_ema_decay_out_of_range_rejected(tmp_path, mesh1):
         _trainer(tmp_path, mesh1, 1.0)
 
 
+@pytest.mark.slow
 def test_resume_enabling_ema_seeds_from_restored_params(tmp_path, mesh1):
     """Turning --ema-decay on over a checkpoint trained WITHOUT EMA must
     seed the EMA from the restored (trained) params — not crash on the
@@ -93,6 +95,7 @@ def test_resume_enabling_ema_seeds_from_restored_params(tmp_path, mesh1):
     assert np.isfinite(float(m["loss"]))
 
 
+@pytest.mark.slow
 def test_infer_load_state_serves_ema_weights(tmp_path, mesh1):
     """cli.infer's loader must hand every subcommand the averaged copy
     when the checkpoint carries one."""
