@@ -57,7 +57,12 @@ class TrainConfig:
     grad_accum_steps: int = 1
     # exponential moving average of params: eval/serving uses the EMA
     # copy (the modern-recipe trick for a ~0.2-0.5 top-1 bump at zero
-    # training cost).  0 = off.
+    # training cost).  0 = off.  PARAMS ONLY: BN running stats are served
+    # raw (tf.train.ExponentialMovingAverage semantics; timm's ModelEmaV2
+    # averages buffers too — both are defensible, this one keeps the
+    # stats a single source of truth).  The effective decay warms up as
+    # min(decay, (1+step)/(10+step)) so short/seeded runs aren't
+    # dominated by the init point.
     ema_decay: float = 0.0
     seed: int = 42
     extra: dict = dataclasses.field(default_factory=dict)

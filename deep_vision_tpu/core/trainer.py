@@ -266,10 +266,16 @@ class Trainer:
                 loss, grads, batch_stats=new_bs)
             if ema_decay:
                 # guard-aware: a skipped step reverted params, so the EMA
-                # merely re-averages toward the unchanged weights
+                # merely re-averages toward the unchanged weights.
+                # Warmup (tf.train.ExponentialMovingAverage num_updates /
+                # timm ModelEmaV2 semantics): the effective decay ramps as
+                # min(d, (1+t)/(10+t)) so short or freshly-seeded runs
+                # aren't dominated by the seed point at high decays.
+                t = new_state.step.astype(jnp.float32)
+                d = jnp.minimum(ema_decay, (1.0 + t) / (10.0 + t))
                 new_state = new_state.replace(
                     ema_params=jax.tree_util.tree_map(
-                        lambda e, p: ema_decay * e + (1 - ema_decay) * p,
+                        lambda e, p: d * e + (1 - d) * p,
                         new_state.ema_params, new_state.params))
             metrics = {"loss": loss, "bad_steps": new_state.bad_steps, **aux}
             return new_state, metrics

@@ -55,9 +55,14 @@ PIPE_AXIS = "pipe"
 def _pvary(x, axes=(PIPE_AXIS,)):
     """Mark ``x`` as varying over ``axes`` for shard_map's
     varying-manual-axes (VMA) type check; no-op on JAX versions without
-    the check."""
+    the check.  ``pcast(..., to="varying")`` is the current API (probed
+    first, guarded since its signature may still move); deprecated
+    ``pvary`` is the fallback for versions that predate it."""
     if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, tuple(axes), to="varying")
+        try:  # the current API (pvary is deprecated in its favor)
+            return jax.lax.pcast(x, tuple(axes), to="varying")
+        except TypeError:  # future signature drift: fall through
+            pass
     if hasattr(jax.lax, "pvary"):
         return jax.lax.pvary(x, tuple(axes))
     return x

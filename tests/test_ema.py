@@ -24,8 +24,9 @@ def _trainer(tmp_path, mesh, decay):
 
 @pytest.mark.slow
 def test_ema_tracks_param_trajectory(tmp_path, mesh1):
-    """After k steps, ema == d·ema + (1−d)·params applied per step to the
-    actual param trajectory (verified against a host-side replay)."""
+    """After k steps, ema == d_t·ema + (1−d_t)·params applied per step to
+    the actual param trajectory, with the warmup schedule
+    d_t = min(d, (1+t)/(10+t)) (verified against a host-side replay)."""
     d = 0.5
     trainer = _trainer(tmp_path, mesh1, d)
     data = synthetic_mnist(96)
@@ -37,9 +38,11 @@ def test_ema_tracks_param_trajectory(tmp_path, mesh1):
                                       jax.device_get(state.params))
     for b in batches:
         state, _ = trainer.train_step(state, dict(b))
+        t = float(jax.device_get(state.step))
+        d_t = min(d, (1.0 + t) / (10.0 + t))
         p = jax.tree_util.tree_map(np.asarray, jax.device_get(state.params))
         expected = jax.tree_util.tree_map(
-            lambda e, q: d * e + (1 - d) * q, expected, p)
+            lambda e, q: d_t * e + (1 - d_t) * q, expected, p)
 
     jax.tree_util.tree_map(
         lambda e, a: np.testing.assert_allclose(
