@@ -67,7 +67,8 @@ def _bce(logit_or_prob, target, from_probs: bool, eps: float = 1e-7):
 
 def yolo_scale_loss(raw, y_true, gt_boxes, gt_mask, anchors_wh,
                     ignore_thresh: float = 0.5, lambda_coord: float = 5.0,
-                    lambda_noobj: float = 0.5, use_pallas: bool = False):
+                    lambda_noobj: float = 0.5, use_pallas: bool = False,
+                    mesh=None):
     """Loss for ONE scale.
 
     raw: (B,G,G,A,5+C) head output; y_true: same shape, absolute xywh +
@@ -104,12 +105,21 @@ def yolo_scale_loss(raw, y_true, gt_boxes, gt_mask, anchors_wh,
     flat_pred = jax.lax.stop_gradient(pred_corners.reshape(B, -1, 4))
     if use_pallas:
         # fused tiled kernel (ops/pallas_ops.py) — avoids the (B,N,M) HBM
-        # intermediate; single-device only (pallas_call has no GSPMD
-        # partitioning rule, so keep the XLA path under sharded meshes)
-        from deep_vision_tpu.ops.pallas_ops import best_iou_max_auto
+        # intermediate.  pallas_call has no GSPMD partitioning rule, so a
+        # sharded mesh routes through a shard_map over the data axis (the
+        # reduction is per-image independent); single-device calls the
+        # kernel directly.
+        from deep_vision_tpu.ops.pallas_ops import (
+            best_iou_max_auto,
+            best_iou_max_sharded,
+        )
 
-        best_iou = best_iou_max_auto(flat_pred, gt_boxes,
-                                     gt_mask).reshape(obj.shape)
+        if mesh is not None and mesh.devices.size > 1:
+            best_iou = best_iou_max_sharded(
+                flat_pred, gt_boxes, gt_mask, mesh).reshape(obj.shape)
+        else:
+            best_iou = best_iou_max_auto(flat_pred, gt_boxes,
+                                         gt_mask).reshape(obj.shape)
     else:
         iou = broadcast_iou(flat_pred, gt_boxes)           # (B, N, M)
         iou = jnp.where(gt_mask[:, None, :] > 0, iou, 0.0)
@@ -142,12 +152,17 @@ class YoloTask:
                  anchors: np.ndarray = YOLO_ANCHORS,
                  masks: np.ndarray = ANCHOR_MASKS,
                  use_pallas: bool = False,
-                 eval_score_threshold: float = 0.05):
+                 eval_score_threshold: float = 0.05,
+                 mesh=None):
         self.num_classes = num_classes
         self.anchors = jnp.asarray(anchors)
         self.masks = masks
         self.use_pallas = use_pallas
         self.eval_score_threshold = eval_score_threshold
+        # mesh routes the Pallas kernel through a data-axis shard_map
+        # under multi-device meshes (best_iou_max_sharded); None or a
+        # 1-device mesh calls the kernel directly
+        self.mesh = mesh
 
     def _scale_anchors(self, scale: int):
         return self.anchors[self.masks[scale]]
@@ -158,7 +173,7 @@ class YoloTask:
             t, c = yolo_scale_loss(
                 raw, batch[f"y_true_{s}"], batch["boxes"],
                 batch["boxes_mask"], self._scale_anchors(s),
-                use_pallas=self.use_pallas)
+                use_pallas=self.use_pallas, mesh=self.mesh)
             totals = totals + t.mean()
             for k, v in c.items():
                 comps[f"{k}_{s}"] = v.mean()
@@ -175,7 +190,7 @@ class YoloTask:
             t, _ = yolo_scale_loss(
                 raw, batch[f"y_true_{s}"], batch["boxes"],
                 batch["boxes_mask"], self._scale_anchors(s),
-                use_pallas=self.use_pallas)
+                use_pallas=self.use_pallas, mesh=self.mesh)
             per_image = per_image + t
         loss_sum = (per_image * w).sum()
         return {"loss": loss_sum, "neg_loss": -loss_sum, "count": w.sum()}
