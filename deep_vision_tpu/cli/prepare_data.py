@@ -50,6 +50,13 @@ def main(argv=None):
                                "TPU from one host core (bigger shards)")
     imagenet.add_argument("--resize", type=int, default=256,
                           help="shorter-side rescale target for --store raw")
+    for s_, r_ in ((voc, 448), (coco, 448), (mpii, 384)):
+        s_.add_argument("--store", choices=("jpeg", "raw"), default="jpeg",
+                        help="raw: decode+rescale at build time, store "
+                             "uint8 — decode-free read path (labels are "
+                             "rescale-invariant/rescaled at build)")
+        s_.add_argument("--resize", type=int, default=r_,
+                        help="shorter-side rescale target for --store raw")
 
     # XML bbox tree → relative-coords CSV (process_bounding_boxes.py role)
     bboxes = sub.add_parser("imagenet-bboxes")
@@ -93,13 +100,16 @@ def main(argv=None):
 
     if args.cmd == "voc":
         n = prep.prepare_voc(args.voc_root, args.out, args.split, args.names,
-                             args.num_shards, args.num_workers, args.year)
+                             args.num_shards, args.num_workers, args.year,
+                             store=args.store, resize=args.resize)
     elif args.cmd == "coco":
         n = prep.prepare_coco(args.annotations, args.images, args.out,
-                              args.split, args.num_shards, args.num_workers)
+                              args.split, args.num_shards, args.num_workers,
+                              store=args.store, resize=args.resize)
     elif args.cmd == "mpii":
         n = prep.prepare_mpii(args.annotations, args.images, args.out,
-                              args.split, args.num_shards, args.num_workers)
+                              args.split, args.num_shards, args.num_workers,
+                              store=args.store, resize=args.resize)
     elif args.cmd == "imagenet":
         n = prep.prepare_imagenet(args.src, args.labels, args.out, args.split,
                                   args.num_shards, args.num_workers,

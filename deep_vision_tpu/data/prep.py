@@ -189,7 +189,8 @@ def parse_voc_xml(xml_path: str, names_map: dict[str, int]) -> dict:
 
 def prepare_voc(voc_root: str, out_dir: str, split: str = "train",
                 names_file: str | None = None, num_shards: int = 8,
-                num_workers: int = 8, year: str = "2007") -> int:
+                num_workers: int = 8, year: str = "2007",
+                store: str = "jpeg", resize: int = 448) -> int:
     """VOCdevkit/VOC{year}/{Annotations,JPEGImages} → dvrec shards."""
     base = os.path.join(voc_root, f"VOC{year}")
     anno_dir = os.path.join(base, "Annotations")
@@ -213,13 +214,15 @@ def prepare_voc(voc_root: str, out_dir: str, split: str = "train",
         with open(img_path, "rb") as f:
             s["image_bytes"] = f.read()
         samples.append(s)
-    R.write_detection_records(samples, out_dir, split, num_shards, num_workers)
-    return len(samples)
+    _, n = R.write_detection_records(samples, out_dir, split, num_shards,
+                                     num_workers, store=store, resize=resize)
+    return n
 
 
 def prepare_coco(annotation_json: str, image_dir: str, out_dir: str,
                  split: str = "train", num_shards: int = 16,
-                 num_workers: int = 8) -> int:
+                 num_workers: int = 8, store: str = "jpeg",
+                 resize: int = 448) -> int:
     """COCO instances JSON → dvrec (per-image grouping + 0-based classes)."""
     with open(annotation_json) as f:
         coco = json.load(f)
@@ -246,13 +249,15 @@ def prepare_coco(annotation_json: str, image_dir: str, out_dir: str,
                         "boxes": np.clip(np.asarray(boxes, np.float32)
                                          .reshape(-1, 4), 0, 1),
                         "classes": np.asarray(classes, np.int64)})
-    R.write_detection_records(samples, out_dir, split, num_shards, num_workers)
-    return len(samples)
+    _, n = R.write_detection_records(samples, out_dir, split, num_shards,
+                                     num_workers, store=store, resize=resize)
+    return n
 
 
 def prepare_mpii(annotation_json: str, image_dir: str, out_dir: str,
                  split: str = "train", num_shards: int = 8,
-                 num_workers: int = 8) -> int:
+                 num_workers: int = 8, store: str = "jpeg",
+                 resize: int = 384) -> int:
     """MPII pose JSON (list of {image, joints, joints_visibility, center,
     scale}) → pose dvrec.  Visibility remap 0→0, else→2 (reference :63)."""
     with open(annotation_json) as f:
@@ -272,8 +277,9 @@ def prepare_mpii(annotation_json: str, image_dir: str, out_dir: str,
                         "center": np.asarray(a.get("center", (0, 0)),
                                              np.float32),
                         "scale": float(a.get("scale", 1.0))})
-    R.write_pose_records(samples, out_dir, split, num_shards, num_workers)
-    return len(samples)
+    _, n = R.write_pose_records(samples, out_dir, split, num_shards,
+                                num_workers, store=store, resize=resize)
+    return n
 
 
 def load_synset_humans(metadata_file: str) -> dict[str, str]:

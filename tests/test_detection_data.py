@@ -98,6 +98,62 @@ def test_records_reject_missing(tmp_path):
         load_detection_records(str(tmp_path), "val")
 
 
+def test_raw_store_detection_roundtrip(tmp_path):
+    """--store raw: decode-free read path — pixels come back EXACTLY
+    (no JPEG loss), shorter side at the build-time resize, labels
+    unchanged (boxes are normalized), and the loader trains off it."""
+    samples = synthetic_detection_dataset(6, image_size=48, num_classes=2)
+    write_detection_records(samples, str(tmp_path), "train", num_shards=2,
+                            num_workers=1, store="raw", resize=48)
+    loaded = load_detection_records(str(tmp_path), "train")
+    assert len(loaded) == 6
+    # exact pixels (48² input, resize 48 → stored verbatim); order is
+    # round-robin across 2 shards: shard0 gets items 0,2,4
+    np.testing.assert_array_equal(loaded[0]["image"], samples[0]["image"])
+    got_boxes = sorted(tuple(np.round(b, 5)) for s in loaded
+                       for b in s["boxes"])
+    orig_boxes = sorted(tuple(np.round(b, 5)) for s in samples
+                        for b in s["boxes"])
+    assert got_boxes == orig_boxes
+    loader = DetectionLoader(loaded, batch_size=3, num_classes=2,
+                             image_size=48, train=True, seed=0)
+    batch = next(iter(loader))
+    assert batch["image"].shape == (3, 48, 48, 3)
+
+
+def test_raw_store_pose_rescales_pixel_labels(tmp_path):
+    """Pose raw store: keypoints/center/scale are pixel-space, so the
+    build-time rescale must scale them by the per-axis resize factors."""
+    from deep_vision_tpu.data.pose import PoseLoader
+    from deep_vision_tpu.data.records import (
+        load_pose_records,
+        write_pose_records,
+    )
+
+    rng = np.random.default_rng(0)
+    img = rng.integers(0, 255, (96, 128, 3), dtype=np.uint8)
+    kp = np.array([[64.0, 48.0, 2.0], [10.0, 90.0, 0.0]], np.float32)
+    sample = {"image": img, "keypoints": kp,
+              "center": np.array([64.0, 48.0], np.float32), "scale": 0.6}
+    write_pose_records([sample], str(tmp_path), "train", num_shards=1,
+                       num_workers=1, store="raw", resize=48)
+    (got,) = load_pose_records(str(tmp_path), "train")
+    assert got["image"].shape == (48, 64, 3)  # shorter side 96 → 48
+    fy, fx = 48 / 96, 64 / 128
+    np.testing.assert_allclose(got["keypoints"][:, 0], kp[:, 0] * fx,
+                               rtol=1e-6)
+    np.testing.assert_allclose(got["keypoints"][:, 1], kp[:, 1] * fy,
+                               rtol=1e-6)
+    np.testing.assert_array_equal(got["keypoints"][:, 2], kp[:, 2])
+    np.testing.assert_allclose(got["center"], [64 * fx, 48 * fy])
+    np.testing.assert_allclose(got["scale"], 0.6 * fy, rtol=1e-6)
+    loader = PoseLoader([got] * 4, batch_size=4, image_size=32,
+                        heatmap_size=8, num_keypoints=2, train=True)
+    batch = next(iter(loader))
+    assert batch["image"].shape == (4, 32, 32, 3)
+    assert batch["heatmaps"].shape == (4, 8, 8, 2)
+
+
 def test_loader_feeds_trainer_loss():
     import jax.numpy as jnp
 
