@@ -43,7 +43,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--image-size", type=int, default=None,
                    help="override config (smoke runs at low res)")
     p.add_argument("--mesh", default=None,
-                   help="mesh spec like 'data=8' or 'data=4,model=2'")
+                   help="mesh spec like 'data=8', 'data=4,model=2', or "
+                        "'data=2,pipe=4' (pose: GPipe pipeline over the "
+                        "hourglass stacks)")
+    p.add_argument("--microbatches", type=int, default=None,
+                   help="pipeline microbatches per step (with a pipe mesh "
+                        "axis; default = pipe axis size)")
     p.add_argument("--num-workers", type=int, default=16,
                    help="decode/augment worker processes (ImageNet, "
                         "detection, and pose loaders; 0 = inline prep, "
@@ -289,24 +294,26 @@ def _main_detection(args, cfg, mesh):
         from deep_vision_tpu.data.detection import DetectionLoader as LoaderCls
         from deep_vision_tpu.tasks.detection import YoloTask
 
-        # pallas ignore-mask kernel: single-device TPU only (pallas_call
-        # has no GSPMD partitioning rule under a sharded mesh), and only
-        # after a one-batch parity check against the XLA path
-        use_pallas = (mesh.devices.size == 1
-                      and jax.default_backend() == "tpu")
+        # pallas ignore-mask kernel: TPU only, gated on a parity check;
+        # sharded meshes route it through a data-axis shard_map
+        # (best_iou_max_sharded), so multi-chip keeps the fused path
+        use_pallas = jax.default_backend() == "tpu"
         if use_pallas:
             from deep_vision_tpu.ops.pallas_ops import pallas_parity_ok
             from deep_vision_tpu.tasks.detection import MAX_BOXES
 
-            # check at the REAL training shapes — Mosaic tiling/VMEM limits
+            # check at the REAL compiled shapes — Mosaic tiling/VMEM limits
             # are shape-dependent, so toy shapes prove nothing; the loss
-            # calls the kernel once PER SCALE with that scale's n_pred
+            # calls the kernel once PER SCALE with that scale's n_pred, and
+            # under shard_map the kernel sees the PER-SHARD batch
+            per_shard = max(cfg.batch_size // mesh.shape.get("data", 1), 1)
             use_pallas = all(
-                pallas_parity_ok(batch=cfg.batch_size,
+                pallas_parity_ok(batch=per_shard,
                                  n_pred=3 * (cfg.image_size // s) ** 2,
                                  n_gt=MAX_BOXES)
                 for s in (8, 16, 32))
-        task = YoloTask(cfg.num_classes, use_pallas=use_pallas)
+        task = YoloTask(cfg.num_classes, use_pallas=use_pallas,
+                        mesh=mesh if mesh.devices.size > 1 else None)
     if args.synthetic:
         train_samples = synthetic_detection_dataset(
             args.synthetic_size, cfg.image_size,
@@ -391,7 +398,19 @@ def _main_pose(args, cfg, mesh):
     val_loader = PoseLoader(val_samples, cfg.batch_size, cfg.image_size,
                             heatmap_size, cfg.num_classes, train=False,
                             device_normalize=dev_norm)
-    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir,
+    # pipeline-parallel training mode: a pipe mesh axis shards the
+    # hourglass stacks over devices (GPipe microbatch pipeline) — the
+    # monolithic config's num_stack/filters/order carry over unchanged
+    if mesh.shape.get("pipe", 1) > 1:
+        from deep_vision_tpu.parallel.pipelined import PipelinedModel
+
+        model = PipelinedModel.from_stacked_hourglass(
+            cfg.model(), mesh, num_microbatches=args.microbatches)
+        print(f"[pipeline] {model.num_stages} stages over pipe="
+              f"{mesh.shape['pipe']}, {model.num_microbatches} microbatches")
+    else:
+        model = cfg.model()
+    trainer = Trainer(cfg, model, task, mesh=mesh, workdir=args.workdir,
                       preprocess_fn=preprocess_fn, upload=args.upload)
     try:
         state = trainer.fit(train_loader, val_loader, resume=args.resume)

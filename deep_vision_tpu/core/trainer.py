@@ -142,7 +142,26 @@ class Trainer:
             apply_fn=self.model.apply, params=params, tx=self.tx,
             batch_stats=batch_stats, rng=state_rng,
             ema=getattr(self.config, "ema_decay", 0.0) > 0)
-        return replicate(state, self.mesh)
+        return self._place_state(state)
+
+    def _place_state(self, state: TrainState) -> TrainState:
+        """Place state on the mesh.  Models that partition their own state
+        (e.g. pipeline stages over ``pipe`` —
+        ``parallel.pipelined.PipelinedModel.state_partition_rule``) expose
+        a per-leaf rule: (path string, leaf) → PartitionSpec; params, EMA
+        copy, and optimizer moments all flow through it (the moments
+        mirror the param tree, so path matching covers them).  Without a
+        rule, everything is replicated (the dp/tp default)."""
+        rule = getattr(self.model, "state_partition_rule", None)
+        if rule is None:
+            return replicate(state, self.mesh)
+        from jax.sharding import NamedSharding
+
+        def place(path, leaf):
+            spec = rule(jax.tree_util.keystr(path), leaf)
+            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+
+        return jax.tree_util.tree_map_with_path(place, state)
 
     def maybe_resume(self, state: TrainState) -> TrainState:
         """Resume from the latest checkpoint if one exists (the reference's
@@ -172,7 +191,7 @@ class Trainer:
         self.guard.set_baseline(int(jax.device_get(state.bad_steps)))
         print(f"[resume] restored step={int(state.step)} "
               f"start_epoch={self.start_epoch}")
-        return replicate(state, self.mesh)
+        return self._place_state(state)
 
     # ------------------------------------------------------------- jit steps
 

@@ -135,6 +135,32 @@ class HourglassStack(nn.Module):
         return new_x, heat.astype(jnp.float32)
 
 
+class HourglassStem(nn.Module):
+    """The pre-stack head (hourglass104.py:121-130): 7×7/2 conv →
+    bottleneck → 2×2 pool → two bottlenecks, H×W → H/4×W/4 at ``filters``.
+
+    Factored out of :class:`StackedHourglass` for the pipelined variant;
+    submodule auto-names (Conv_0, BatchNorm_0, PreActBottleneck_0-2) are
+    kept IDENTICAL to the stem portion of the monolithic network so
+    :func:`merge_stacked_variables` is a pure rename."""
+
+    filters: int = 256
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), (2, 2), padding="SAME",
+                    kernel_init=conv_kernel_init, dtype=self.dtype)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9, dtype=self.dtype)(x))
+        x = PreActBottleneck(128, self.dtype)(x, train)
+        x = nn.max_pool(x, (2, 2), (2, 2))
+        x = PreActBottleneck(128, self.dtype)(x, train)
+        x = PreActBottleneck(self.filters, self.dtype)(x, train)
+        return x
+
+
 class StackedHourglass(nn.Module):
     """256²×3 input → ``num_stack`` heatmap predictions at 64² — the full
     Hourglass-104 when num_stack=4 (hourglass104.py:113-159)."""
@@ -143,6 +169,7 @@ class StackedHourglass(nn.Module):
     num_heatmap: int = 16
     filters: int = 256
     num_residual: int = 1
+    order: int = 4
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -162,7 +189,7 @@ class StackedHourglass(nn.Module):
 
         outputs = []
         for s in range(self.num_stack):
-            y = HourglassModule(4, self.filters, self.num_residual,
+            y = HourglassModule(self.order, self.filters, self.num_residual,
                                 self.dtype)(x, train)
             for _ in range(self.num_residual):
                 y = PreActBottleneck(self.filters, self.dtype)(y, train)
@@ -178,3 +205,82 @@ class StackedHourglass(nn.Module):
                 x = x + nn.Conv(self.filters, (1, 1), dtype=self.dtype)(y) \
                     + nn.Conv(self.filters, (1, 1), dtype=self.dtype)(heat)
         return tuple(outputs)
+
+
+# --------------------------------------------------------------------------
+# Variable-layout conversion: monolithic StackedHourglass <-> (HourglassStem
+# + per-stage HourglassStack) — the layout the pipeline-parallel training
+# mode uses (parallel/pipelined.py).  Both directions are pure renames of
+# the SAME math: flax auto-names submodules in call order, so the mapping
+# below mirrors the two ``__call__`` bodies line by line.
+
+def _stage_name_map(s: int, num_stack: int, num_residual: int) -> dict:
+    """HourglassStack submodule name → its name inside StackedHourglass
+    for stack ``s``.  Monolithic call order per stack: HourglassModule,
+    ``num_residual`` bottlenecks, linear Conv+BN, heatmap Conv, and (all
+    but the last stack) two re-injection Convs — so the monolithic Conv
+    counter advances 4 per stack (1 stem Conv before it) and the
+    bottleneck counter ``num_residual`` per stack (3 stem bottlenecks)."""
+    r = num_residual
+    base = 1 + 4 * s
+    m = {"HourglassModule_0": f"HourglassModule_{s}",
+         "Conv_0": f"Conv_{base}",
+         "BatchNorm_0": f"BatchNorm_{1 + s}",
+         "Conv_1": f"Conv_{base + 1}"}
+    for j in range(r):
+        m[f"PreActBottleneck_{j}"] = f"PreActBottleneck_{3 + s * r + j}"
+    if s < num_stack - 1:
+        m["Conv_2"] = f"Conv_{base + 2}"
+        m["Conv_3"] = f"Conv_{base + 3}"
+    return m
+
+
+def merge_stacked_variables(stem_vars, stage_vars_list,
+                            num_residual: int = 1) -> dict:
+    """(HourglassStem variables, [per-stage HourglassStack variables]) →
+    monolithic :class:`StackedHourglass` variables.  The final stage's
+    re-injection convs (structurally present in every HourglassStack but
+    unused downstream) have no monolithic counterpart and are dropped.
+    Used to export pipeline-trained checkpoints to the layout
+    ``cli.infer`` and single-device serving load."""
+    num_stack = len(stage_vars_list)
+    cols = set(stem_vars) | {c for v in stage_vars_list for c in v}
+    out = {}
+    for col in cols:
+        merged = dict(stem_vars.get(col, {}))
+        for s, sv in enumerate(stage_vars_list):
+            names = _stage_name_map(s, num_stack, num_residual)
+            for src, dst in names.items():
+                if src in sv.get(col, {}):
+                    merged[dst] = sv[col][src]
+        out[col] = merged
+    return out
+
+
+def split_stacked_variables(variables, template_stage_vars,
+                            num_residual: int = 1) -> tuple[dict, list]:
+    """Inverse of :func:`merge_stacked_variables`: monolithic
+    :class:`StackedHourglass` variables → ``(stem_vars, [stage_vars])``.
+    The final stage's re-injection convs don't exist in the monolithic
+    net; they are taken from ``template_stage_vars`` (a per-stage list,
+    e.g. a fresh pipelined init) — they receive no gradient, so any
+    finite values preserve the trajectory."""
+    num_stack = len(template_stage_vars)
+    stem_names = {"Conv_0", "BatchNorm_0", "PreActBottleneck_0",
+                  "PreActBottleneck_1", "PreActBottleneck_2"}
+    stem_vars = {col: {k: v for k, v in tree.items() if k in stem_names}
+                 for col, tree in variables.items()}
+    stage_vars = []
+    for s in range(num_stack):
+        names = _stage_name_map(s, num_stack, num_residual)
+        sv = {}
+        for col, tree in variables.items():
+            tmpl = template_stage_vars[s].get(col, {})
+            sub = {src: tree[dst] for src, dst in names.items()
+                   if dst in tree}
+            for k in tmpl:  # final stage: Conv_2/Conv_3 from the template
+                if k not in sub:
+                    sub[k] = tmpl[k]
+            sv[col] = sub
+        stage_vars.append(sv)
+    return stem_vars, stage_vars

@@ -1,0 +1,250 @@
+"""Pipeline parallelism as a TRAINING MODE: a Trainer-compatible model
+built from a stem module plus ``S`` identical same-shape stage modules,
+run as a GPipe microbatch pipeline over the mesh's ``pipe`` axis
+(parallel/pipeline.py).
+
+The reference trains its deepest model (Stacked Hourglass,
+Hourglass/tensorflow/train.py:195-226) whole-network data-parallel; here
+``cli.train -m hourglass104 --mesh data=d,pipe=p`` shards the stack
+sequence over devices instead: each device holds S/p stages' params and
+optimizer state (placed with :meth:`PipelinedModel.state_partition_rule`)
+and only its stages' activations — the memory that actually bounds deep
+stacks.
+
+Design notes:
+- ``PipelinedModel`` duck-types a Flax module (``init``/``apply``) so the
+  unified Trainer (core/trainer.py) uses it unchanged — grad-accum, EMA,
+  divergence guard, checkpointing, and scan dispatch all compose.
+- The stem runs data-parallel ahead of the pipeline (replicated over
+  ``pipe`` — it is a few % of the FLOPs); stages run via
+  :func:`pipeline_apply` with BatchNorm running stats threaded as
+  device-local pipeline state and pmean-ed over ``data``.
+- BN semantics: stages normalize per microbatch per data shard (the
+  standard GPipe choice); the monolithic network normalizes over the
+  global batch.  With ``num_microbatches=1`` on a ``data=1`` mesh the
+  two coincide and the pipelined trajectory matches the monolithic
+  :class:`~deep_vision_tpu.models.hourglass.StackedHourglass` exactly
+  (tests/test_pipeline_trainer.py).
+- Checkpoints store the pipelined layout ({stem, stages}); convert to
+  the monolithic layout for serving with
+  :func:`deep_vision_tpu.models.hourglass.merge_stacked_variables`.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from deep_vision_tpu.parallel.mesh import DATA_AXIS
+from deep_vision_tpu.parallel.pipeline import (
+    PIPE_AXIS,
+    pipeline_apply,
+    stack_stages,
+    unstack_stages,
+)
+
+
+class PipelinedModel:
+    """Stem + ``num_stages`` identical same-shape stages as one model.
+
+    ``stage`` must map ``(carry) -> (new_carry, output)`` with carry
+    shape preserved (the stacked-hourglass contract); intermediate
+    outputs come back as a tuple, one per stage, matching the monolithic
+    network's intermediate-supervision interface.
+
+    ``num_microbatches`` defaults to the ``pipe`` axis size (the minimum
+    that keeps every pipeline stage busy); it is reduced at trace time
+    when a (smaller, e.g. final eval) batch isn't divisible — a static
+    shape-derived fallback, numerically exact either way.
+    """
+
+    def __init__(self, stem, stage, num_stages: int, mesh,
+                 num_microbatches: int | None = None):
+        if PIPE_AXIS not in mesh.shape:
+            raise ValueError(f"mesh {dict(mesh.shape)} has no "
+                             f"'{PIPE_AXIS}' axis")
+        if num_stages % mesh.shape[PIPE_AXIS]:
+            raise ValueError(
+                f"num_stages={num_stages} not divisible by pipe axis "
+                f"size {mesh.shape[PIPE_AXIS]}")
+        self.stem = stem
+        self.stage = stage
+        self.num_stages = num_stages
+        self.mesh = mesh
+        self.num_microbatches = (num_microbatches
+                                 or max(mesh.shape[PIPE_AXIS], 1))
+
+    @classmethod
+    def from_stacked_hourglass(cls, model, mesh,
+                               num_microbatches: int | None = None):
+        """Build the pipelined equivalent of a monolithic
+        :class:`~deep_vision_tpu.models.hourglass.StackedHourglass`."""
+        from deep_vision_tpu.models.hourglass import (
+            HourglassStack,
+            HourglassStem,
+            StackedHourglass,
+        )
+
+        if not isinstance(model, StackedHourglass):
+            raise TypeError(
+                f"pipeline training mode supports StackedHourglass "
+                f"configs; got {type(model).__name__}")
+        stem = HourglassStem(filters=model.filters, dtype=model.dtype)
+        stage = HourglassStack(
+            num_heatmap=model.num_heatmap, filters=model.filters,
+            num_residual=model.num_residual, order=model.order,
+            dtype=model.dtype)
+        return cls(stem, stage, model.num_stack, mesh, num_microbatches)
+
+    # ------------------------------------------------------- module protocol
+
+    def init(self, rngs, x, train: bool = False) -> dict:
+        """Flax-style init: stem init + ``num_stages`` stage inits stacked
+        on a leading stage axis (the layout ``pipeline_apply`` shards)."""
+        if not isinstance(rngs, dict):
+            rngs = {"params": rngs}
+        stem_vars = self.stem.init(rngs, x, train=False)
+        carry = self.stem.apply(stem_vars, x, train=False)
+        keys = jax.random.split(
+            jax.random.fold_in(rngs["params"], 1), self.num_stages)
+        stage_vars = [self.stage.init({"params": k}, carry, train=False)
+                      for k in keys]
+        out = {"params": {
+            "stem": stem_vars["params"],
+            "stages": stack_stages([v["params"] for v in stage_vars]),
+        }}
+        if "batch_stats" in stem_vars or "batch_stats" in stage_vars[0]:
+            out["batch_stats"] = {
+                "stem": stem_vars.get("batch_stats", {}),
+                "stages": stack_stages(
+                    [v.get("batch_stats", {}) for v in stage_vars]),
+            }
+        return out
+
+    def apply(self, variables, x, train: bool = False, mutable=False,
+              rngs=None):
+        params = variables["params"]
+        stats = variables.get("batch_stats", {})
+        has_bn = bool(stats)
+        # one switch for the WHOLE network: batch-statistics BN requires a
+        # mutable stats channel, so train-mode without mutable coherently
+        # degrades to eval-mode everywhere (stem and stages must never
+        # disagree on BN semantics)
+        bn_train = train and bool(mutable) and has_bn
+        want_mutable = bool(mutable)
+
+        stem_in = {"params": params["stem"]}
+        if has_bn:
+            stem_in["batch_stats"] = stats["stem"]
+        if bn_train:
+            carry, stem_upd = self.stem.apply(
+                stem_in, x, train=True, mutable=["batch_stats"], rngs=rngs)
+            new_stem_stats = stem_upd["batch_stats"]
+        else:
+            carry = self.stem.apply(stem_in, x, train=False)
+            new_stem_stats = stem_in.get("batch_stats", {})
+
+        stage, mesh = self.stage, self.mesh
+
+        def stage_fn(p, c, s):
+            vin = {"params": p}
+            if has_bn:
+                vin["batch_stats"] = s
+            if bn_train:
+                (c2, out), upd = stage.apply(
+                    vin, c, train=True, mutable=["batch_stats"])
+                return c2, out, upd["batch_stats"]
+            c2, out = stage.apply(vin, c, train=False)
+            return c2, out, s
+
+        outs, new_stage_stats = pipeline_apply(
+            stage_fn, params["stages"], carry, mesh=mesh,
+            num_microbatches=self._microbatches_for(x.shape[0]),
+            stage_state=stats.get("stages", {}) if has_bn else None)
+        outputs = tuple(outs[i] for i in range(self.num_stages))
+        if want_mutable:
+            return outputs, {"batch_stats": {
+                "stem": new_stem_stats, "stages": new_stage_stats}}
+        return outputs
+
+    def _microbatches_for(self, global_batch: int) -> int:
+        """Largest M ≤ ``num_microbatches`` dividing the per-data-shard
+        batch (static, shape-derived — eval batches may be smaller)."""
+        per_shard = global_batch // self.mesh.shape.get(DATA_AXIS, 1)
+        m = max(1, min(self.num_microbatches, per_shard))
+        while per_shard % m:
+            m -= 1
+        return m
+
+    # ------------------------------------------------------------- placement
+
+    def state_partition_rule(self, path: str, leaf) -> P:
+        """PartitionSpec for one TrainState leaf: stage-stacked leaves
+        (params/EMA/optimizer moments under the ``stages`` subtree) shard
+        their leading stage axis over ``pipe``; everything else is
+        replicated.  Consumed by ``Trainer._place_state``."""
+        if ("stages" in path and getattr(leaf, "ndim", 0) >= 1
+                and leaf.shape[0] == self.num_stages):
+            return P(PIPE_AXIS)
+        return P()
+
+    # ------------------------------------------------------------- export
+
+    def import_monolithic_variables(self, variables, template_variables):
+        """Monolithic StackedHourglass variables → pipelined layout, so a
+        pipe-mesh run can start from a monolithic checkpoint.
+        ``template_variables`` is a pipelined ``init`` result — it donates
+        the final stage's re-injection convs (absent in the monolithic
+        net; they receive no gradient, so values are trajectory-neutral).
+        """
+        from deep_vision_tpu.models.hourglass import split_stacked_variables
+
+        tp = unstack_stages(template_variables["params"]["stages"])
+        has_bn = "batch_stats" in template_variables
+        ts = unstack_stages(template_variables["batch_stats"]["stages"]) \
+            if has_bn else [{} for _ in tp]
+        tpl = []
+        for p, s in zip(tp, ts):
+            d = {"params": p}
+            if s:
+                d["batch_stats"] = s
+            tpl.append(d)
+        stem_v, stage_v = split_stacked_variables(
+            variables, tpl,
+            num_residual=getattr(self.stage, "num_residual", 1))
+        out = {"params": {
+            "stem": stem_v["params"],
+            "stages": stack_stages([t["params"] for t in stage_v]),
+        }}
+        if "batch_stats" in variables:
+            out["batch_stats"] = {
+                "stem": stem_v.get("batch_stats", {}),
+                "stages": stack_stages(
+                    [t.get("batch_stats", {}) for t in stage_v]),
+            }
+        return out
+
+    def export_monolithic_variables(self, params, batch_stats) -> dict:
+        """Pipeline-layout state → monolithic StackedHourglass variables
+        (for ``cli.infer`` / single-device serving)."""
+        from deep_vision_tpu.models.hourglass import merge_stacked_variables
+
+        params = jax.device_get(params)
+        batch_stats = jax.device_get(batch_stats)
+        stage_list = []
+        p_list = unstack_stages(params["stages"])
+        s_list = unstack_stages(batch_stats["stages"]) if batch_stats else \
+            [{} for _ in p_list]
+        for p, s in zip(p_list, s_list):
+            sv = {"params": p}
+            if s:
+                sv["batch_stats"] = s
+            stage_list.append(sv)
+        stem_vars = {"params": params["stem"]}
+        if batch_stats:
+            stem_vars["batch_stats"] = batch_stats["stem"]
+        return merge_stacked_variables(
+            stem_vars, stage_list,
+            num_residual=getattr(self.stage, "num_residual", 1))

@@ -13,6 +13,25 @@ from deep_vision_tpu.core.config import (
 from deep_vision_tpu.models.hourglass import StackedHourglass
 
 
+@register_config("hourglass_toy")
+def hourglass_toy():
+    """Shrunken stack (order-2, 16 filters, 64² input) for smoke runs and
+    the pipeline-mode tests — same structure, minutes not hours."""
+    return TrainConfig(
+        name="hourglass_toy",
+        model=lambda: StackedHourglass(num_stack=4, num_heatmap=8,
+                                       filters=16, order=2,
+                                       dtype=jnp.float32),
+        task="pose",
+        batch_size=16,
+        total_epochs=2,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        image_size=64,
+        num_classes=8,
+        half_precision=False,
+    )
+
+
 @register_config("hourglass104")
 def hourglass104():
     return TrainConfig(
