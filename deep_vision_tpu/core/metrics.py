@@ -20,18 +20,42 @@ class MetricLogger:
     def __init__(self, workdir: str | None = None,
                  filename: str = "metrics.jsonl", tensorboard: bool = True):
         self.history: dict[str, dict[str, list]] = {}
+        self._workdir = workdir
+        self._filename = filename
+        self._tensorboard = tensorboard
         self._path = None
         self._tb = None
         self._tb_dir = None
-        if workdir is not None:
-            os.makedirs(workdir, exist_ok=True)
-            self._path = os.path.join(workdir, filename)
-            if tensorboard:
-                # lazy: the event file is only created on first log, so
-                # never-logging components don't litter empty files
-                self._tb_dir = os.path.join(workdir, "tensorboard")
+        self._resolved = False
+
+    def _resolve_paths(self):
+        """Decide file destinations on FIRST log, not construction.
+
+        Multi-process: history stays on every rank (plateau/best-val logic
+        must agree), but files are written by process 0 only — otherwise N
+        ranks interleave lines into one metrics.jsonl.  The process check
+        initializes the JAX backend, so it must not run in ``__init__``:
+        a Trainer is often constructed before
+        ``jax.distributed.initialize()``, which requires a pristine
+        backend.  By the first log a train step has long since run."""
+        if self._resolved:
+            return
+        self._resolved = True
+        if self._workdir is None:
+            return
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        os.makedirs(self._workdir, exist_ok=True)
+        self._path = os.path.join(self._workdir, self._filename)
+        if self._tensorboard:
+            # lazy: the event file is only created on first log, so
+            # never-logging components don't litter empty files
+            self._tb_dir = os.path.join(self._workdir, "tensorboard")
 
     def _tb_writer(self):
+        self._resolve_paths()
         if self._tb is None and self._tb_dir is not None:
             from deep_vision_tpu.core.tboard import TFEventWriter
 
@@ -39,6 +63,7 @@ class MetricLogger:
         return self._tb
 
     def _record(self, name: str, step: int, value: float):
+        self._resolve_paths()
         series = self.history.setdefault(name, {"steps": [], "values": []})
         series["steps"].append(int(step))
         series["values"].append(float(value))

@@ -67,8 +67,17 @@ def replicated_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def replicate(tree: Any, mesh: Mesh) -> Any:
-    """Fully replicate a pytree over the mesh (params, opt state, ...)."""
-    return jax.device_put(tree, replicated_sharding(mesh))
+    """Fully replicate a pytree over the mesh (params, opt state, ...).
+
+    Works on multi-process meshes too: every process holds the same host
+    value (same seed / same restore), so each contributes its addressable
+    replicas via ``make_array_from_process_local_data``."""
+    sharding = replicated_sharding(mesh)
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x: jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)), tree)
+    return jax.device_put(tree, sharding)
 
 
 def shard_batch(tree: Any, mesh: Mesh) -> Any:
@@ -87,21 +96,32 @@ def shard_batch(tree: Any, mesh: Mesh) -> Any:
     """
     n_data = mesh.shape[DATA_AXIS]
     n_spatial = mesh.shape.get(SPATIAL_AXIS, 1)
+    # multi-process: the host batch is this process's LOCAL shard (loaders
+    # shard files per host); each process contributes its portion of the
+    # global array (the tf.data per-worker dataset semantics)
+    multiproc = jax.process_count() > 1
 
     def _put(x):
         if isinstance(x, jax.Array):  # already placed (e.g. prefetch thread)
             return x
         x = np.asarray(x)
         if x.ndim == 0:
+            if multiproc:
+                return jax.make_array_from_process_local_data(
+                    replicated_sharding(mesh), x)
             return jax.device_put(x, replicated_sharding(mesh))
-        if x.shape[0] % n_data != 0:
+        global_batch = x.shape[0] * (jax.process_count() if multiproc else 1)
+        if global_batch % n_data != 0:
             raise ValueError(
-                f"batch dim {x.shape[0]} not divisible by data axis {n_data}"
-            )
+                f"global batch {global_batch} (local {x.shape[0]}) not "
+                f"divisible by data axis {n_data}")
         spec = [DATA_AXIS] + [None] * (x.ndim - 1)
         if n_spatial > 1 and x.ndim >= 4 and x.shape[1] % n_spatial == 0:
             spec[1] = SPATIAL_AXIS  # rows over the spatial axis
-        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+        sharding = NamedSharding(mesh, P(*spec))
+        if multiproc:
+            return jax.make_array_from_process_local_data(sharding, x)
+        return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map(_put, tree)
 
@@ -115,19 +135,28 @@ def shard_batch_stacked(tree: Any, mesh: Mesh) -> Any:
     (the Trainer's ``scan_steps`` multi-step dispatch)."""
     n_data = mesh.shape[DATA_AXIS]
     n_spatial = mesh.shape.get(SPATIAL_AXIS, 1)
+    multiproc = jax.process_count() > 1
 
     def _put(x):
         if isinstance(x, jax.Array):
             return x
         x = np.asarray(x)
         if x.ndim <= 1:  # scalars / per-step vectors: replicate
+            if multiproc:
+                return jax.make_array_from_process_local_data(
+                    replicated_sharding(mesh), x)
             return jax.device_put(x, replicated_sharding(mesh))
-        if x.shape[1] % n_data != 0:
+        global_batch = x.shape[1] * (jax.process_count() if multiproc else 1)
+        if global_batch % n_data != 0:
             raise ValueError(
-                f"batch dim {x.shape[1]} not divisible by data axis {n_data}")
+                f"global batch {global_batch} (local {x.shape[1]}) not "
+                f"divisible by data axis {n_data}")
         spec = [None, DATA_AXIS] + [None] * (x.ndim - 2)
         if n_spatial > 1 and x.ndim >= 5 and x.shape[2] % n_spatial == 0:
             spec[2] = SPATIAL_AXIS
-        return jax.device_put(x, NamedSharding(mesh, P(*spec)))
+        sharding = NamedSharding(mesh, P(*spec))
+        if multiproc:  # local leaves are this process's batch shard
+            return jax.make_array_from_process_local_data(sharding, x)
+        return jax.device_put(x, sharding)
 
     return jax.tree_util.tree_map(_put, tree)
