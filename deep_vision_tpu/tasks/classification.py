@@ -9,8 +9,24 @@ Inception/pytorch/models/inception_v1.py:92-113).
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import optax
+
+
+def _materialize(logits):
+    """f32 logits behind an optimization barrier.
+
+    Without the barrier, XLA on TPU may fuse/rematerialize the (bf16)
+    classifier matmul separately into the cross-entropy's max-reduce and
+    exp-sum-reduce; the two recomputations can disagree in the last bf16
+    bits, so the computed log-normalizer falls BELOW the true-class logit
+    and the "cross-entropy" goes negative (observed: −0.04/sample on a
+    converged eval whose true loss was 1e-6 — a ~0.04 absolute error
+    hiding inside every fused eval loss).  The barrier forces the logits
+    to materialize once, making both reductions read the same values.
+    """
+    return jax.lax.optimization_barrier(logits.astype(jnp.float32))
 
 
 class ClassificationTask:
@@ -23,7 +39,7 @@ class ClassificationTask:
         self.aux_weight = aux_weight
 
     def _xent(self, logits, labels):
-        logits = logits.astype(jnp.float32)
+        logits = _materialize(logits)
         if self.label_smoothing > 0:
             onehot = optax.smooth_labels(
                 jnp.eye(self.num_classes)[labels], self.label_smoothing)
@@ -47,7 +63,7 @@ class ClassificationTask:
 
     def eval_metrics(self, outputs, batch):
         logits = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
-        logits = logits.astype(jnp.float32)
+        logits = _materialize(logits)
         labels = batch["label"]
         # weight=0 marks padded filler rows from pad_last loaders
         w = batch.get("weight", jnp.ones(labels.shape[0], jnp.float32))
