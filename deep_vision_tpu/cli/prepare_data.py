@@ -50,7 +50,7 @@ def main(argv=None):
                                "TPU from one host core (bigger shards)")
     imagenet.add_argument("--resize", type=int, default=256,
                           help="shorter-side rescale target for --store raw")
-    for s_, r_ in ((voc, 448), (coco, 448), (mpii, 384)):
+    for s_, r_ in ((voc, 416), (coco, 416), (mpii, 384)):
         s_.add_argument("--store", choices=("jpeg", "raw"), default="jpeg",
                         help="raw: decode+rescale at build time, store "
                              "uint8 — decode-free read path (labels are "

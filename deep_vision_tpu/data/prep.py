@@ -190,7 +190,7 @@ def parse_voc_xml(xml_path: str, names_map: dict[str, int]) -> dict:
 def prepare_voc(voc_root: str, out_dir: str, split: str = "train",
                 names_file: str | None = None, num_shards: int = 8,
                 num_workers: int = 8, year: str = "2007",
-                store: str = "jpeg", resize: int = 448) -> int:
+                store: str = "jpeg", resize: int = 416) -> int:
     """VOCdevkit/VOC{year}/{Annotations,JPEGImages} → dvrec shards."""
     base = os.path.join(voc_root, f"VOC{year}")
     anno_dir = os.path.join(base, "Annotations")
@@ -222,7 +222,7 @@ def prepare_voc(voc_root: str, out_dir: str, split: str = "train",
 def prepare_coco(annotation_json: str, image_dir: str, out_dir: str,
                  split: str = "train", num_shards: int = 16,
                  num_workers: int = 8, store: str = "jpeg",
-                 resize: int = 448) -> int:
+                 resize: int = 416) -> int:
     """COCO instances JSON → dvrec (per-image grouping + 0-based classes)."""
     with open(annotation_json) as f:
         coco = json.load(f)

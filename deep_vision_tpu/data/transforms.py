@@ -41,6 +41,8 @@ def resize_bilinear(img: np.ndarray, w: int, h: int) -> np.ndarray:
     downscale); the active backend is announced once at import so accuracy
     comparisons across machines are attributable.  Accepts uint8 or float
     HWC arrays; dtype is preserved on both paths."""
+    if img.shape[0] == h and img.shape[1] == w:
+        return img  # already at target (e.g. raw-store reads): zero-copy
     if _cv2 is not None:
         return _cv2.resize(img, (w, h), interpolation=_cv2.INTER_LINEAR)
     if img.dtype == np.uint8:
