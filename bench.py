@@ -539,6 +539,39 @@ def bench_all() -> list[dict]:
     return results
 
 
+def _make_synthetic_imagenet(tmp: str, n_images: int, jpeg_size: int,
+                             val_images: int = 0) -> tuple[str, str, str]:
+    """Synthetic flat-ImageNet tree shared by the pipeline/coupled benches:
+    8 synsets, 8 distinct base images saved as JPEGs, labels.txt.
+    Returns (train_dir, labels_path, val_dir_or_empty)."""
+    import os
+
+    import numpy as np
+    from PIL import Image
+
+    root = os.path.join(tmp, "train")
+    os.makedirs(root)
+    rng = np.random.default_rng(0)
+    synsets = [f"n{i:08d}" for i in range(8)]
+    labels = os.path.join(tmp, "labels.txt")
+    with open(labels, "w") as f:
+        for sn in synsets:
+            f.write(f"{sn} synthetic\n")
+    base = rng.integers(0, 255, (8, jpeg_size, jpeg_size, 3), dtype=np.uint8)
+    for i in range(n_images):
+        Image.fromarray(base[i % 8]).save(
+            os.path.join(root, f"{synsets[i % 8]}_{i}.JPEG"), quality=85)
+    val_root = ""
+    if val_images:
+        val_root = os.path.join(tmp, "val")
+        os.makedirs(val_root)
+        for i in range(val_images):
+            Image.fromarray(base[i % 8]).save(
+                os.path.join(val_root, f"{synsets[i % 8]}_{i}.JPEG"),
+                quality=85)
+    return root, labels, val_root
+
+
 def bench_coupled(batch: int = 256, epochs: int = 13,
                   n_images: int = 10240, image_size: int = 224) -> dict:
     """The COUPLED end-to-end number (VERDICT r3 #2): a real ``cli.train``
@@ -546,8 +579,9 @@ def bench_coupled(batch: int = 256, epochs: int = 13,
     scan-dispatched train steps → logging → per-epoch eval + checkpoint —
     not a decoupled step bench.  Sustained rate = images trained in
     epochs 2..N over the wall time from epoch 2's first log record to the
-    run's last record (epoch 1 absorbs compiles), INCLUDING eval and
-    checkpoint pauses.
+    run's last record (epoch 1 absorbs compiles; with one scan group per
+    epoch the first post-epoch-1 record lands at epoch 2's END, so the
+    window covers epochs 3..N), INCLUDING eval and checkpoint pauses.
 
     Defaults: 10,240 synthetic 400² JPEGs packed once with
     ``prepare_data imagenet --store raw`` (40 steps/epoch = one
@@ -557,36 +591,17 @@ def bench_coupled(batch: int = 256, epochs: int = 13,
     import shutil
     import tempfile
 
-    import numpy as np
-    from PIL import Image
-
     tmp = tempfile.mkdtemp(prefix="bench_coupled_")
     try:
-        root = os.path.join(tmp, "train")
-        os.makedirs(root)
-        rng = np.random.default_rng(0)
-        synsets = [f"n{i:08d}" for i in range(8)]
-        with open(os.path.join(tmp, "labels.txt"), "w") as f:
-            for sn in synsets:
-                f.write(f"{sn} synthetic\n")
-        base = rng.integers(0, 255, (8, 400, 400, 3), dtype=np.uint8)
-        for i in range(n_images):
-            Image.fromarray(base[i % 8]).save(
-                os.path.join(root, f"{synsets[i % 8]}_{i}.JPEG"), quality=85)
-        n_val = 1024
-        val_root = os.path.join(tmp, "val")
-        os.makedirs(val_root)
-        for i in range(n_val):
-            Image.fromarray(base[i % 8]).save(
-                os.path.join(val_root, f"{synsets[i % 8]}_{i}.JPEG"),
-                quality=85)
+        root, labels, val_root = _make_synthetic_imagenet(
+            tmp, n_images, 400, val_images=1024)
 
         from deep_vision_tpu.data.prep import prepare_imagenet
         from deep_vision_tpu.data.transforms import imagenet_resize_for
 
         recs = os.path.join(tmp, "recs")
         for split, src in (("train", root), ("val", val_root)):
-            prepare_imagenet(src, os.path.join(tmp, "labels.txt"), recs,
+            prepare_imagenet(src, labels, recs,
                              split=split, num_shards=8, num_workers=1,
                              store="raw",
                              resize=imagenet_resize_for(image_size))
@@ -628,7 +643,7 @@ def bench_coupled(batch: int = 256, epochs: int = 13,
         "value": round(rate, 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(rate / BASELINE_IMG_PER_SEC_PER_CHIP, 2),
-        "epochs_measured": epochs - 1,
+        "epochs_measured": (last_step - first["step"]) // steps_per_epoch,
         "steps_measured": last_step - first["step"],
         "batch": batch,
         "image_size": image_size,
@@ -654,7 +669,7 @@ def bench_cyclegan_live(steps: int = 20, size: int = 256,
         CycleGANGenerator,
         PatchGANDiscriminator,
     )
-    from deep_vision_tpu.parallel import make_mesh, shard_batch
+    from deep_vision_tpu.parallel import make_mesh
     from deep_vision_tpu.tasks.gan import CycleGANTask
 
     cfg = get_config("cyclegan")
@@ -662,8 +677,10 @@ def bench_cyclegan_live(steps: int = 20, size: int = 256,
     cfg.image_size = size
     a, b = synthetic_unpaired(max(4 * batch, 8), size)
     loader = UnpairedLoader(a, b, batch, seed=0)
-    task = CycleGANTask(lambda: CycleGANGenerator(),
-                        lambda: PatchGANDiscriminator())
+    # bf16 like the step bench (bench_task "cyclegan"), so live-vs-step
+    # deltas isolate the host exchange, not a dtype change
+    task = CycleGANTask(lambda: CycleGANGenerator(dtype=jnp.bfloat16),
+                        lambda: PatchGANDiscriminator(dtype=jnp.bfloat16))
     mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
     import tempfile
 
@@ -764,26 +781,13 @@ def bench_pipeline(num_workers: int = 16, batch: int = 256,
     import shutil
     import tempfile
 
-    import numpy as np
-    from PIL import Image
-
     from deep_vision_tpu.data.imagenet import ImageNetLoader
 
     tmp = tempfile.mkdtemp(prefix="bench_pipeline_")
     try:
-        root = os.path.join(tmp, "train")
-        os.makedirs(root)
-        rng = np.random.default_rng(0)
-        synsets = [f"n{i:08d}" for i in range(8)]
-        with open(os.path.join(tmp, "labels.txt"), "w") as f:
-            for s in synsets:
-                f.write(f"{s} synthetic\n")
         # realistic decode cost: ImageNet train JPEGs average ~400×350
-        base = rng.integers(0, 255, (8, jpeg_size, jpeg_size, 3),
-                            dtype=np.uint8)
-        for i in range(n_images):
-            Image.fromarray(base[i % 8]).save(
-                os.path.join(root, f"{synsets[i % 8]}_{i}.JPEG"), quality=85)
+        root, labels_path, _ = _make_synthetic_imagenet(
+            tmp, n_images, jpeg_size)
 
         common = dict(train=True, image_size=image_size,
                       num_workers=num_workers, process_index=0,
@@ -792,7 +796,7 @@ def bench_pipeline(num_workers: int = 16, batch: int = 256,
             from deep_vision_tpu.data.prep import prepare_imagenet
 
             recs = os.path.join(tmp, "recs")
-            prepare_imagenet(root, os.path.join(tmp, "labels.txt"), recs,
+            prepare_imagenet(root, labels_path, recs,
                              split="train", num_shards=8,
                              num_workers=min(8, os.cpu_count() or 1),
                              store="jpeg" if source == "records" else "raw")
@@ -800,7 +804,7 @@ def bench_pipeline(num_workers: int = 16, batch: int = 256,
                                                  **common)
         else:
             loader = ImageNetLoader(
-                root, os.path.join(tmp, "labels.txt"), batch, **common)
+                root, labels_path, batch, **common)
         # warm one batch (pool spin-up), then measure a full epoch
         it = iter(loader)
         next(it)

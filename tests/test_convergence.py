@@ -160,20 +160,19 @@ def test_cyclegan_learns_deterministic_translation(tmp_path, mesh1):
     base = np.repeat(np.repeat(grid, 4, 1), 4, 2)
     ys = np.mgrid[0:size, 0:size][0] / size
     pattern = np.sin(6.28 * ys)[..., None] * np.array([1.0, -1.0, 0.5])
-    a = np.clip(base[:n] + pattern * 0.6 + [0.3, -0.3, 0.0],
-                -1, 1).astype(np.float32)
-    b = np.clip(base[n:] - pattern * 0.6 + [-0.3, 0.3, 0.0],
-                -1, 1).astype(np.float32)
-    # the deterministic a→b map implied by the construction: flip the
-    # pattern term and the color cast
-    shift = (2 * 0.6 * pattern + 2 * np.array([0.3, -0.3, 0.0]))[None]
-    target = np.clip(a - shift, -1, 1).astype(np.float32)
+    # amplitudes sum to 0.2+0.5+0.25 < 1, so no pixel saturates and the
+    # analytic a→b oracle (flip pattern + cast) is EXACT — a clipped
+    # construction would make the target wrong at saturated pixels
+    a = (base[:n] + pattern * 0.5 + [0.25, -0.25, 0.0]).astype(np.float32)
+    b = (base[n:] - pattern * 0.5 + [-0.25, 0.25, 0.0]).astype(np.float32)
+    shift = (2 * 0.5 * pattern + 2 * np.array([0.25, -0.25, 0.0]))[None]
+    target = (a - shift).astype(np.float32)
 
     cfg = get_config("cyclegan")
-    cfg.batch_size = 8
+    cfg.batch_size = 4
     cfg.image_size = size
     cfg.log_every_steps = 100
-    cfg.optimizer.learning_rate = 1e-3  # toy scale: 120 steps, not epochs
+    cfg.optimizer.learning_rate = 1e-3  # toy scale: 400 steps total
     task = CycleGANTask(lambda: CycleGANGenerator(n_blocks=2),
                         lambda: PatchGANDiscriminator())
     trainer = AdversarialTrainer(cfg, task, mesh=mesh1,
@@ -184,20 +183,20 @@ def test_cyclegan_learns_deterministic_translation(tmp_path, mesh1):
     err_init = float(np.abs(task.translate(states0, a) - target).mean())
     ident_init = float(np.abs(task.translate(states0, b) - b).mean())
 
-    states = trainer.fit(loader, epochs=60)
+    states = trainer.fit(loader, epochs=100)
     trans = task.translate(states, a)
     # measured at this recipe (in the 8-virtual-device test env):
-    # ratio 0.43, castR -0.23, castG +0.30, ident 0.44x its init; GAN
-    # trajectories are chaotic in f32, so thresholds carry ~25% margin
+    # ratio 0.38, casts ±0.23, ident 0.41x its init; GAN trajectories
+    # are chaotic in f32, so thresholds carry ~30% margin
     err = float(np.abs(trans - target).mean())
     assert err < 0.55 * err_init, (err, err_init)
-    # lands in B's color cast (R negative, G positive — A had +0.3/-0.3)
-    assert trans[..., 0].mean() < -0.15, trans[..., 0].mean()
-    assert trans[..., 1].mean() > 0.15, trans[..., 1].mean()
+    # lands in B's color cast (R negative, G positive — A had +/-0.25)
+    assert trans[..., 0].mean() < -0.12, trans[..., 0].mean()
+    assert trans[..., 1].mean() > 0.12, trans[..., 1].mean()
     # identity: already-B images pass through far closer than at init —
     # a broken LAMBDA_ID leaves this flat
     ident_err = float(np.abs(task.translate(states, b) - b).mean())
-    assert ident_err < 0.65 * ident_init, (ident_err, ident_init)
+    assert ident_err < 0.6 * ident_init, (ident_err, ident_init)
 
 
 def test_dcgan_loss_trajectories_sane():
