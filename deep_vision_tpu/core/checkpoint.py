@@ -52,19 +52,32 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def _state_meta(self, step: int | None) -> dict:
+        """The stored state payload's metadata dict ({} when absent) —
+        the one place that knows the save() payload nesting."""
+        if step is None:
+            step = self._mgr.latest_step()
+        if step is None:
+            return {}
+        try:
+            meta = self._mgr.item_metadata(step)["state"]["state"]
+        except (KeyError, TypeError):
+            return {}
+        return meta if isinstance(meta, dict) else {}
+
     def has_state_key(self, key: str, step: int | None = None) -> bool:
         """True iff the stored state payload carries a NON-EMPTY ``key``
         subtree (e.g. ``ema_params``) — lets callers reconcile state
         fields the checkpoint may pre- or post-date before restoring."""
-        if step is None:
-            step = self._mgr.latest_step()
-        if step is None:
-            return False
-        try:
-            meta = self._mgr.item_metadata(step)["state"]["state"]
-        except (KeyError, TypeError):
-            return False
-        return isinstance(meta, dict) and bool(meta.get(key))
+        return bool(self._state_meta(step).get(key))
+
+    def state_subtree_keys(self, key: str, step: int | None = None) -> set:
+        """Child keys of the stored ``state[key]`` subtree (empty set when
+        absent) — layout introspection without a restore, e.g. telling a
+        pipeline-trained params tree ({stem, stages}) from a monolithic
+        one before choosing the restore template."""
+        meta = self._state_meta(step).get(key)
+        return set(meta.keys()) if isinstance(meta, dict) else set()
 
     def _restore_payload(self, step: int, template: dict) -> tuple[dict, dict]:
         """Restore ``template``-shaped payload + extras; keys the stored

@@ -223,12 +223,30 @@ def test_pipelined_fit_data_pipe_mesh_exact_vs_pipe1(tmp_path):
 @pytest.mark.slow
 def test_cli_pose_pipeline_smoke(tmp_path):
     """The full CLI path: cli.train -m hourglass_toy --mesh data=2,pipe=4
-    runs fit + eval end to end through the pipelined model."""
+    runs fit + eval end to end through the pipelined model — and the
+    resulting checkpoint SERVES through cli.infer's loader, which detects
+    the pipeline layout and converts it to the monolithic network."""
     from deep_vision_tpu.cli import train as cli_train
+    from deep_vision_tpu.cli.infer import _load_state
+    from deep_vision_tpu.core.config import get_config
 
+    workdir = tmp_path / "cli"
     rc = cli_train.main([
         "-m", "hourglass_toy", "--synthetic", "--synthetic-size", "16",
         "--epochs", "1", "--batch-size", "8", "--image-size", "32",
         "--mesh", "data=2,pipe=4", "--microbatches", "2",
-        "--workdir", str(tmp_path / "cli")])
+        "--workdir", str(workdir)])
     assert rc == 0
+
+    cfg = get_config("hourglass_toy")
+    cfg.image_size = 32
+    model, state = _load_state(cfg, str(workdir))
+    # monolithic layout (flax auto-names, no stem/stages nesting) and a
+    # working forward at serving shape
+    assert "stem" not in state.params and "Conv_0" in state.params
+    out = model.apply(
+        {"params": state.params, "batch_stats": state.batch_stats},
+        jnp.zeros((1, 32, 32, 3)), train=False)
+    assert len(out) == 4 and out[0].shape == (1, 8, 8, 8)
+    # the restored weights are trained, not the template init
+    assert float(jnp.abs(out[-1]).max()) > 0
