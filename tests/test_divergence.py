@@ -108,6 +108,25 @@ def test_restores_checkpoint_without_bad_steps(tmp_path, mesh1):
     assert int(jax.device_get(restored.bad_steps)) == 0  # default kept
 
 
+def test_checkpoint_layout_introspection(tmp_path, mesh1):
+    """state_subtree_keys/has_state_key read stored-layout metadata
+    without a restore — what cli.infer uses to tell a pipeline-trained
+    params tree ({stem, stages}) from a monolithic one."""
+    from deep_vision_tpu.core.checkpoint import Checkpointer
+
+    cfg, trainer = make_trainer(tmp_path, mesh1)
+    data = synthetic_mnist(64)
+    state = trainer.init_state(next(iter(ArrayLoader(data, cfg.batch_size))))
+
+    ckpt = Checkpointer(str(tmp_path / "introspect"))
+    assert ckpt.state_subtree_keys("params") == set()  # no checkpoint yet
+    ckpt.save(1, state, extras={})
+    keys = ckpt.state_subtree_keys("params")
+    assert keys and "stem" not in keys  # monolithic flax auto-names
+    assert ckpt.state_subtree_keys("no_such_key") == set()
+    assert not ckpt.has_state_key("ema_params")  # EMA off → {} stored
+
+
 def test_guard_baseline_survives_resume(tmp_path, mesh1):
     """Skips recorded before a checkpoint must not count against the
     resumed run (review finding: lifetime cap across resumes)."""
