@@ -88,18 +88,17 @@ def _restore_pipelined(cfg, model, ckpt, x):
 
     from deep_vision_tpu.core.optim import build_optimizer
     from deep_vision_tpu.core.state import TrainState
-    from deep_vision_tpu.models.hourglass import StackedHourglass
     from deep_vision_tpu.parallel import make_mesh
     from deep_vision_tpu.parallel.pipelined import PipelinedModel
 
-    if not isinstance(model, StackedHourglass):
+    try:
+        pm = PipelinedModel.for_model(
+            model, make_mesh({"data": 1, "pipe": 1},
+                             devices=jax.devices()[:1]))
+    except TypeError as e:
         raise SystemExit(
             f"checkpoint stores a pipeline layout but config "
-            f"'{cfg.name}' builds {type(model).__name__} — pipeline "
-            f"training is only wired for StackedHourglass configs")
-    pm = PipelinedModel.from_stacked_hourglass(
-        model, make_mesh({"data": 1, "pipe": 1},
-                         devices=jax.devices()[:1]))
+            f"'{cfg.name}' builds no pipelined family: {e}") from e
     pv = jax.jit(functools.partial(pm.init, train=False))(
         {"params": jax.random.PRNGKey(0)}, x)
     has_ema = ckpt.has_state_key("ema_params")

@@ -44,8 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="override config (smoke runs at low res)")
     p.add_argument("--mesh", default=None,
                    help="mesh spec like 'data=8', 'data=4,model=2', or "
-                        "'data=2,pipe=4' (pose: GPipe pipeline over the "
-                        "hourglass stacks)")
+                        "'data=2,pipe=4' (GPipe pipeline over the stacked "
+                        "families: hourglass pose, CenterNet detection)")
     p.add_argument("--microbatches", type=int, default=None,
                    help="pipeline microbatches per step (with a pipe mesh "
                         "axis; default = pipe axis size)")
@@ -280,6 +280,23 @@ def _load_pretrained_state(args, cfg, trainer, train_loader):
                       batch_stats=merged["batch_stats"]), trainer.mesh)
 
 
+def _maybe_pipelined(model, mesh, args):
+    """Wrap ``model`` for pipeline-parallel training when the mesh has a
+    pipe axis; clean CLI error for families with no stage sequence."""
+    if mesh.shape.get("pipe", 1) <= 1:
+        return model
+    from deep_vision_tpu.parallel.pipelined import PipelinedModel
+
+    try:
+        model = PipelinedModel.for_model(
+            model, mesh, num_microbatches=args.microbatches)
+    except TypeError as e:
+        raise SystemExit(f"--mesh pipe axis: {e}") from e
+    print(f"[pipeline] {model.num_stages} stages over pipe="
+          f"{mesh.shape['pipe']}, {model.num_microbatches} microbatches")
+    return model
+
+
 def _main_detection(args, cfg, mesh):
     from deep_vision_tpu.core.trainer import Trainer
     from deep_vision_tpu.data.detection import synthetic_detection_dataset
@@ -350,7 +367,10 @@ def _main_detection(args, cfg, mesh):
     val_loader = LoaderCls(val_samples, cfg.batch_size,
                            cfg.num_classes, cfg.image_size, train=False,
                            device_normalize=dev_norm)
-    trainer = Trainer(cfg, cfg.model(), task, mesh=mesh, workdir=args.workdir,
+    # pipeline-parallel training mode (stacked families only — CenterNet
+    # here; YOLO has no same-shape stage sequence and exits cleanly)
+    model = _maybe_pipelined(cfg.model(), mesh, args)
+    trainer = Trainer(cfg, model, task, mesh=mesh, workdir=args.workdir,
                       preprocess_fn=preprocess_fn, upload=args.upload)
     try:
         state = trainer.fit(train_loader, val_loader, resume=args.resume)
@@ -401,15 +421,7 @@ def _main_pose(args, cfg, mesh):
     # pipeline-parallel training mode: a pipe mesh axis shards the
     # hourglass stacks over devices (GPipe microbatch pipeline) — the
     # monolithic config's num_stack/filters/order carry over unchanged
-    if mesh.shape.get("pipe", 1) > 1:
-        from deep_vision_tpu.parallel.pipelined import PipelinedModel
-
-        model = PipelinedModel.from_stacked_hourglass(
-            cfg.model(), mesh, num_microbatches=args.microbatches)
-        print(f"[pipeline] {model.num_stages} stages over pipe="
-              f"{mesh.shape['pipe']}, {model.num_microbatches} microbatches")
-    else:
-        model = cfg.model()
+    model = _maybe_pipelined(cfg.model(), mesh, args)
     trainer = Trainer(cfg, model, task, mesh=mesh, workdir=args.workdir,
                       preprocess_fn=preprocess_fn, upload=args.upload)
     try:

@@ -42,6 +42,58 @@ class DetectionHead(nn.Module):
         return x.astype(jnp.float32)
 
 
+class CenterNetStem(nn.Module):
+    """The pre-stack head (model.py:130-140): 7×7/2 conv → bottleneck →
+    2×2 pool, H×W → H/4×W/4.  Submodule auto-names (Conv_0, BatchNorm_0,
+    PreActBottleneck_0) match the stem portion of the monolithic
+    :class:`CenterNet` so :func:`merge_centernet_variables` is a pure
+    rename."""
+
+    filters: tuple = CENTERNET_FILTERS
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        base = self.filters[0]
+        x = x.astype(self.dtype)
+        x = nn.Conv(base // 2, (7, 7), (2, 2), padding="SAME",
+                    kernel_init=conv_kernel_init, dtype=self.dtype)(x)
+        x = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9, dtype=self.dtype)(x))
+        x = PreActBottleneck(base, self.dtype)(x, train)
+        return nn.max_pool(x, (2, 2), (2, 2))
+
+
+class CenterNetStack(nn.Module):
+    """ONE CenterNet stack as a standalone same-shape map — the pipeline
+    stage unit (:func:`deep_vision_tpu.parallel.pipelined.PipelinedModel.
+    from_centernet`).  Maps a (B, H, W, base) carry to
+    ``(new_carry, (heat, wh, offset))``; every stack is structurally
+    identical (the last stack's re-injection conv goes unused
+    downstream, like the hourglass stage unit)."""
+
+    num_classes: int = 80
+    order: int = 5
+    filters: tuple = CENTERNET_FILTERS
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        base = self.filters[0]
+        y = HourglassModule(self.order, list(self.filters),
+                            num_residual=1, dtype=self.dtype)(x, train)
+        y = nn.Conv(base, (3, 3), padding="SAME",
+                    kernel_init=conv_kernel_init, dtype=self.dtype)(y)
+        y = nn.relu(nn.BatchNorm(use_running_average=not train,
+                                 momentum=0.9, dtype=self.dtype)(y))
+        heat = DetectionHead(self.num_classes, -2.19, self.dtype,
+                             features=base)(y)
+        wh = DetectionHead(2, 0.0, self.dtype, features=base)(y)
+        offset = DetectionHead(2, 0.0, self.dtype, features=base)(y)
+        new_x = x + nn.Conv(base, (1, 1), dtype=self.dtype)(y)
+        return new_x, (heat, wh, offset)
+
+
 class CenterNet(nn.Module):
     """256²×3 → per-stack (heatmap_logits (64²,C), wh (64²,2), offset).
 
@@ -86,3 +138,68 @@ class CenterNet(nn.Module):
             if s < self.num_stack - 1:
                 x = x + nn.Conv(base, (1, 1), dtype=self.dtype)(y)
         return tuple(outputs)
+
+
+# --------------------------------------------------------------------------
+# Variable-layout conversion: monolithic CenterNet <-> (CenterNetStem +
+# per-stage CenterNetStack) — the pipeline-parallel layout.  Pure renames
+# mirroring the two ``__call__`` bodies (same scheme as
+# models/hourglass.merge_stacked_variables).
+
+def _cn_stage_name_map(s: int, num_stack: int) -> dict:
+    """CenterNetStack submodule name → its name inside CenterNet for
+    stack ``s``.  Monolithic call order per stack: HourglassModule, 3×3
+    Conv+BN, three DetectionHeads, and (all but the last stack) the
+    re-injection Conv — so the Conv counter advances 2 per stack (1 stem
+    Conv before it) and DetectionHead 3 per stack."""
+    m = {"HourglassModule_0": f"HourglassModule_{s}",
+         "Conv_0": f"Conv_{1 + 2 * s}",
+         "BatchNorm_0": f"BatchNorm_{1 + s}"}
+    for j in range(3):
+        m[f"DetectionHead_{j}"] = f"DetectionHead_{3 * s + j}"
+    if s < num_stack - 1:
+        m["Conv_1"] = f"Conv_{2 + 2 * s}"
+    return m
+
+
+def merge_centernet_variables(stem_vars, stage_vars_list) -> dict:
+    """(CenterNetStem variables, [per-stage CenterNetStack variables]) →
+    monolithic :class:`CenterNet` variables (the final stage's unused
+    re-injection conv is dropped)."""
+    num_stack = len(stage_vars_list)
+    cols = set(stem_vars) | {c for v in stage_vars_list for c in v}
+    out = {}
+    for col in cols:
+        merged = dict(stem_vars.get(col, {}))
+        for s, sv in enumerate(stage_vars_list):
+            names = _cn_stage_name_map(s, num_stack)
+            for src, dst in names.items():
+                if src in sv.get(col, {}):
+                    merged[dst] = sv[col][src]
+        out[col] = merged
+    return out
+
+
+def split_centernet_variables(variables, template_stage_vars
+                              ) -> tuple[dict, list]:
+    """Inverse of :func:`merge_centernet_variables`; the final stage's
+    re-injection conv comes from ``template_stage_vars`` (absent in the
+    monolithic net, receives no gradient)."""
+    num_stack = len(template_stage_vars)
+    stem_names = {"Conv_0", "BatchNorm_0", "PreActBottleneck_0"}
+    stem_vars = {col: {k: v for k, v in tree.items() if k in stem_names}
+                 for col, tree in variables.items()}
+    stage_vars = []
+    for s in range(num_stack):
+        names = _cn_stage_name_map(s, num_stack)
+        sv = {}
+        for col, tree in variables.items():
+            tmpl = template_stage_vars[s].get(col, {})
+            sub = {src: tree[dst] for src, dst in names.items()
+                   if dst in tree}
+            for k in tmpl:
+                if k not in sub:
+                    sub[k] = tmpl[k]
+            sv[col] = sub
+        stage_vars.append(sv)
+    return stem_vars, stage_vars

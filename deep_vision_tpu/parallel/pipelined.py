@@ -25,9 +25,11 @@ Design notes:
   two coincide and the pipelined trajectory matches the monolithic
   :class:`~deep_vision_tpu.models.hourglass.StackedHourglass` exactly
   (tests/test_pipeline_trainer.py).
-- Checkpoints store the pipelined layout ({stem, stages}); convert to
-  the monolithic layout for serving with
-  :func:`deep_vision_tpu.models.hourglass.merge_stacked_variables`.
+- Checkpoints store the pipelined layout ({stem, stages}); the
+  per-family layout converters (``merge_fn``/``split_fn``, e.g.
+  ``models.hourglass.merge_stacked_variables`` or
+  ``models.centernet.merge_centernet_variables``) translate to/from the
+  monolithic layout for serving and warm starts.
 """
 
 from __future__ import annotations
@@ -61,7 +63,8 @@ class PipelinedModel:
     """
 
     def __init__(self, stem, stage, num_stages: int, mesh,
-                 num_microbatches: int | None = None):
+                 num_microbatches: int | None = None,
+                 merge_fn=None, split_fn=None):
         if PIPE_AXIS not in mesh.shape:
             raise ValueError(f"mesh {dict(mesh.shape)} has no "
                              f"'{PIPE_AXIS}' axis")
@@ -75,6 +78,11 @@ class PipelinedModel:
         self.mesh = mesh
         self.num_microbatches = (num_microbatches
                                  or max(mesh.shape[PIPE_AXIS], 1))
+        # model-family layout converters (pipelined ↔ monolithic):
+        # merge_fn(stem_vars, [stage_vars]) -> monolithic variables;
+        # split_fn(variables, [template_stage_vars]) -> (stem, [stages])
+        self._merge_fn = merge_fn
+        self._split_fn = split_fn
 
     @classmethod
     def from_stacked_hourglass(cls, model, mesh,
@@ -89,14 +97,64 @@ class PipelinedModel:
 
         if not isinstance(model, StackedHourglass):
             raise TypeError(
-                f"pipeline training mode supports StackedHourglass "
-                f"configs; got {type(model).__name__}")
+                f"from_stacked_hourglass needs a StackedHourglass; "
+                f"got {type(model).__name__}")
+        from deep_vision_tpu.models.hourglass import (
+            merge_stacked_variables,
+            split_stacked_variables,
+        )
+
         stem = HourglassStem(filters=model.filters, dtype=model.dtype)
         stage = HourglassStack(
             num_heatmap=model.num_heatmap, filters=model.filters,
             num_residual=model.num_residual, order=model.order,
             dtype=model.dtype)
-        return cls(stem, stage, model.num_stack, mesh, num_microbatches)
+        r = model.num_residual
+        return cls(stem, stage, model.num_stack, mesh, num_microbatches,
+                   merge_fn=lambda sv, sl: merge_stacked_variables(
+                       sv, sl, num_residual=r),
+                   split_fn=lambda v, tpl: split_stacked_variables(
+                       v, tpl, num_residual=r))
+
+    @classmethod
+    def from_centernet(cls, model, mesh, num_microbatches: int | None = None):
+        """Build the pipelined equivalent of a monolithic
+        :class:`~deep_vision_tpu.models.centernet.CenterNet`."""
+        from deep_vision_tpu.models.centernet import (
+            CenterNet,
+            CenterNetStack,
+            CenterNetStem,
+            merge_centernet_variables,
+            split_centernet_variables,
+        )
+
+        if not isinstance(model, CenterNet):
+            raise TypeError(
+                f"from_centernet needs a CenterNet; "
+                f"got {type(model).__name__}")
+        stem = CenterNetStem(filters=model.filters, dtype=model.dtype)
+        stage = CenterNetStack(
+            num_classes=model.num_classes, order=model.order,
+            filters=model.filters, dtype=model.dtype)
+        return cls(stem, stage, model.num_stack, mesh, num_microbatches,
+                   merge_fn=merge_centernet_variables,
+                   split_fn=split_centernet_variables)
+
+    @classmethod
+    def for_model(cls, model, mesh, num_microbatches: int | None = None):
+        """Dispatch on the monolithic model's family (what cli.train and
+        cli.infer use: any stacked family reachable from a config)."""
+        from deep_vision_tpu.models.centernet import CenterNet
+        from deep_vision_tpu.models.hourglass import StackedHourglass
+
+        if isinstance(model, StackedHourglass):
+            return cls.from_stacked_hourglass(model, mesh, num_microbatches)
+        if isinstance(model, CenterNet):
+            return cls.from_centernet(model, mesh, num_microbatches)
+        raise TypeError(
+            f"pipeline training mode supports the stacked-hourglass "
+            f"families (StackedHourglass, CenterNet); "
+            f"got {type(model).__name__}")
 
     # ------------------------------------------------------- module protocol
 
@@ -163,7 +221,9 @@ class PipelinedModel:
             stage_fn, params["stages"], carry, mesh=mesh,
             num_microbatches=self._microbatches_for(x.shape[0]),
             stage_state=stats.get("stages", {}) if has_bn else None)
-        outputs = tuple(outs[i] for i in range(self.num_stages))
+        # per-stage outputs may be any pytree (hourglass: one heatmap
+        # array; CenterNet: a (heat, wh, offset) tuple)
+        outputs = tuple(unstack_stages(outs))
         if want_mutable:
             return outputs, {"batch_stats": {
                 "stem": new_stem_stats, "stages": new_stage_stats}}
@@ -193,14 +253,16 @@ class PipelinedModel:
     # ------------------------------------------------------------- export
 
     def import_monolithic_variables(self, variables, template_variables):
-        """Monolithic StackedHourglass variables → pipelined layout, so a
-        pipe-mesh run can start from a monolithic checkpoint.
+        """Monolithic model variables → pipelined layout (via the
+        family's split_fn), so a pipe-mesh run can start from a
+        monolithic checkpoint.
         ``template_variables`` is a pipelined ``init`` result — it donates
         the final stage's re-injection convs (absent in the monolithic
         net; they receive no gradient, so values are trajectory-neutral).
         """
-        from deep_vision_tpu.models.hourglass import split_stacked_variables
-
+        if self._split_fn is None:
+            raise NotImplementedError(
+                "this PipelinedModel was built without a layout split_fn")
         tp = unstack_stages(template_variables["params"]["stages"])
         has_bn = "batch_stats" in template_variables
         ts = unstack_stages(template_variables["batch_stats"]["stages"]) \
@@ -211,9 +273,7 @@ class PipelinedModel:
             if s:
                 d["batch_stats"] = s
             tpl.append(d)
-        stem_v, stage_v = split_stacked_variables(
-            variables, tpl,
-            num_residual=getattr(self.stage, "num_residual", 1))
+        stem_v, stage_v = self._split_fn(variables, tpl)
         out = {"params": {
             "stem": stem_v["params"],
             "stages": stack_stages([t["params"] for t in stage_v]),
@@ -227,10 +287,11 @@ class PipelinedModel:
         return out
 
     def export_monolithic_variables(self, params, batch_stats) -> dict:
-        """Pipeline-layout state → monolithic StackedHourglass variables
-        (for ``cli.infer`` / single-device serving)."""
-        from deep_vision_tpu.models.hourglass import merge_stacked_variables
-
+        """Pipeline-layout state → monolithic model variables (for
+        ``cli.infer`` / single-device serving)."""
+        if self._merge_fn is None:
+            raise NotImplementedError(
+                "this PipelinedModel was built without a layout merge_fn")
         params = jax.device_get(params)
         batch_stats = jax.device_get(batch_stats)
         stage_list = []
@@ -245,6 +306,4 @@ class PipelinedModel:
         stem_vars = {"params": params["stem"]}
         if batch_stats:
             stem_vars["batch_stats"] = batch_stats["stem"]
-        return merge_stacked_variables(
-            stem_vars, stage_list,
-            num_residual=getattr(self.stage, "num_residual", 1))
+        return self._merge_fn(stem_vars, stage_list)
