@@ -12,8 +12,11 @@ TPU-first design notes:
   the optimizer see full precision while the MXU runs bf16 matmuls.
 - The whole network is a static trace — stage loops unroll at trace time into
   one XLA program; residual adds fuse into the conv epilogues.
-- stride-2 3×3 convs use explicit SAME padding; shapes stay static so XLA can
-  tile every conv onto the MXU.
+- V1 blocks' stride-2 3×3 convs use explicit (1,1) padding — torch's window
+  placement, NOT XLA SAME (which pads low=0/high=1 at even sizes and would
+  make imported torchvision checkpoints numerically wrong).  The V2 pre-act
+  block keeps SAME deliberately: its parity target is TF, whose SAME matches
+  XLA's.  Shapes stay static either way so XLA tiles every conv onto the MXU.
 """
 
 from __future__ import annotations
@@ -41,7 +44,12 @@ class BasicBlock(nn.Module):
         bn = partial(nn.BatchNorm, use_running_average=not train,
                      momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         shortcut = x
-        y = conv(self.filters, (3, 3), (self.strides, self.strides))(x)
+        # explicit (1,1) pad: identical to SAME at stride 1, and at stride 2
+        # it keeps torch's window placement (torch pads both sides then floor-
+        # crops ⇒ windows start at row −1; XLA SAME starts at 0) so imported
+        # torchvision checkpoints stay numerically exact
+        y = conv(self.filters, (3, 3), (self.strides, self.strides),
+                 padding=[(1, 1), (1, 1)])(x)
         y = nn.relu(bn()(y))
         y = conv(self.filters, (3, 3))(y)
         # zero-init the last BN scale: residual branch starts as identity
@@ -70,7 +78,9 @@ class BottleneckBlock(nn.Module):
                      momentum=0.9, epsilon=1e-5, dtype=self.dtype)
         shortcut = x
         y = nn.relu(bn()(conv(self.filters, (1, 1))(x)))
-        y = conv(self.filters, (3, 3), (self.strides, self.strides))(y)
+        # torch-exact stride-2 window placement (see BasicBlock)
+        y = conv(self.filters, (3, 3), (self.strides, self.strides),
+                 padding=[(1, 1), (1, 1)])(y)
         y = nn.relu(bn()(y))
         y = conv(4 * self.filters, (1, 1))(y)
         y = bn(scale_init=nn.initializers.zeros)(y)
@@ -103,6 +113,8 @@ class PreActBottleneckBlock(nn.Module):
                             (self.strides, self.strides))(pre)
         y = conv(self.filters, (1, 1))(pre)
         y = nn.relu(bn()(y))
+        # SAME (not the V1 blocks' explicit pad) is deliberate: the parity
+        # target is TF (resnet50v2.py), whose SAME == XLA's
         y = conv(self.filters, (3, 3), (self.strides, self.strides))(y)
         y = nn.relu(bn()(y))
         y = conv(4 * self.filters, (1, 1))(y)

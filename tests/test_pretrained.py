@@ -78,9 +78,16 @@ class TorchResNet50(tnn.Module):
 
 
 def _randomize_bn_stats(model, gen):
-    """Non-trivial running stats so the parity check exercises them."""
+    """Non-trivial running stats AND O(1) affine params so the parity check
+    exercises them.  The scales must stay near 1: tiny (0.05·randn) BN scales
+    attenuate the residual branch ~1e-4 relative to the shortcut and MASK
+    real semantic mismatches (this hid a stride-2 padding bug — SAME pads
+    low=0/high=1 where torch effectively pads low=1 — until round 5)."""
     for m in model.modules():
         if isinstance(m, tnn.BatchNorm2d):
+            m.weight.copy_(
+                1.0 + torch.randn(m.weight.shape, generator=gen) * 0.1)
+            m.bias.copy_(torch.randn(m.bias.shape, generator=gen) * 0.1)
             m.running_mean.copy_(
                 torch.randn(m.running_mean.shape, generator=gen) * 0.1)
             m.running_var.copy_(
