@@ -379,25 +379,25 @@ def _load_pretrained_state(cfg, args):
     from deep_vision_tpu.core.optim import build_optimizer
     from deep_vision_tpu.core.state import TrainState
     from deep_vision_tpu.models.pretrained import (
-        STAGE_SIZES,
-        load_torch_checkpoint,
-        merge_pretrained,
+        ARCH_IMPORTERS,
+        import_pretrained,
     )
 
-    if args.model not in STAGE_SIZES:
+    if args.model not in ARCH_IMPORTERS:
         raise SystemExit(
-            f"--pretrained supports {sorted(STAGE_SIZES)} (torch-format "
-            f"V1 checkpoints); '{args.model}' has a different param tree")
+            f"--pretrained supports {sorted(ARCH_IMPORTERS)} (torch-format "
+            f"checkpoints); '{args.model}' has a different param tree")
     model = cfg.model()
     x = jnp.zeros((1, cfg.image_size, cfg.image_size, cfg.channels))
     variables = jax.jit(functools.partial(model.init, train=False))(
         {"params": jax.random.PRNGKey(0)}, x)
-    imported = load_torch_checkpoint(
-        args.pretrained, args.model, include_fc=cfg.num_classes == 1000)
-    merged = merge_pretrained(
-        {"params": variables["params"],
-         "batch_stats": variables.get("batch_stats", {})}, imported)
-    print(f"[eval] imported {args.model} weights from {args.pretrained}")
+    fresh = {"params": variables["params"],
+             "batch_stats": variables.get("batch_stats", {})}
+    merged, head_kept = import_pretrained(args.pretrained, args.model, fresh)
+    head = ("with checkpoint head" if head_kept
+            else "head left fresh (class-count mismatch)")
+    print(f"[eval] imported {args.model} weights from {args.pretrained} "
+          f"({head})")
     state = TrainState.create(
         apply_fn=model.apply, params=merged["params"],
         tx=build_optimizer(cfg.optimizer),

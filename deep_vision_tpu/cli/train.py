@@ -65,9 +65,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="sync checkpoints to this URI after each save "
                         "(path, file://, or gs://)")
     p.add_argument("--pretrained", default=None,
-                   help="torch-format ResNet state_dict (.pth) to start "
-                        "from (the load_model_weights role); head kept "
-                        "only when num_classes matches")
+                   help="torch-format state_dict (.pth) to start from "
+                        "(the load_model_weights role; any published-"
+                        "accuracy arch — see models/pretrained.py); head "
+                        "kept only when the class count matches")
     p.add_argument("--profile", action="store_true",
                    help="jax.profiler trace of steps 10-20 → workdir/profile")
     p.add_argument("--list", action="store_true", help="list configs and exit")
@@ -256,25 +257,22 @@ def _load_pretrained_state(args, cfg, trainer, train_loader):
     import jax
 
     from deep_vision_tpu.models.pretrained import (
-        STAGE_SIZES,
-        load_torch_checkpoint,
-        merge_pretrained,
+        ARCH_IMPORTERS,
+        import_pretrained,
     )
     from deep_vision_tpu.parallel import replicate
 
-    if args.model not in STAGE_SIZES:
+    if args.model not in ARCH_IMPORTERS:
         raise SystemExit(
-            f"--pretrained supports {sorted(STAGE_SIZES)} (torch-format "
-            f"V1 checkpoints); '{args.model}' has a different param tree")
+            f"--pretrained supports {sorted(ARCH_IMPORTERS)} (torch-format "
+            f"checkpoints); '{args.model}' has a different param tree")
     state = trainer.init_state(next(iter(train_loader)))
-    arch = args.model
-    include_fc = cfg.num_classes == 1000  # ImageNet head transfers as-is
-    imported = load_torch_checkpoint(args.pretrained, arch, include_fc)
-    merged = merge_pretrained(
+    merged, head_kept = import_pretrained(
+        args.pretrained, args.model,
         {"params": jax.device_get(state.params),
-         "batch_stats": jax.device_get(state.batch_stats)}, imported)
-    print(f"[pretrained] loaded {arch} weights from {args.pretrained} "
-          f"(head {'kept' if include_fc else 'fresh'})")
+         "batch_stats": jax.device_get(state.batch_stats)})
+    print(f"[pretrained] loaded {args.model} weights from {args.pretrained} "
+          f"(head {'kept' if head_kept else 'fresh'})")
     return replicate(
         state.replace(params=merged["params"],
                       batch_stats=merged["batch_stats"]), trainer.mesh)

@@ -22,7 +22,11 @@ from typing import Any, Sequence
 import jax.numpy as jnp
 from flax import linen as nn
 
-from deep_vision_tpu.models.common import conv_kernel_init, global_avg_pool
+from deep_vision_tpu.models.common import (
+    conv_kernel_init,
+    global_avg_pool,
+    local_response_norm,
+)
 
 
 class BasicConv(nn.Module):
@@ -88,6 +92,7 @@ class AuxClassifier(nn.Module):
 class InceptionV1(nn.Module):
     num_classes: int = 1000
     aux_heads: bool = True
+    use_lrn: bool = True  # the reference stem LRNs (inception_v1.py lrn1/lrn2)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -95,10 +100,19 @@ class InceptionV1(nn.Module):
         conv = partial(BasicConv, dtype=self.dtype)
         mod = partial(InceptionModule, dtype=self.dtype)
         x = x.astype(self.dtype)
-        x = conv(64, (7, 7), (2, 2))(x, train)                      # 224→112
+        # explicit pad 3 = torch's stride-2 window placement (SAME would pad
+        # low=2/high=3 and shift every window) — keeps reference-format
+        # checkpoint imports numerically exact
+        x = conv(64, (7, 7), (2, 2), padding=[(3, 3), (3, 3)])(x, train)
         x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")          # →56
+        # SAME maxpool == torch ceil_mode here (even sizes: both pad (0,1));
+        # post-ReLU values are ≥0 so the -inf SAME fill never wins
+        if self.use_lrn:
+            x = local_response_norm(x, size=64)
         x = conv(64)(x, train)
         x = conv(192, (3, 3))(x, train)
+        if self.use_lrn:
+            x = local_response_norm(x, size=192)
         x = nn.max_pool(x, (3, 3), (2, 2), padding="SAME")          # →28
         x = mod(64, 96, 128, 16, 32, 32)(x, train)      # 3a → 256
         x = mod(128, 128, 192, 32, 96, 64)(x, train)    # 3b → 480

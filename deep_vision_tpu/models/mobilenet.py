@@ -31,9 +31,13 @@ class DepthwiseSeparable(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         in_ch = x.shape[-1]
-        # depthwise: groups == channels
+        # depthwise: groups == channels.  Explicit (1,1) pad = torch's window
+        # placement at stride 2 (XLA SAME pads low=0/high=1 at even sizes),
+        # so reference-format checkpoints import numerically exact; identical
+        # to SAME at stride 1.
         x = ConvBN(in_ch, (3, 3), (self.strides, self.strides),
-                   groups=in_ch, dtype=self.dtype)(x, train)
+                   padding=[(1, 1), (1, 1)], groups=in_ch,
+                   dtype=self.dtype)(x, train)
         # pointwise
         x = ConvBN(self.features, (1, 1), dtype=self.dtype)(x, train)
         return x
@@ -51,7 +55,8 @@ class MobileNetV1(nn.Module):
             return max(8, int(c * self.alpha))
 
         x = x.astype(self.dtype)
-        x = ConvBN(w(32), (3, 3), (2, 2), dtype=self.dtype)(x, train)  # 224→112
+        x = ConvBN(w(32), (3, 3), (2, 2), padding=[(1, 1), (1, 1)],
+                   dtype=self.dtype)(x, train)                 # 224→112
         for features, stride in _PLAN:
             x = DepthwiseSeparable(w(features), stride,
                                    dtype=self.dtype)(x, train)

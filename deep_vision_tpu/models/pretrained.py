@@ -1,12 +1,14 @@
-"""Pretrained-weight import: torch ResNet checkpoints → flax variables.
+"""Pretrained-weight import: torch checkpoints → flax variables, for every
+architecture the reference publishes an accuracy number for.
 
 The reference downloads Keras ImageNet weights for its TF ResNet-50 V2
-(ResNet/tensorflow/models/resnet50v2.py:137-153 ``load_model_weights``).
-The TPU-native equivalent imports the de-facto standard checkpoint format
-for these architectures — a torchvision-style ``state_dict``
-(``conv1/bn1/layer{1..4}.{i}.conv{j}/bn{j}/downsample/fc``) — into the
-flax ``ResNet`` pytree, so ``models.resnet.ResNet50`` can start from
-published ImageNet weights instead of scratch.
+(ResNet/tensorflow/models/resnet50v2.py:137-153 ``load_model_weights``) and
+publishes trained-model numbers in AlexNet/VGG/Inception/MobileNet/LeNet/
+ResNet ``pytorch/README.md``s.  The TPU-native equivalent imports the torch
+``state_dict`` formats those numbers live in — torchvision-style ResNet
+(``conv1/bn1/layer{1..4}.{i}.conv{j}/bn{j}/downsample/fc``) plus the
+reference's own sequential/module layouts — into the flax pytrees, so each
+published number is verifiable via ``cli.infer eval --pretrained``.
 
 Layout mapping (torch → flax):
 - conv weight ``(O, I, kH, kW)`` → kernel ``(kH, kW, I, O)``
@@ -107,18 +109,262 @@ def import_torch_resnet(state_dict: Mapping, arch: str = "resnet50",
     return {"params": params, "batch_stats": stats}
 
 
-def load_torch_checkpoint(path: str, arch: str = "resnet50",
-                          include_fc: bool = True) -> dict:
-    """Load a ``.pth``/``.pt`` state_dict from disk and convert.  Accepts
-    both a bare state_dict and the common ``{"state_dict": ...}`` wrapper
-    (with optional ``module.`` DataParallel prefixes)."""
+def _linear(t, flatten_chw=None) -> np.ndarray:
+    """torch Linear weight ``(O, I)`` → Dense kernel ``(I, O)``.
+
+    ``flatten_chw=(C, H, W)``: the Linear consumes a flattened conv map.
+    torch flattens NCHW (C-major); this package flattens NHWC — permute the
+    input axis so the imported kernel matches the NHWC flatten order."""
+    w = _np(t)
+    if flatten_chw is not None and flatten_chw[1] * flatten_chw[2] > 1:
+        c, h, wd = flatten_chw
+        w = w.reshape(w.shape[0], c, h, wd).transpose(2, 3, 1, 0)
+        return w.reshape(h * wd * c, -1)
+    return w.T
+
+
+def _seq_indices(sd: Mapping, prefix: str, ndim: int) -> list:
+    """Sorted module indices under ``prefix.N.weight`` with ``ndim``-D
+    weights (4 = conv, 2 = linear) — tolerant of interleaved ReLU/LRN/pool
+    modules, so one scan covers both the reference's layouts and
+    torchvision's (which number the same layers differently)."""
+    out = []
+    for k, v in sd.items():
+        parts = k.split(".")
+        if (len(parts) == 3 and parts[0] == prefix and parts[2] == "weight"
+                and parts[1].isdigit() and _np(v).ndim == ndim):
+            out.append(int(parts[1]))
+    return sorted(out)
+
+
+def import_torch_sequential(state_dict: Mapping, flatten_hw,
+                            include_fc: bool = True,
+                            features: str = "features",
+                            classifier: str = "classifier") -> dict:
+    """Generic importer for the reference's plain-sequential CNNs
+    (``features`` convs + ``classifier`` linears): AlexNet V1/V2
+    (AlexNet/pytorch/models/alexnet_v{1,2}.py), VGG-16/19
+    (VGG/pytorch/models/vgg{16,19}.py), LeNet-5
+    (LeNet/pytorch/models/lenet5.py) — and torchvision's alexnet/vgg
+    checkpoints, which share the Sequential layout with different indices.
+
+    ``flatten_hw``: spatial size at the conv→FC boundary (6×6 AlexNet,
+    7×7 VGG at 224² input) for the NCHW→NHWC flatten-order permutation.
+    ``include_fc=False`` drops the final classifier Dense (the class head).
+    """
+    sd = state_dict
+    conv_idx = _seq_indices(sd, features, 4)
+    fc_idx = _seq_indices(sd, classifier, 2)
+    if not conv_idx or not fc_idx:
+        raise ValueError(
+            f"no '{features}.N.weight' convs / '{classifier}.N.weight' "
+            "linears found — not a sequential-CNN checkpoint")
+    if any(k.startswith(f"{features}.") and k.endswith(".running_mean")
+           for k in sd):
+        # a BN variant (e.g. torchvision vgg16_bn) would import its convs
+        # and silently drop every BN — evaluating to garbage; refuse instead
+        raise ValueError(
+            "checkpoint carries BatchNorm stats — the zoo's sequential "
+            "models (AlexNet/VGG/LeNet) are BN-free; use the plain "
+            "(non-_bn) checkpoint variant")
+    params: dict = {}
+    for j, i in enumerate(conv_idx):
+        p = {"kernel": _conv(sd[f"{features}.{i}.weight"])}
+        if f"{features}.{i}.bias" in sd:
+            p["bias"] = _np(sd[f"{features}.{i}.bias"])
+        params[f"Conv_{j}"] = p
+    last_conv_out = _np(sd[f"{features}.{conv_idx[-1]}.weight"]).shape[0]
+    if not include_fc:
+        fc_idx = fc_idx[:-1]
+    for j, i in enumerate(fc_idx):
+        chw = (last_conv_out,) + tuple(flatten_hw) if j == 0 else None
+        params[f"Dense_{j}"] = {
+            "kernel": _linear(sd[f"{classifier}.{i}.weight"], chw),
+            "bias": _np(sd[f"{classifier}.{i}.bias"])}
+    return {"params": params, "batch_stats": {}}
+
+
+def import_torch_alexnet(state_dict: Mapping,
+                         include_fc: bool = True) -> dict:
+    """AlexNet V1/V2 (one Sequential layout, widths differ) → flax
+    ``models.alexnet.AlexNet``.  Published numbers:
+    AlexNet/pytorch/README.md."""
+    n = len(_seq_indices(state_dict, "features", 4))
+    if n != 5:
+        raise ValueError(f"AlexNet has 5 convs; checkpoint has {n}")
+    return import_torch_sequential(state_dict, (6, 6), include_fc)
+
+
+def import_torch_vgg(state_dict: Mapping, include_fc: bool = True) -> dict:
+    """VGG-16/19 → flax ``models.vgg.VGG`` (published numbers:
+    VGG/pytorch/README.md)."""
+    n = len(_seq_indices(state_dict, "features", 4))
+    if n not in (13, 16):
+        raise ValueError(f"VGG-16/19 has 13/16 convs; checkpoint has {n}")
+    return import_torch_sequential(state_dict, (7, 7), include_fc)
+
+
+def import_torch_lenet5(state_dict: Mapping,
+                        include_fc: bool = True) -> dict:
+    """LeNet-5 → flax ``models.lenet.LeNet5`` (flatten is 1×1×120, so no
+    permutation arises).  Published number: LeNet/pytorch/README.md."""
+    n = len(_seq_indices(state_dict, "features", 4))
+    if n != 3:
+        raise ValueError(f"LeNet-5 has 3 convs; checkpoint has {n}")
+    return import_torch_sequential(state_dict, (1, 1), include_fc)
+
+
+def _convbn(sd: Mapping, conv_key: str, bn_key: str) -> tuple:
+    """(params, batch_stats) for one ConvBN submodule."""
+    p = {"Conv_0": {"kernel": _conv(sd[f"{conv_key}.weight"])},
+         "BatchNorm_0": {"scale": _np(sd[f"{bn_key}.weight"]),
+                         "bias": _np(sd[f"{bn_key}.bias"])}}
+    s = {"BatchNorm_0": {"mean": _np(sd[f"{bn_key}.running_mean"]),
+                         "var": _np(sd[f"{bn_key}.running_var"])}}
+    return p, s
+
+
+def import_torch_mobilenet_v1(state_dict: Mapping,
+                              include_fc: bool = True) -> dict:
+    """Reference MobileNet V1 layout (MobileNet/pytorch/models/
+    mobilenet_v1.py: ``features.0/1`` stem conv+bn, ``features.3..15``
+    DepthwiseSeparableConv blocks each ``{dw,pw}.{conv,bn}``, ``linear``)
+    → flax ``models.mobilenet.MobileNetV1``.  Published number:
+    MobileNet/pytorch/README.md."""
+    sd = state_dict
+    if "features.0.weight" not in sd or "features.3.dw.conv.weight" not in sd:
+        raise ValueError("not a reference-layout MobileNet V1 checkpoint "
+                         "(expects features.0 stem + features.N.dw/pw blocks)")
+    params: dict = {}
+    stats: dict = {}
+    params["ConvBN_0"], stats["ConvBN_0"] = _convbn(
+        sd, "features.0", "features.1")
+    # torch stores stem bn as a sibling Sequential entry; block bns nest
+    for k in range(13):
+        t = f"features.{k + 3}"
+        dw_p, dw_s = _convbn(sd, f"{t}.dw.conv", f"{t}.dw.bn")
+        pw_p, pw_s = _convbn(sd, f"{t}.pw.conv", f"{t}.pw.bn")
+        name = f"DepthwiseSeparable_{k}"
+        params[name] = {"ConvBN_0": dw_p, "ConvBN_1": pw_p}
+        stats[name] = {"ConvBN_0": dw_s, "ConvBN_1": pw_s}
+    if include_fc:
+        params["Dense_0"] = {"kernel": _np(sd["linear.weight"]).T,
+                             "bias": _np(sd["linear.bias"])}
+    return {"params": params, "batch_stats": stats}
+
+
+def _basic_conv(sd: Mapping, key: str) -> dict:
+    """Reference BasicConv2d (conv + bias + ReLU) → flax BasicConv params."""
+    return {"Conv_0": {"kernel": _conv(sd[f"{key}.conv.weight"]),
+                       "bias": _np(sd[f"{key}.conv.bias"])}}
+
+
+# reference inception module attr ↔ flax auto-name index within
+# InceptionModule.  Flax numbers submodules in CONSTRUCTION order, and in
+# ``conv(c3)(conv(c3r)(x))`` Python constructs the outer conv before
+# evaluating its argument — so each branch's outer conv precedes its reducer.
+_INCEPTION_BRANCHES = (
+    ("branch1_conv1x1", 0), ("branch2_conv3x3", 1), ("branch2_conv1x1", 2),
+    ("branch3_conv5x5", 3), ("branch3_conv1x1", 4), ("branch4_conv1x1", 5))
+_INCEPTION_MODULES = ("inception_3a", "inception_3b", "inception_4a",
+                      "inception_4b", "inception_4c", "inception_4d",
+                      "inception_4e", "inception_5a", "inception_5b")
+
+
+def import_torch_inception_v1(state_dict: Mapping,
+                              include_fc: bool = True) -> dict:
+    """Reference Inception V1 / GoogLeNet layout (Inception/pytorch/models/
+    inception_v1.py: ``conv7x7/conv1x1/conv3x3``, ``inception_Nx`` modules
+    with ``branchK_convJxJ`` BasicConv2d branches, ``aux1/aux2``,
+    ``linear``) → flax ``models.inception.InceptionV1``.
+
+    The aux heads' first Linear consumes a flattened 4×4×128 map — same
+    NCHW→NHWC permutation as the sequential importer.  Published number:
+    Inception/pytorch/README.md."""
+    sd = state_dict
+    if "conv7x7.conv.weight" not in sd:
+        raise ValueError("not a reference-layout Inception V1 checkpoint "
+                         "(expects conv7x7.conv.weight)")
+    params: dict = {
+        "BasicConv_0": _basic_conv(sd, "conv7x7"),
+        "BasicConv_1": _basic_conv(sd, "conv1x1"),
+        "BasicConv_2": _basic_conv(sd, "conv3x3"),
+    }
+    for m, mod in enumerate(_INCEPTION_MODULES):
+        p: dict = {}
+        for attr, j in _INCEPTION_BRANCHES:
+            p[f"BasicConv_{j}"] = _basic_conv(sd, f"{mod}.{attr}")
+        params[f"InceptionModule_{m}"] = p
+    for a, aux in enumerate(("aux1", "aux2")):
+        p = {"BasicConv_0": _basic_conv(sd, f"{aux}.features.1")}
+        p["Dense_0"] = {
+            "kernel": _linear(sd[f"{aux}.classifier.0.weight"], (128, 4, 4)),
+            "bias": _np(sd[f"{aux}.classifier.0.bias"])}
+        if include_fc:
+            p["Dense_1"] = {
+                "kernel": _np(sd[f"{aux}.classifier.3.weight"]).T,
+                "bias": _np(sd[f"{aux}.classifier.3.bias"])}
+        params[f"AuxClassifier_{a}"] = p
+    if include_fc:
+        params["Dense_0"] = {"kernel": _np(sd["linear.weight"]).T,
+                             "bias": _np(sd["linear.bias"])}
+    return {"params": params, "batch_stats": {}}
+
+
+# config-registry name → importer.  Every architecture the reference
+# publishes an accuracy number for (docs/ACCURACY.md) imports here, so each
+# published number is one `cli.infer eval --pretrained` away from checkable.
+ARCH_IMPORTERS = {
+    "resnet34": lambda sd, fc: import_torch_resnet(sd, "resnet34", fc),
+    "resnet50": lambda sd, fc: import_torch_resnet(sd, "resnet50", fc),
+    "resnet152": lambda sd, fc: import_torch_resnet(sd, "resnet152", fc),
+    "alexnet1": import_torch_alexnet,
+    "alexnet2": import_torch_alexnet,
+    "vgg16": import_torch_vgg,
+    "vgg19": import_torch_vgg,
+    "lenet5": import_torch_lenet5,
+    "mobilenet1": import_torch_mobilenet_v1,
+    "inception1": import_torch_inception_v1,
+}
+
+
+def load_state_dict(path: str) -> dict:
+    """Load a ``.pth``/``.pt`` state_dict from disk.  Accepts both a bare
+    state_dict and the common ``{"state_dict": ...}`` wrapper (with
+    optional ``module.`` DataParallel prefixes)."""
     import torch
 
     obj = torch.load(path, map_location="cpu", weights_only=True)
     if isinstance(obj, dict) and "state_dict" in obj:
         obj = obj["state_dict"]
-    obj = {k.removeprefix("module."): v for k, v in obj.items()}
-    return import_torch_resnet(obj, arch, include_fc)
+    return {k.removeprefix("module."): v for k, v in obj.items()}
+
+
+def load_torch_checkpoint(path: str, arch: str = "resnet50",
+                          include_fc: bool = True) -> dict:
+    """Load from disk and convert.  ``arch`` is a config-registry name
+    (see ``ARCH_IMPORTERS``)."""
+    if arch not in ARCH_IMPORTERS:
+        raise ValueError(
+            f"no torch importer for '{arch}'; have {sorted(ARCH_IMPORTERS)}")
+    return ARCH_IMPORTERS[arch](load_state_dict(path), include_fc)
+
+
+def import_pretrained(path: str, arch: str, fresh: dict) -> tuple:
+    """The shared CLI loader: load once, convert, merge onto freshly-
+    initialized ``fresh`` variables.  Keeps the checkpoint's class head
+    when it fits the model; on a head shape mismatch re-converts headless
+    (fine-tuning on a different label space).  Returns
+    ``(merged_variables, head_kept)``."""
+    if arch not in ARCH_IMPORTERS:
+        raise ValueError(
+            f"no torch importer for '{arch}'; have {sorted(ARCH_IMPORTERS)}")
+    sd = load_state_dict(path)
+    try:
+        return merge_pretrained(fresh, ARCH_IMPORTERS[arch](sd, True)), True
+    except ValueError:
+        # a backbone mismatch raises again here — only the head recovers
+        return merge_pretrained(fresh, ARCH_IMPORTERS[arch](sd, False)), False
 
 
 def merge_pretrained(variables: dict, imported: dict) -> dict:

@@ -83,6 +83,11 @@ def _randomize_bn_stats(model, gen):
     attenuate the residual branch ~1e-4 relative to the shortcut and MASK
     real semantic mismatches (this hid a stride-2 padding bug — SAME pads
     low=0/high=1 where torch effectively pads low=1 — until round 5)."""
+    with torch.no_grad():
+        _randomize_bn_stats_impl(model, gen)
+
+
+def _randomize_bn_stats_impl(model, gen):
     for m in model.modules():
         if isinstance(m, tnn.BatchNorm2d):
             m.weight.copy_(

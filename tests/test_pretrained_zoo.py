@@ -1,0 +1,430 @@
+"""Torch-weight import parity across the published-accuracy zoo (VERDICT r4
+item 1): AlexNet V1/V2, VGG-16/19, Inception V1, MobileNet V1, LeNet-5 —
+every architecture whose trained numbers the reference publishes
+(AlexNet/VGG/Inception/MobileNet/LeNet ``pytorch/README.md``), so each
+number is one ``cli.infer eval --pretrained`` away from verification.
+
+Pattern follows test_pretrained.py: build a torch net with the REFERENCE's
+exact module layout (the state_dict key format the published checkpoints
+use), random weights, eval mode, and require logits parity through the
+importer.  Runs fully air-gapped.  BN nets randomize affines near 1 so
+scale attenuation can't mask placement/padding bugs (see
+test_pretrained._randomize_bn_stats).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn as tnn  # noqa: E402
+import torch.nn.functional as tfun  # noqa: E402
+
+import jax.numpy as jnp  # noqa: E402
+
+from deep_vision_tpu.models.pretrained import (  # noqa: E402
+    import_torch_alexnet,
+    import_torch_inception_v1,
+    import_torch_lenet5,
+    import_torch_mobilenet_v1,
+    import_torch_vgg,
+)
+
+from tests.test_pretrained import _randomize_bn_stats  # noqa: E402
+
+
+def _fill(net, gen, scale=0.05):
+    with torch.no_grad():
+        for p in net.parameters():
+            p.copy_(torch.randn(p.shape, generator=gen) * scale)
+
+
+def _parity(net, imported, flax_model, size, channels=3, gen=None,
+            atol=2e-4, rtol=1e-3):
+    with torch.no_grad():
+        net.eval()
+        x = torch.randn(2, channels, size, size, generator=gen)
+        ref = net(x).numpy()
+    out = flax_model.apply(
+        {"params": imported["params"],
+         "batch_stats": imported["batch_stats"]},
+        jnp.asarray(x.numpy().transpose(0, 2, 3, 1)), train=False)
+    np.testing.assert_allclose(np.asarray(out), ref, atol=atol, rtol=rtol)
+    return x
+
+
+# ---------------------------------------------------------------- AlexNet
+
+def _torch_alexnet(widths, num_classes=1000):
+    """Reference Sequential layout (AlexNet/pytorch/models/alexnet_v1.py
+    :27-117, alexnet_v2.py:30-64): conv indices 0/4/8/10/12, classifier
+    linears 1/4/6, LRN(width) after each of the first two ReLUs."""
+    f = widths
+    feats = tnn.Sequential(
+        tnn.Conv2d(3, f[0], 11, 4, 2), tnn.ReLU(),
+        tnn.LocalResponseNorm(f[0]), tnn.MaxPool2d(3, 2),
+        tnn.Conv2d(f[0], f[1], 5, 1, 2), tnn.ReLU(),
+        tnn.LocalResponseNorm(f[1]), tnn.MaxPool2d(3, 2),
+        tnn.Conv2d(f[1], f[2], 3, 1, 1), tnn.ReLU(),
+        tnn.Conv2d(f[2], f[3], 3, 1, 1), tnn.ReLU(),
+        tnn.Conv2d(f[3], f[4], 3, 1, 1), tnn.ReLU(),
+        tnn.MaxPool2d(3, 2))
+    clf = tnn.Sequential(
+        tnn.Dropout(), tnn.Linear(6 * 6 * f[4], 4096), tnn.ReLU(),
+        tnn.Dropout(), tnn.Linear(4096, 4096), tnn.ReLU(),
+        tnn.Linear(4096, num_classes))
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.features = feats
+            self.classifier = clf
+
+        def forward(self, x):
+            return self.classifier(torch.flatten(self.features(x), 1))
+
+    return Net()
+
+
+@pytest.mark.slow
+def test_alexnet_v1_import_forward_parity():
+    from deep_vision_tpu.models.alexnet import AlexNetV1
+
+    gen = torch.Generator().manual_seed(10)
+    net = _torch_alexnet((96, 256, 384, 384, 256), num_classes=12)
+    _fill(net, gen)
+    _parity(net, import_torch_alexnet(net.state_dict()),
+            AlexNetV1(num_classes=12), 224, gen=gen)
+
+
+def test_alexnet_v2_import_forward_parity():
+    from deep_vision_tpu.models.alexnet import AlexNetV2
+
+    gen = torch.Generator().manual_seed(11)
+    net = _torch_alexnet((64, 192, 384, 384, 256), num_classes=12)
+    _fill(net, gen)
+    _parity(net, import_torch_alexnet(net.state_dict()),
+            AlexNetV2(num_classes=12), 224, gen=gen)
+
+
+# ------------------------------------------------------------------- VGG
+
+def _torch_vgg(plan, num_classes=1000):
+    """Reference/torchvision Sequential layout (VGG/pytorch/models/
+    vgg16.py:25-99): 3×3 pad-1 convs interleaved with ReLU and 2×2
+    maxpools; classifier Linear/ReLU/Dropout ×2 + Linear."""
+    layers, in_ch = [], 3
+    for item in plan:
+        if item == "M":
+            layers.append(tnn.MaxPool2d(2, 2))
+        else:
+            layers += [tnn.Conv2d(in_ch, item, 3, 1, 1), tnn.ReLU()]
+            in_ch = item
+    clf = tnn.Sequential(
+        tnn.Linear(7 * 7 * 512, 4096), tnn.ReLU(), tnn.Dropout(),
+        tnn.Linear(4096, 4096), tnn.ReLU(), tnn.Dropout(),
+        tnn.Linear(4096, num_classes))
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.features = tnn.Sequential(*layers)
+            self.classifier = clf
+
+        def forward(self, x):
+            return self.classifier(torch.flatten(self.features(x), 1))
+
+    return Net()
+
+
+@pytest.mark.slow
+def test_vgg16_import_forward_parity():
+    from deep_vision_tpu.models.vgg import _VGG16, VGG16
+
+    gen = torch.Generator().manual_seed(12)
+    net = _torch_vgg(_VGG16, num_classes=7)
+    _fill(net, gen)
+    _parity(net, import_torch_vgg(net.state_dict()),
+            VGG16(num_classes=7), 224, gen=gen)
+
+
+@pytest.mark.slow
+def test_vgg19_import_forward_parity():
+    from deep_vision_tpu.models.vgg import _VGG19, VGG19
+
+    gen = torch.Generator().manual_seed(13)
+    net = _torch_vgg(_VGG19, num_classes=7)
+    _fill(net, gen)
+    _parity(net, import_torch_vgg(net.state_dict()),
+            VGG19(num_classes=7), 224, gen=gen)
+
+
+# ----------------------------------------------------------------- LeNet
+
+def _torch_lenet5(num_classes=10):
+    """Reference layout (LeNet/pytorch/models/lenet5.py:24-58): conv
+    indices 0/4/8 (tanh + avgpool interleaved), classifier linears 0/2."""
+    feats = tnn.Sequential(
+        tnn.Conv2d(1, 6, 5), tnn.Tanh(), tnn.AvgPool2d(2, 2), tnn.Tanh(),
+        tnn.Conv2d(6, 16, 5), tnn.Tanh(), tnn.AvgPool2d(2, 2), tnn.Tanh(),
+        tnn.Conv2d(16, 120, 5), tnn.Tanh())
+    clf = tnn.Sequential(tnn.Linear(120, 84), tnn.Tanh(),
+                         tnn.Linear(84, num_classes))
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.features = feats
+            self.classifier = clf
+
+        def forward(self, x):
+            return self.classifier(torch.flatten(self.features(x), 1))
+
+    return Net()
+
+
+def test_lenet5_import_forward_parity():
+    from deep_vision_tpu.models.lenet import LeNet5
+
+    gen = torch.Generator().manual_seed(14)
+    net = _torch_lenet5()
+    _fill(net, gen, scale=0.2)
+    _parity(net, import_torch_lenet5(net.state_dict()),
+            LeNet5(), 32, channels=1, gen=gen)
+
+
+# ------------------------------------------------------------- MobileNet
+
+class _TConvBN(tnn.Module):
+    def __init__(self, i, o, k, s, p, groups=1):
+        super().__init__()
+        self.conv = tnn.Conv2d(i, o, k, s, p, groups=groups, bias=False)
+        self.bn = tnn.BatchNorm2d(o)
+
+    def forward(self, x):
+        return tfun.relu(self.bn(self.conv(x)))
+
+
+class _TDWSep(tnn.Module):
+    """Reference DepthwiseSeparableConv (MobileNet/pytorch/models/
+    mobilenet_v1.py:98-155): ``dw``/``pw`` children each with conv+bn."""
+
+    def __init__(self, i, o, s):
+        super().__init__()
+        self.dw = _TConvBN(i, i, 3, s, 1, groups=i)
+        self.pw = _TConvBN(i, o, 1, 1, 0)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+def _torch_mobilenet_v1(num_classes=1000):
+    plan = [(32, 64, 1), (64, 128, 2), (128, 128, 1), (128, 256, 2),
+            (256, 256, 1), (256, 512, 2), (512, 512, 1), (512, 512, 1),
+            (512, 512, 1), (512, 512, 1), (512, 512, 1), (512, 1024, 2),
+            (1024, 1024, 1)]
+    feats = tnn.Sequential(
+        tnn.Conv2d(3, 32, 3, 2, 1, bias=False), tnn.BatchNorm2d(32),
+        tnn.ReLU(),
+        *[_TDWSep(i, o, s) for i, o, s in plan],
+        tnn.AdaptiveAvgPool2d((1, 1)))
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.features = feats
+            self.linear = tnn.Linear(1024, num_classes)
+
+        def forward(self, x):
+            return self.linear(torch.flatten(self.features(x), 1))
+
+    return Net()
+
+
+def test_mobilenet_v1_import_forward_parity():
+    from deep_vision_tpu.models.mobilenet import MobileNetV1
+
+    gen = torch.Generator().manual_seed(15)
+    net = _torch_mobilenet_v1(num_classes=9)
+    _fill(net, gen)
+    _randomize_bn_stats(net, gen)  # affines near 1: unmask padding bugs
+    # 64² input walks stride-2 blocks through even sizes 64/32/16/8 — the
+    # exact sites where XLA SAME and torch pad-1 placement diverge
+    _parity(net, import_torch_mobilenet_v1(net.state_dict()),
+            MobileNetV1(num_classes=9), 64, gen=gen)
+
+
+# ------------------------------------------------------------- Inception
+
+class _TBasicConv(tnn.Module):
+    """Reference BasicConv2d (inception_v1.py:193-201): conv+bias → ReLU."""
+
+    def __init__(self, i, o, k, **kw):
+        super().__init__()
+        self.conv = tnn.Conv2d(i, o, k, **kw)
+
+    def forward(self, x):
+        return tfun.relu(self.conv(x))
+
+
+class _TInceptionModule(tnn.Module):
+    def __init__(self, i, c1, c3r, c3, c5r, c5, cp):
+        super().__init__()
+        self.branch1_conv1x1 = _TBasicConv(i, c1, 1)
+        self.branch2_conv1x1 = _TBasicConv(i, c3r, 1)
+        self.branch2_conv3x3 = _TBasicConv(c3r, c3, 3, padding=1)
+        self.branch3_conv1x1 = _TBasicConv(i, c5r, 1)
+        self.branch3_conv5x5 = _TBasicConv(c5r, c5, 5, padding=2)
+        self.branch4_maxpool = tnn.MaxPool2d(3, 1, padding=1)
+        self.branch4_conv1x1 = _TBasicConv(i, cp, 1)
+
+    def forward(self, x):
+        return torch.cat(
+            [self.branch1_conv1x1(x),
+             self.branch2_conv3x3(self.branch2_conv1x1(x)),
+             self.branch3_conv5x5(self.branch3_conv1x1(x)),
+             self.branch4_conv1x1(self.branch4_maxpool(x))], 1)
+
+
+class _TAux(tnn.Module):
+    def __init__(self, i, num_classes=1000):
+        super().__init__()
+        self.features = tnn.Sequential(tnn.AvgPool2d(5, 3),
+                                       _TBasicConv(i, 128, 1))
+        self.classifier = tnn.Sequential(
+            tnn.Linear(4 * 4 * 128, 1024), tnn.ReLU(), tnn.Dropout(0.7),
+            tnn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        return self.classifier(torch.flatten(self.features(x), 1))
+
+
+class _TInceptionV1(tnn.Module):
+    """Reference module naming (inception_v1.py:27-77) so state_dict keys
+    match the published checkpoint format."""
+
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.conv7x7 = _TBasicConv(3, 64, 7, stride=2, padding=3)
+        self.maxpool1 = tnn.MaxPool2d(3, 2, ceil_mode=True)
+        self.lrn1 = tnn.LocalResponseNorm(64)
+        self.conv1x1 = _TBasicConv(64, 64, 1)
+        self.conv3x3 = _TBasicConv(64, 192, 3, padding=1)
+        self.lrn2 = tnn.LocalResponseNorm(192)
+        self.maxpool2 = tnn.MaxPool2d(3, 2, ceil_mode=True)
+        self.inception_3a = _TInceptionModule(192, 64, 96, 128, 16, 32, 32)
+        self.inception_3b = _TInceptionModule(256, 128, 128, 192, 32, 96, 64)
+        self.maxpool3 = tnn.MaxPool2d(3, 2, ceil_mode=True)
+        self.inception_4a = _TInceptionModule(480, 192, 96, 208, 16, 48, 64)
+        self.aux1 = _TAux(512, num_classes)
+        self.inception_4b = _TInceptionModule(512, 160, 112, 224, 24, 64, 64)
+        self.inception_4c = _TInceptionModule(512, 128, 128, 256, 24, 64, 64)
+        self.inception_4d = _TInceptionModule(512, 112, 144, 288, 32, 64, 64)
+        self.aux2 = _TAux(528, num_classes)
+        self.inception_4e = _TInceptionModule(528, 256, 160, 320, 32, 128, 128)
+        self.maxpool4 = tnn.MaxPool2d(3, 2, ceil_mode=True)
+        self.inception_5a = _TInceptionModule(832, 256, 160, 320, 32, 128, 128)
+        self.inception_5b = _TInceptionModule(832, 384, 192, 384, 48, 128, 128)
+        self.avgpool = tnn.AvgPool2d(7, stride=1)
+        self.dropout = tnn.Dropout(0.4)
+        self.linear = tnn.Linear(1024, num_classes)
+
+    def stem_to_4a(self, x):
+        x = self.lrn1(self.maxpool1(self.conv7x7(x)))
+        x = self.maxpool2(self.lrn2(self.conv3x3(self.conv1x1(x))))
+        x = self.inception_3b(self.inception_3a(x))
+        return self.inception_4a(self.maxpool3(x))
+
+    def forward(self, x):
+        x = self.stem_to_4a(x)
+        x = self.inception_4d(self.inception_4c(self.inception_4b(x)))
+        x = self.maxpool4(self.inception_4e(x))
+        x = self.avgpool(self.inception_5b(self.inception_5a(x)))
+        return self.linear(self.dropout(torch.flatten(x, 1)))
+
+
+@pytest.mark.slow
+def test_inception_v1_import_forward_parity():
+    from deep_vision_tpu.models.inception import AuxClassifier, InceptionV1
+
+    gen = torch.Generator().manual_seed(16)
+    net = _TInceptionV1(num_classes=1000)
+    _fill(net, gen)
+    imported = import_torch_inception_v1(net.state_dict())
+    x = _parity(net, imported, InceptionV1(num_classes=1000), 224, gen=gen)
+
+    # the eval graph drops aux heads on both sides, so verify their import
+    # directly: feed the torch 4a feature map through the flax AuxClassifier
+    with torch.no_grad():
+        feat = net.stem_to_4a(x)
+        ref_aux = net.aux1(feat).numpy()
+    out_aux = AuxClassifier(num_classes=1000).apply(
+        {"params": imported["params"]["AuxClassifier_0"]},
+        jnp.asarray(feat.numpy().transpose(0, 2, 3, 1)), train=False)
+    np.testing.assert_allclose(np.asarray(out_aux), ref_aux,
+                               atol=2e-4, rtol=1e-3)
+
+
+# ------------------------------------------------------ CLI eval harness
+
+@pytest.mark.slow
+def test_eval_pretrained_lenet_harness(tmp_path, capsys):
+    """`infer eval --pretrained` must accept the non-ResNet arches too —
+    the command docs/ACCURACY.md pairs with each published number.  LeNet's
+    published setting IS 10-class, so the checkpoint head must be kept
+    (the old num_classes==1000 heuristic would have dropped it)."""
+    from deep_vision_tpu.cli import infer
+
+    gen = torch.Generator().manual_seed(17)
+    net = _torch_lenet5()
+    _fill(net, gen, scale=0.2)
+    pth = tmp_path / "lenet.pth"
+    torch.save(net.state_dict(), pth)
+    infer.main(["eval", "-m", "lenet5", "--workdir", str(tmp_path / "w"),
+                "--pretrained", str(pth), "--synthetic",
+                "--synthetic-size", "8", "--batch-size", "8"])
+    out = capsys.readouterr().out
+    assert "imported lenet5 weights" in out
+    assert "with checkpoint head" in out
+    assert "top1=" in out and "eval[" in out
+
+
+def test_importer_rejects_wrong_arch():
+    gen = torch.Generator().manual_seed(18)
+    net = _torch_lenet5()
+    _fill(net, gen)
+    with pytest.raises(ValueError, match="5 convs"):
+        import_torch_alexnet(net.state_dict())
+    with pytest.raises(ValueError, match="not a reference-layout"):
+        import_torch_mobilenet_v1(net.state_dict())
+
+
+def test_sequential_importer_rejects_bn_variant():
+    """A _bn checkpoint (torchvision vgg16_bn style) must be refused, not
+    silently imported minus its BatchNorms (which evaluates to garbage)."""
+    sd = _torch_lenet5().state_dict()
+    sd["features.1.weight"] = torch.zeros(6)
+    sd["features.1.bias"] = torch.zeros(6)
+    sd["features.1.running_mean"] = torch.zeros(6)
+    sd["features.1.running_var"] = torch.ones(6)
+    with pytest.raises(ValueError, match="BatchNorm"):
+        import_torch_lenet5(sd)
+
+
+@pytest.mark.slow
+def test_train_pretrained_accepts_zoo_arch(tmp_path, capsys):
+    """cli.train --pretrained must accept the zoo arches for fine-tuning
+    (it gated on the ResNet-only table before round 5)."""
+    from deep_vision_tpu.cli import train as train_cli
+
+    gen = torch.Generator().manual_seed(19)
+    net = _torch_lenet5()
+    _fill(net, gen, scale=0.2)
+    pth = tmp_path / "lenet.pth"
+    torch.save(net.state_dict(), pth)
+    train_cli.main(["-m", "lenet5", "--synthetic", "--synthetic-size", "16",
+                    "--batch-size", "8", "--epochs", "1",
+                    "--workdir", str(tmp_path / "w"),
+                    "--pretrained", str(pth)])
+    out = capsys.readouterr().out
+    assert "[pretrained] loaded lenet5 weights" in out
+    assert "head kept" in out
