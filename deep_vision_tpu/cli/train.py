@@ -320,8 +320,15 @@ def _main_detection(args, cfg, mesh):
             # check at the REAL compiled shapes — Mosaic tiling/VMEM limits
             # are shape-dependent, so toy shapes prove nothing; the loss
             # calls the kernel once PER SCALE with that scale's n_pred, and
-            # under shard_map the kernel sees the PER-SHARD batch
-            per_shard = max(cfg.batch_size // mesh.shape.get("data", 1), 1)
+            # under shard_map the kernel sees the PER-SHARD batch.
+            # cfg.batch_size is per-HOST, the data axis spans all hosts —
+            # the global batch is per-host × process_count; grad accum then
+            # splits each shard into microbatches INSIDE the step, so the
+            # kernel's real compiled batch divides by that too
+            global_batch = cfg.batch_size * jax.process_count()
+            accum = max(1, getattr(cfg, "grad_accum_steps", 1))
+            per_shard = max(
+                global_batch // mesh.shape.get("data", 1) // accum, 1)
             use_pallas = all(
                 pallas_parity_ok(batch=per_shard,
                                  n_pred=3 * (cfg.image_size // s) ** 2,

@@ -157,9 +157,21 @@ class Trainer:
             return replicate(state, self.mesh)
         from jax.sharding import NamedSharding
 
+        multiproc = jax.process_count() > 1
+
         def place(path, leaf):
             spec = rule(jax.tree_util.keystr(path), leaf)
-            return jax.device_put(leaf, NamedSharding(self.mesh, spec))
+            sharding = NamedSharding(self.mesh, spec)
+            if multiproc:
+                # device_put can't build a multi-host global array from a
+                # host-local value; assemble it the way replicate() does.
+                # global_shape=leaf.shape: every host holds the FULL leaf
+                # (init/restore are replicated), so local data IS the global
+                # array — without it, a rule axis spanning processes would
+                # be inferred as a per-host chunk and double-counted
+                return jax.make_array_from_process_local_data(
+                    sharding, leaf, global_shape=leaf.shape)
+            return jax.device_put(leaf, sharding)
 
         return jax.tree_util.tree_map_with_path(place, state)
 

@@ -42,7 +42,14 @@ def resize_bilinear(img: np.ndarray, w: int, h: int) -> np.ndarray:
     comparisons across machines are attributable.  Accepts uint8 or float
     HWC arrays; dtype is preserved on both paths."""
     if img.shape[0] == h and img.shape[1] == w:
-        return img  # already at target (e.g. raw-store reads): zero-copy
+        # already at target (e.g. raw-store reads): zero-copy, and the
+        # result may ALIAS the input — possibly a read-only frombuffer
+        # view of the record cache (records._LazySample).  Contract:
+        # callers must not write the result in place (audited round 5:
+        # every consumer flows into astype/np.stack copies; a violation
+        # raises ValueError loudly on the read-only view, it cannot
+        # corrupt silently)
+        return img
     if _cv2 is not None:
         return _cv2.resize(img, (w, h), interpolation=_cv2.INTER_LINEAR)
     if img.dtype == np.uint8:
