@@ -43,7 +43,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--image-size", type=int, default=None,
                    help="override config (smoke runs at low res)")
     p.add_argument("--mesh", default=None,
-                   help="mesh spec like 'data=8', 'data=4,model=2', or "
+                   help="mesh spec like 'data=8', 'data=4,model=2', "
+                        "'data=2,spatial=4' (image rows sharded over "
+                        "'spatial'; GSPMD inserts the conv halo exchanges "
+                        "— the activation-memory lever, docs/PERF.md), or "
                         "'data=2,pipe=4' (GPipe pipeline over the stacked "
                         "families: hourglass pose, CenterNet detection)")
     p.add_argument("--microbatches", type=int, default=None,
