@@ -337,8 +337,9 @@ def main(argv=None):
 
 def _cmd_eval(args, cfg):
     """Held-out evaluation from a restored checkpoint: detection/centernet
-    report VOC mAP@0.5 (the evaluation the reference's YOLO README lists
-    as "WIP"), classification reports top-1/top-5 (the reference's
+    report VOC mAP@0.5 AND COCO mAP@[.5:.95] (the evaluation the
+    reference's YOLO README lists as "WIP", finished to the modern
+    standard), classification reports top-1/top-5 (the reference's
     ``validate()``), pose reports val loss."""
     from deep_vision_tpu.core.trainer import Trainer
 

@@ -33,6 +33,11 @@ class OptimizerConfig:
     eps: float = 1e-8
     rms_decay: float = 0.9  # torch RMSprop 'alpha' (MobileNet config uses 0.9)
     grad_clip_norm: float | None = None
+    # SGD momentum accumulator storage dtype (None = param dtype, f32).
+    # "bfloat16" halves the optimizer-state HBM traffic in the elementwise
+    # band of the step — a measured experiment, see docs/PERF.md; changes
+    # update numerics (~1e-3 relative), so NOT part of the parity recipe.
+    momentum_dtype: str | None = None
 
 
 def _weight_decay_mask(params):
@@ -50,6 +55,14 @@ def _weight_decay_mask(params):
 
 
 def build_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
+    if cfg.momentum_dtype not in (None, "bfloat16"):
+        raise ValueError(f"momentum_dtype must be None or 'bfloat16', "
+                         f"got {cfg.momentum_dtype!r}")
+    if cfg.momentum_dtype is not None and cfg.name != "sgd":
+        raise ValueError(
+            f"momentum_dtype applies to the sgd momentum accumulator "
+            f"only; optimizer is {cfg.name!r}")
+
     def make(learning_rate):
         txs = []
         if cfg.grad_clip_norm:
@@ -59,7 +72,11 @@ def build_optimizer(cfg: OptimizerConfig) -> optax.GradientTransformation:
                 txs.append(
                     optax.add_decayed_weights(cfg.weight_decay, mask=_weight_decay_mask)
                 )
-            txs.append(optax.sgd(learning_rate, momentum=cfg.momentum, nesterov=cfg.nesterov))
+            acc_dtype = (jnp.bfloat16 if cfg.momentum_dtype == "bfloat16"
+                         else None)
+            txs.append(optax.sgd(learning_rate, momentum=cfg.momentum,
+                                 nesterov=cfg.nesterov,
+                                 accumulator_dtype=acc_dtype))
         elif cfg.name == "adam":
             if cfg.weight_decay:
                 txs.append(optax.adamw(learning_rate, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,

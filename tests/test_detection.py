@@ -251,6 +251,41 @@ def test_map_evaluator_perfect_and_miss():
     assert res["per_class"][0] == pytest.approx(1.0)
     assert res["per_class"][1] == pytest.approx(0.0)
     assert res["mAP"] == pytest.approx(0.5)
+    # exact hit scores 1.0 at every COCO threshold; the miss 0 at every one
+    assert res["mAP50_95"] == pytest.approx(0.5)
+
+
+def test_map_coco_average_partial_overlap():
+    """A detection at IoU 0.8 passes thresholds 0.50–0.80 (7 of the 10 COCO
+    grid points) and fails 0.85–0.95 → mAP50_95 = 0.7 while mAP@0.5 = 1."""
+    ev = MeanAPEvaluator(num_classes=1)
+    gt = np.array([[0.0, 0.0, 10.0, 10.0]])
+    det = np.array([[0.0, 0.0, 10.0, 8.0]])   # inter 80 / union 100 = 0.8
+    ev.add(det, np.array([0.9]), np.array([0]), gt, np.array([0]))
+    res = ev.compute()
+    assert res["mAP"] == pytest.approx(1.0)
+    assert res["mAP50_95"] == pytest.approx(0.7)
+
+
+def test_map_matching_rules_crowded_objects():
+    """The two matching rules diverge on crowded scenes, and each metric
+    uses its own: det2's argmax-IoU gt is taken by det1, so VOC-devkit
+    matching (mAP@0.5 — comparable to published VOC numbers) counts it
+    FP (AP 0.5), while COCO matching (the mAP50_95 grid) lets it fall
+    through to the unmatched gt above threshold (AP 1.0 at IoUs ≤ 0.8)."""
+    ev = MeanAPEvaluator(num_classes=1)
+    gts = np.array([[0.0, 0.0, 10.0, 10.0], [2.0, 0.0, 12.0, 10.0]])
+    dets = np.array([[0.0, 0.0, 10.0, 10.0],   # IoU 1.0 / 0.667
+                     [1.0, 0.0, 11.0, 10.0]])  # IoU 0.818 / 0.818 (tie)
+    ev.add(dets, np.array([0.9, 0.8]), np.array([0, 0]),
+           gts, np.array([0, 0]))
+    res = ev.compute()
+    assert res["mAP"] == pytest.approx(0.5)       # VOC rule: det2 is FP
+    # COCO rule: both match for the 7 grid points ≤0.80 where det2's 0.818
+    # clears threshold (AP 1.0); above that only det1 matches.  AP at a
+    # threshold where recall stops at 0.5 with precision 1.0 is 0.5, so
+    # the average is (7·1.0 + 3·0.5)/10
+    assert res["mAP50_95"] == pytest.approx(0.85)
 
 
 def test_yolov3_model_shapes():

@@ -55,3 +55,31 @@ def test_build_scheduler_registry():
     assert isinstance(s, EpochTableSchedule)
     s = build_scheduler("warmup_cosine", 0.1, total_epochs=10)
     assert isinstance(s, WarmupCosine)
+
+
+def test_momentum_dtype_bf16_accumulator():
+    """momentum_dtype='bfloat16' stores the SGD trace in bf16 (the
+    optimizer-state bandwidth experiment, docs/PERF.md) and is rejected
+    for anything but sgd / any other dtype string."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from deep_vision_tpu.core.optim import OptimizerConfig, build_optimizer
+
+    params = {"w": jnp.ones((4, 4), jnp.float32)}
+    tx = build_optimizer(OptimizerConfig(name="sgd", learning_rate=0.1,
+                                         momentum=0.9,
+                                         momentum_dtype="bfloat16"))
+    st = tx.init(params)
+    accs = [l for l in jax.tree_util.tree_leaves(st)
+            if getattr(l, "shape", None) == (4, 4)]
+    assert accs and all(l.dtype == jnp.bfloat16 for l in accs)
+    upd, _ = tx.update({"w": jnp.full((4, 4), 0.5)}, st, params)
+    assert jnp.isfinite(upd["w"]).all()
+
+    with pytest.raises(ValueError, match="momentum_dtype"):
+        build_optimizer(OptimizerConfig(name="sgd", momentum_dtype="bf16"))
+    with pytest.raises(ValueError, match="sgd"):
+        build_optimizer(OptimizerConfig(name="adam",
+                                        momentum_dtype="bfloat16"))
