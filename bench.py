@@ -50,7 +50,8 @@ def _peak_tflops() -> float:
 
 def bench_train_step(batch: int = 256, size: int = 224, steps: int = 20,
                      profile: bool = False, scan_steps: int = 40,
-                     ema_decay: float = 0.0, grad_accum: int = 1) -> dict:
+                     ema_decay: float = 0.0, grad_accum: int = 1,
+                     momentum_dtype: str | None = None) -> dict:
     """Sustained ResNet-50 train-step throughput.
 
     ``scan_steps`` mirrors the Trainer's multi-step dispatch
@@ -78,7 +79,8 @@ def bench_train_step(batch: int = 256, size: int = 224, steps: int = 20,
     model = ResNet50(dtype=jnp.bfloat16)
     task = ClassificationTask(1000)
     tx = build_optimizer(OptimizerConfig(
-        name="sgd", learning_rate=0.1, momentum=0.9, weight_decay=1e-4))
+        name="sgd", learning_rate=0.1, momentum=0.9, weight_decay=1e-4,
+        momentum_dtype=momentum_dtype))
 
     rng = jax.random.PRNGKey(0)
     x = jax.random.normal(rng, (batch, size, size, 3), jnp.float32)
@@ -182,7 +184,8 @@ def bench_train_step(batch: int = 256, size: int = 224, steps: int = 20,
                    for d in arr.devices()}) or 1
     img_per_sec_per_chip = steps * batch / dt / n_chips
     suffix = ("_ema" if ema_decay else "") + \
-        (f"_ga{grad_accum}" if grad_accum > 1 else "")
+        (f"_ga{grad_accum}" if grad_accum > 1 else "") + \
+        ("_bf16mom" if momentum_dtype == "bfloat16" else "")
     out = {
         "metric": "resnet50_train_images_per_sec_per_chip" + suffix,
         "value": round(img_per_sec_per_chip, 1),
@@ -868,6 +871,10 @@ def main():
     p.add_argument("--grad-accum", type=int, default=1,
                    help="measure with N-microbatch gradient accumulation "
                         "(the Trainer's --grad-accum)")
+    p.add_argument("--momentum-dtype", choices=("bfloat16",), default=None,
+                   help="store the SGD momentum accumulator in bf16 "
+                        "(OptimizerConfig.momentum_dtype) — the optimizer-"
+                        "state bandwidth experiment, docs/PERF.md")
     p.add_argument("--recipe", action="store_true",
                    help="one line per recipe-overhead combo (base, EMA, "
                         "grad-accum 2/4, EMA+ga2), each in a fresh process")
@@ -915,7 +922,8 @@ def main():
                                profile=args.profile,
                                scan_steps=args.scan_steps,
                                ema_decay=args.ema_decay,
-                               grad_accum=args.grad_accum)
+                               grad_accum=args.grad_accum,
+                               momentum_dtype=args.momentum_dtype)
     print(json.dumps(out))
 
 

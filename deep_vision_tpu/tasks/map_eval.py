@@ -74,12 +74,19 @@ class MeanAPEvaluator:
     COCO_IOUS = tuple(np.arange(0.50, 0.96, 0.05).round(2))
 
     def _class_entries(self, c: int) -> list:
-        """Score-sorted detections with their per-gt IoU vectors computed
-        ONCE — scores and IoUs are threshold-independent, so the per-
-        threshold passes below only redo the (cheap) matching/cumsum."""
+        """Score-sorted detections with their per-gt IoU vectors AND the
+        IoU-descending gt order computed ONCE — scores, IoUs, and sort
+        order are threshold-independent, so the per-threshold passes
+        below only redo the (cheap) matching/cumsum."""
         dets = sorted(self._dets[c], key=lambda d: -d[0])
-        return [(img, _iou_matrix(box[None], gts)[0] if len(gts) else None)
-                for (_s, box, img, gts) in dets]
+        out = []
+        for (_s, box, img, gts) in dets:
+            if len(gts):
+                ious = _iou_matrix(box[None], gts)[0]
+                out.append((img, ious, np.argsort(-ious)))
+            else:
+                out.append((img, None, None))
+        return out
 
     def _class_ap(self, entries: list, n_gt: int, iou_threshold: float,
                   coco_matching: bool) -> float:
@@ -95,14 +102,14 @@ class MeanAPEvaluator:
         matched: dict[int, set] = {}
         tp = np.zeros(len(entries))
         fp = np.zeros(len(entries))
-        for i, (img, ious) in enumerate(entries):
+        for i, (img, ious, order) in enumerate(entries):
             if ious is None:
                 fp[i] = 1
                 continue
             taken = matched.setdefault(img, set())
             j = -1
             if coco_matching:
-                for cand in np.argsort(-ious):
+                for cand in order:
                     if ious[cand] < iou_threshold:
                         break
                     if int(cand) not in taken:
