@@ -40,6 +40,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--ema-decay", type=float, default=None,
                    help="params EMA decay (e.g. 0.9999); eval/serving "
                         "use the averaged copy")
+    p.add_argument("--momentum-dtype", choices=("bfloat16",), default=None,
+                   help="store the SGD momentum accumulator in bf16 "
+                        "(halves optimizer-state HBM; ~1e-3 update "
+                        "numerics change — OFF for parity recipes)")
     p.add_argument("--image-size", type=int, default=None,
                    help="override config (smoke runs at low res)")
     p.add_argument("--mesh", default=None,
@@ -114,6 +118,8 @@ def main(argv=None):
         cfg.grad_accum_steps = args.grad_accum
     if args.ema_decay is not None:
         cfg.ema_decay = args.ema_decay
+    if args.momentum_dtype is not None:
+        cfg.optimizer.momentum_dtype = args.momentum_dtype
     if args.image_size is not None:
         cfg.image_size = args.image_size
 

@@ -403,7 +403,17 @@ class Trainer:
             for k, v in sums.items():
                 totals[k] = totals.get(k, 0.0) + float(v)
             if evaluator is not None and extra is not None:
-                evaluator.add_batch(jax.device_get(extra))
+                if jax.process_count() > 1:
+                    # extras are batch-sharded over `data`, which spans
+                    # processes — gather every host's shard so each rank's
+                    # accumulator sees the GLOBAL val set (and all ranks
+                    # therefore report identical mAP)
+                    from jax.experimental import multihost_utils
+                    extra = multihost_utils.process_allgather(extra,
+                                                              tiled=True)
+                else:
+                    extra = jax.device_get(extra)
+                evaluator.add_batch(extra)
         count = max(totals.pop("count", 1.0), 1.0)
         out = {k: v / count for k, v in totals.items()}
         if evaluator is not None:
