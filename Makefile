@@ -15,6 +15,17 @@ test:
 test-all:
 	$(PY) -m pytest tests/ -q
 
+# boot the HTTP serving stack on a random port against a LeNet fixture,
+# issue one request, assert a 200 (the cli.serve wiring, end to end)
+serve-smoke:
+	$(PY) tests/serve_smoke.py
+
+serve_%:
+	$(PY) -m deep_vision_tpu.cli.serve -m $* --workdir $(WORKDIR)/$*
+
+bench-serve:
+	$(PY) bench.py --serve
+
 bench:
 	$(PY) bench.py
 
@@ -43,4 +54,4 @@ eval_%:
 list:
 	$(PY) -m deep_vision_tpu.cli.train --list -m x
 
-.PHONY: test test-all bench list
+.PHONY: test test-all bench bench-serve serve-smoke list

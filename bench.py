@@ -512,6 +512,81 @@ def bench_infer(name: str = "resnet50", steps: int | None = None,
     return out
 
 
+def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
+                duration_s: float = 2.0, max_batch: int = 8,
+                max_wait_ms: float = 2.0) -> dict:
+    """Closed-loop load generator against the dynamic-batching engine
+    (``deep_vision_tpu/serve``): C client threads each submit one image,
+    wait for the answer, repeat — so C is the offered load (concurrency),
+    and the engine's batcher decides how requests coalesce into bucketed
+    device batches.  One JSON line reports p50/p95/p99 request latency
+    and sustained img/s at every load point — the knee where latency
+    rises faster than throughput is the max_wait/bucket tuning signal
+    (docs/SERVING.md).
+    """
+    import sys
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from deep_vision_tpu.core.config import get_config
+    from deep_vision_tpu.core.restore import load_state
+    from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.registry import CheckpointServingModel
+
+    cfg = get_config(model_name)
+    with tempfile.TemporaryDirectory() as td:
+        # random-init fallback: serving latency is weight-agnostic
+        model, state = load_state(cfg, td,
+                                  log=lambda m: print(m, file=sys.stderr))
+    sm = CheckpointServingModel(model_name, cfg, model, state)
+    img = np.random.RandomState(0).randn(*sm.input_shape).astype(np.float32)
+    points = []
+    with BatchingEngine(sm, max_batch=max_batch,
+                        max_wait_ms=max_wait_ms) as engine:
+        engine.warmup()  # compiles excluded from every load point
+        for clients in loads:
+            latencies: list = []
+            lock = threading.Lock()
+            stop_at = time.perf_counter() + duration_s
+
+            def client():
+                local = []
+                while time.perf_counter() < stop_at:
+                    t0 = time.perf_counter()
+                    engine.infer(img, timeout=60)
+                    local.append(time.perf_counter() - t0)
+                with lock:
+                    latencies.extend(local)
+
+            threads = [threading.Thread(target=client)
+                       for _ in range(clients)]
+            t_start = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t_start
+            lat_ms = np.asarray(latencies) * 1e3
+            points.append({
+                "clients": clients, "requests": len(latencies),
+                "img_per_sec": round(len(latencies) / elapsed, 1),
+                "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+                "p95_ms": round(float(np.percentile(lat_ms, 95)), 2),
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 2)})
+        stats = engine.stats()
+    return {"metric": f"serve_{model_name}_img_per_sec",
+            "value": points[-1]["img_per_sec"], "unit": "img/s",
+            "model": model_name, "max_batch": max_batch,
+            "max_wait_ms": max_wait_ms, "buckets": stats["buckets"],
+            "loads": points,
+            "engine": {"batches": stats["batches"],
+                       "compiles": stats["compiles"],
+                       "padded_images": stats["padded_images"]},
+            "device_kind": jax.devices()[0].device_kind}
+
+
 def bench_all() -> list[dict]:
     """Run every task bench in its own subprocess (fresh process ⇒
     per-model peak-HBM stats and no cross-compile interference)."""
@@ -865,6 +940,17 @@ def main():
     p.add_argument("--infer", choices=("resnet50", "yolo"), default=None,
                    help="forward-only serving throughput (yolo includes "
                         "on-device decode + NMS)")
+    p.add_argument("--serve", action="store_true",
+                   help="closed-loop load generator against the dynamic-"
+                        "batching engine (deep_vision_tpu/serve): "
+                        "p50/p95/p99 latency + img/s per offered load")
+    p.add_argument("--serve-model", default="lenet5",
+                   help="config to serve (--serve)")
+    p.add_argument("--serve-loads", default="1,8",
+                   help="comma-separated closed-loop client counts "
+                        "(--serve offered-load points)")
+    p.add_argument("--serve-duration", type=float, default=2.0,
+                   help="seconds per offered-load point (--serve)")
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="measure the train step with the params-EMA "
                         "update in it (the Trainer's --ema-decay)")
@@ -900,6 +986,12 @@ def main():
     if args.live_gan:
         print(json.dumps(bench_cyclegan_live(steps=args.steps or 20,
                                              batch=args.batch or 1)))
+        return
+    if args.serve:
+        print(json.dumps(bench_serve(
+            model_name=args.serve_model,
+            loads=tuple(int(c) for c in args.serve_loads.split(",")),
+            duration_s=args.serve_duration, max_batch=args.batch or 8)))
         return
     if args.infer:
         print(json.dumps(bench_infer(args.infer, steps=args.steps,

@@ -1,0 +1,101 @@
+"""Serving CLI — boot the dynamic-batching inference engine over HTTP.
+
+    # serve a trained workdir (best checkpoint, EMA weights if trained)
+    python -m deep_vision_tpu.cli.serve -m resnet50 --workdir runs/r50
+
+    # serve a StableHLO export (cli.infer export artifact)
+    python -m deep_vision_tpu.cli.serve -m resnet50 --workdir runs/r50 \\
+        --stablehlo model.stablehlo
+
+    # tuning: batch buckets, drain window, queue bound
+    python -m deep_vision_tpu.cli.serve -m yolov3_voc --workdir runs/y \\
+        --max-batch 16 --max-wait-ms 8 --max-queue 512 --warmup
+
+Knobs and architecture: docs/SERVING.md.  Smoke: ``make serve-smoke``.
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_server(args):
+    """argparse namespace → (engine, ServeServer); shared with the smoke
+    test so `make serve-smoke` boots exactly the production wiring."""
+    from deep_vision_tpu.serve.admission import AdmissionController
+    from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.http import ServeServer
+    from deep_vision_tpu.serve.registry import ModelRegistry
+
+    registry = ModelRegistry()
+    if args.stablehlo:
+        sm = registry.load_exported(args.model, args.stablehlo,
+                                    args.workdir)
+    else:
+        sm = registry.load_checkpoint(args.model, args.workdir)
+    buckets = [int(b) for b in args.buckets.split(",")] if args.buckets \
+        else None
+    engine = BatchingEngine(
+        sm, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        buckets=buckets,
+        admission=AdmissionController(max_queue=args.max_queue,
+                                      max_wait_ms=args.max_wait_ms))
+    engine.start()
+    if args.warmup:
+        print(f"[serve] warming {engine.buckets} ...")
+        engine.warmup()
+    server = ServeServer(registry, {sm.name: engine}, host=args.host,
+                         port=args.port, verbose=args.verbose)
+    return engine, server
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="deep_vision_tpu dynamic-batching inference server")
+    p.add_argument("-m", "--model", required=True,
+                   help="config name (see cli.train --list)")
+    p.add_argument("--workdir", required=True,
+                   help="training workdir (checkpoint restore; also "
+                        "supplies variables for --stablehlo)")
+    p.add_argument("--stablehlo", default=None,
+                   help="serve this exported blob instead of re-jitting "
+                        "the checkpoint (fixed batch = export batch)")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 = pick a free port")
+    p.add_argument("--max-batch", type=int, default=32)
+    p.add_argument("--max-wait-ms", type=float, default=5.0,
+                   help="batch drain window: latency floor under load, "
+                        "batching opportunity at low load")
+    p.add_argument("--buckets", default=None,
+                   help="comma-separated batch buckets (default: powers "
+                        "of two up to --max-batch)")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="admission bound; beyond this requests shed 429")
+    p.add_argument("--warmup", action="store_true",
+                   help="compile every bucket before accepting traffic")
+    p.add_argument("--verbose", action="store_true",
+                   help="per-request HTTP access logs")
+    args = p.parse_args(argv)
+
+    from deep_vision_tpu.core.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    engine, server = build_server(args)
+    print(f"[serve] {args.model} listening on "
+          f"http://{server.host}:{server.port} "
+          f"(buckets={engine.buckets}, max_wait={args.max_wait_ms}ms, "
+          f"max_queue={args.max_queue})")
+    print(f"[serve] try: curl http://{server.host}:{server.port}/v1/healthz")
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        print("[serve] shutting down")
+    finally:
+        server.shutdown()
+        engine.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

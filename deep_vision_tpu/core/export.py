@@ -30,6 +30,18 @@ def export_forward(model, variables, input_shape, path: str,
         lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), variables)
     exported = jexport.export(jax.jit(forward))(v_spec, x_spec)
     blob = exported.serialize()
+    # The loader hands ``(variables, x)`` straight to the deserialized
+    # callable, so the artifact is only servable if the variables pytree
+    # (collection/key ordering included) survives serialization exactly.
+    # Verify on the bytes being shipped, not the in-memory object.
+    reloaded = jexport.deserialize(blob)
+    if (reloaded.in_tree != exported.in_tree
+            or list(reloaded.in_avals) != list(exported.in_avals)):
+        raise ValueError(
+            "exported variables pytree does not round-trip through "
+            "serialize/deserialize — the blob would reorder or retype "
+            f"inputs at load time (exported {exported.in_tree}, "
+            f"reloaded {reloaded.in_tree})")
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "wb") as f:
         f.write(blob)
@@ -37,9 +49,20 @@ def export_forward(model, variables, input_shape, path: str,
 
 
 def load_exported(path: str):
-    """Deserialize; returns a callable (variables, x) -> outputs."""
+    """Deserialize; returns a callable (variables, x) -> outputs.
+
+    The callable carries ``in_tree``/``in_avals`` (the exported input
+    pytree structure and shapes) so callers — e.g. ``serve/registry.py``
+    — can validate variables and read the traced batch size without
+    re-parsing the blob.
+    """
     from jax import export as jexport
 
     with open(path, "rb") as f:
         exported = jexport.deserialize(f.read())
-    return exported.call
+    def call(*args, **kwargs):
+        return exported.call(*args, **kwargs)
+
+    call.in_tree = exported.in_tree
+    call.in_avals = exported.in_avals
+    return call

@@ -1,0 +1,112 @@
+"""Checkpoint → serving-state restore, shared by every inference surface.
+
+Extracted from ``cli/infer.py`` (where it was private to the demo CLI) so
+the serving engine (``serve/registry.py``), the CLI, and any future
+deployment path all build serving states through one function: workdir
+checkpoint discovery (``checkpoints_best`` preferred), pipeline-layout →
+monolithic conversion for runs trained with ``--mesh ...,pipe=p``, and the
+EMA-params preference (serve the averaged copy — the weights eval scored).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+
+def load_state(cfg, workdir, *, log=print, tag: str = "restore"):
+    """Restore (model, TrainState) ready to serve from ``workdir``.
+
+    Prefers ``checkpoints_best`` over ``checkpoints``; converts
+    pipeline-trained layouts to monolithic; serves EMA params when the run
+    trained with them.  Falls back to a fresh random init (with a warning)
+    when no checkpoint exists — the synthetic / smoke-test path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.core import checkpoint as ckpt_lib
+    from deep_vision_tpu.core.optim import build_optimizer
+    from deep_vision_tpu.core.state import TrainState
+
+    model = cfg.model()
+    x = jnp.zeros((1, cfg.image_size, cfg.image_size, cfg.channels))
+
+    def fresh_state():
+        variables = jax.jit(functools.partial(model.init, train=False))(
+            {"params": jax.random.PRNGKey(0)}, x)
+        return TrainState.create(
+            apply_fn=model.apply, params=variables["params"],
+            tx=build_optimizer(cfg.optimizer),
+            batch_stats=variables.get("batch_stats", {}))
+
+    for sub in ("checkpoints_best", "checkpoints"):
+        d = os.path.join(workdir, sub)
+        if os.path.isdir(d):
+            ckpt = ckpt_lib.Checkpointer(d)
+            if ckpt.latest_step() is not None:
+                if ckpt.state_subtree_keys("params") == {"stem", "stages"}:
+                    # pipeline-trained run (cli.train --mesh ...,pipe=p):
+                    # restore the pipelined layout, convert to monolithic
+                    # (no monolithic init needed — the merged variables
+                    # build the serving state directly)
+                    state = restore_pipelined(cfg, model, ckpt, x)
+                    log(f"[{tag}] restored from {d} step "
+                        f"{ckpt.latest_step()} (pipeline layout → "
+                        f"monolithic)")
+                    break
+                state = fresh_state()
+                if ckpt.has_state_key("ema_params"):
+                    # serve the averaged copy — the weights eval scored
+                    # and the deployment artifact (README: params EMA)
+                    state = state.replace(
+                        ema_params=jax.tree_util.tree_map(
+                            jnp.array, state.params))
+                    state, _ = ckpt.restore(state)
+                    state = state.replace(params=state.ema_params)
+                    log(f"[{tag}] restored from {d} step "
+                        f"{ckpt.latest_step()} (EMA weights)")
+                else:
+                    state, _ = ckpt.restore(state)
+                    log(f"[{tag}] restored from {d} step "
+                        f"{ckpt.latest_step()}")
+                break
+    else:
+        state = fresh_state()
+        log(f"[{tag}] WARNING: no checkpoint found, using random init")
+    return model, state
+
+
+def restore_pipelined(cfg, model, ckpt, x):
+    """Restore a pipeline-trained checkpoint (params = {stem, stages})
+    and build the monolithic serving state from the converted layout.
+    Serves the EMA copy when the run trained with one."""
+    import jax
+
+    from deep_vision_tpu.core.optim import build_optimizer
+    from deep_vision_tpu.core.state import TrainState
+    from deep_vision_tpu.parallel import make_mesh
+    from deep_vision_tpu.parallel.pipelined import PipelinedModel
+
+    try:
+        pm = PipelinedModel.for_model(
+            model, make_mesh({"data": 1, "pipe": 1},
+                             devices=jax.devices()[:1]))
+    except TypeError as e:
+        raise SystemExit(
+            f"checkpoint stores a pipeline layout but config "
+            f"'{cfg.name}' builds no pipelined family: {e}") from e
+    pv = jax.jit(functools.partial(pm.init, train=False))(
+        {"params": jax.random.PRNGKey(0)}, x)
+    has_ema = ckpt.has_state_key("ema_params")
+    pstate = TrainState.create(
+        apply_fn=pm.apply, params=pv["params"],
+        tx=build_optimizer(cfg.optimizer),
+        batch_stats=pv.get("batch_stats", {}), ema=has_ema)
+    pstate, _ = ckpt.restore(pstate)
+    params = pstate.ema_params if has_ema else pstate.params
+    merged = pm.export_monolithic_variables(params, pstate.batch_stats)
+    return TrainState.create(
+        apply_fn=model.apply, params=merged["params"],
+        tx=build_optimizer(cfg.optimizer),
+        batch_stats=merged.get("batch_stats", {}))

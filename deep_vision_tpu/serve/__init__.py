@@ -1,0 +1,18 @@
+"""In-process serving subsystem: dynamic micro-batching with deadlines,
+load shedding, and latency metrics over the training stack's restore path.
+
+    registry.py   checkpoint / StableHLO blob → ServingModel
+    engine.py     background-thread dynamic batcher, bucketed jit cache
+    admission.py  deadline-aware load shedding + queue-depth bound
+    http.py       stdlib HTTP front-end (/v1/classify, /v1/detect, ...)
+
+Entry point: ``python -m deep_vision_tpu.cli.serve``; load generator:
+``python bench.py --serve``; architecture notes: docs/SERVING.md.
+"""
+
+from deep_vision_tpu.serve.admission import AdmissionController, Shed
+from deep_vision_tpu.serve.engine import BatchingEngine
+from deep_vision_tpu.serve.registry import ModelRegistry, ServingModel
+
+__all__ = ["AdmissionController", "BatchingEngine", "ModelRegistry",
+           "ServingModel", "Shed"]

@@ -1,0 +1,99 @@
+"""Admission control: shed doomed work at the door, not after the queue.
+
+Two bounds, both checked at submit time (and deadlines re-checked at
+batch-formation time, so a request that expired while queued is dropped
+rather than executed late):
+
+  * queue depth — beyond ``max_queue`` the engine is over capacity and
+    every additional request only adds latency for everyone; reject
+    immediately so the client can retry against another replica.
+  * deadline feasibility — if ``now + estimated_service_time`` already
+    exceeds the request's deadline, executing it wastes a batch slot on
+    an answer nobody will read.  The estimate is the batcher's drain
+    window plus an EWMA of recent batch execution time (pessimistic
+    before any batch has run: only already-expired deadlines are shed).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+
+@dataclasses.dataclass
+class Shed:
+    """Result delivered to a request the engine refused to execute."""
+
+    reason: str          # "queue_full" | "deadline" | "shutdown"
+    detail: str = ""
+
+    def __bool__(self):  # `if result:` reads as "was served"
+        return False
+
+
+class AdmissionController:
+    def __init__(self, max_queue: int = 256, max_wait_ms: float = 5.0,
+                 ewma_alpha: float = 0.2):
+        self.max_queue = max_queue
+        self._max_wait_s = max_wait_ms / 1e3
+        self._alpha = ewma_alpha
+        self._exec_ewma_s: float | None = None
+        self._lock = threading.Lock()
+        self.shed_queue_full = 0
+        self.shed_deadline = 0
+
+    def observe_exec(self, seconds: float):
+        """Feed one batch's wall-clock execution time into the EWMA."""
+        with self._lock:
+            if self._exec_ewma_s is None:
+                self._exec_ewma_s = seconds
+            else:
+                self._exec_ewma_s += self._alpha * (seconds -
+                                                    self._exec_ewma_s)
+
+    def estimated_service_s(self) -> float:
+        """Worst-case time-to-result for a request admitted right now:
+        a full drain window plus one batch execution."""
+        with self._lock:
+            return self._max_wait_s + (self._exec_ewma_s or 0.0)
+
+    def admit(self, queue_depth: int, deadline: float | None,
+              now: float | None = None) -> Shed | None:
+        """None = admitted; a ``Shed`` = rejected (reason inside)."""
+        if queue_depth >= self.max_queue:
+            with self._lock:
+                self.shed_queue_full += 1
+            return Shed("queue_full",
+                        f"queue depth {queue_depth} >= {self.max_queue}")
+        if deadline is not None:
+            now = time.monotonic() if now is None else now
+            est = self.estimated_service_s()
+            if now + est > deadline:
+                with self._lock:
+                    self.shed_deadline += 1
+                return Shed("deadline",
+                            f"needs ~{est * 1e3:.1f}ms, "
+                            f"deadline in {(deadline - now) * 1e3:.1f}ms")
+        return None
+
+    def expired(self, deadline: float | None,
+                now: float | None = None) -> Shed | None:
+        """Batch-formation-time re-check: queued past its deadline?"""
+        if deadline is None:
+            return None
+        now = time.monotonic() if now is None else now
+        if now > deadline:
+            with self._lock:
+                self.shed_deadline += 1
+            return Shed("deadline",
+                        f"expired {(now - deadline) * 1e3:.1f}ms ago in "
+                        f"queue")
+        return None
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"shed_queue_full": self.shed_queue_full,
+                    "shed_deadline": self.shed_deadline,
+                    "exec_ewma_ms": (self._exec_ewma_s or 0.0) * 1e3,
+                    "max_queue": self.max_queue}

@@ -1,0 +1,234 @@
+"""Background-thread dynamic micro-batcher.
+
+The training stack amortizes XLA dispatch over ``lax.scan`` steps; the
+serving stack amortizes it over dynamically-formed batches.  Requests
+enqueue with an optional deadline; the batcher thread drains the queue up
+to ``max_batch`` or ``max_wait_ms`` (whichever comes first), pads the
+batch to a small set of power-of-two buckets so every served shape hits
+an already-compiled program (the bucket dict IS the jit cache — a miss is
+an explicit, counted compile, never a surprise mid-request trace),
+executes, and scatters the output rows back to per-request futures.
+
+Deadline handling is two-phase: admission (``admission.py``) sheds
+requests that cannot possibly make their deadline at submit time, and the
+batcher re-checks at batch-formation time so a request that expired while
+queued is dropped rather than executed late.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from deep_vision_tpu.core.metrics import LatencyHistogram, ThroughputMeter
+from deep_vision_tpu.serve.admission import AdmissionController, Shed
+
+
+def power_of_two_buckets(max_batch: int) -> list[int]:
+    """1, 2, 4, ... plus ``max_batch`` itself when it isn't a power of 2."""
+    buckets, b = [], 1
+    while b < max_batch:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_batch)
+    return buckets
+
+
+class _Request:
+    __slots__ = ("image", "deadline", "enqueued_at", "future")
+
+    def __init__(self, image, deadline, enqueued_at, future):
+        self.image = image
+        self.deadline = deadline
+        self.enqueued_at = enqueued_at
+        self.future = future
+
+
+class BatchingEngine:
+    """Dynamic batcher for one ServingModel.
+
+    Use as a context manager or call ``start()``/``stop()``.  ``submit``
+    returns a ``concurrent.futures.Future`` resolving to either the
+    output pytree row for that image or a ``Shed``; ``infer`` is the
+    blocking convenience wrapper.
+    """
+
+    def __init__(self, model, *, max_batch: int = 32,
+                 max_wait_ms: float = 5.0, buckets: list[int] | None = None,
+                 admission: AdmissionController | None = None):
+        self.model = model
+        if model.fixed_batch is not None:
+            # a StableHLO blob serves exactly its traced shape
+            buckets = [model.fixed_batch]
+        self.buckets = sorted(buckets) if buckets else \
+            power_of_two_buckets(max_batch)
+        self.max_batch = self.buckets[-1]
+        self.max_wait_s = max_wait_ms / 1e3
+        self.admission = admission or AdmissionController(
+            max_wait_ms=max_wait_ms)
+        self.latency = LatencyHistogram()
+        self.throughput = ThroughputMeter(warmup_steps=1)
+        self._queue: queue.Queue[_Request] = queue.Queue()
+        self._executables: dict = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.submitted = 0
+        self.served = 0
+        self.batches = 0
+        self.compiles = 0
+        self.padded_images = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "BatchingEngine":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, name=f"batcher-{self.model.name}",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        # anything still queued will never run — tell its caller
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.future.set_result(Shed("shutdown", "engine stopped"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def warmup(self, buckets: list[int] | None = None):
+        """Compile ahead of traffic (persisted via core/compile_cache)."""
+        import jax
+
+        for b in (buckets or self.buckets):
+            jax.block_until_ready(self._compiled(b)(np.zeros(
+                (b, *self.model.input_shape), np.float32)))
+
+    # -- request path ------------------------------------------------------
+
+    def submit(self, image, deadline_ms: float | None = None) -> Future:
+        now = time.monotonic()
+        deadline = now + deadline_ms / 1e3 if deadline_ms is not None \
+            else None
+        with self._lock:
+            self.submitted += 1
+        fut: Future = Future()
+        shed = self.admission.admit(self._queue.qsize(), deadline, now)
+        if shed is not None:
+            fut.set_result(shed)
+            return fut
+        self._queue.put(_Request(np.asarray(image, np.float32), deadline,
+                                 now, fut))
+        return fut
+
+    def infer(self, image, deadline_ms: float | None = None,
+              timeout: float | None = 30.0):
+        return self.submit(image, deadline_ms).result(timeout)
+
+    # -- batcher thread ----------------------------------------------------
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            batch = [first]
+            drain_until = time.monotonic() + self.max_wait_s
+            while len(batch) < self.max_batch:
+                remaining = drain_until - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    batch.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            try:
+                self._run_batch(batch)
+            except Exception as e:  # deliver, don't kill the batcher
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        return self.buckets[-1]
+
+    def _compiled(self, bucket: int):
+        fn = self._executables.get(bucket)
+        if fn is None:
+            fn = self.model.compile_bucket(bucket)
+            self._executables[bucket] = fn
+            with self._lock:
+                self.compiles += 1
+        return fn
+
+    def _run_batch(self, batch: list[_Request]):
+        import jax
+
+        live = []
+        for req in batch:
+            expired = self.admission.expired(req.deadline)
+            if expired is not None:
+                req.future.set_result(expired)
+            else:
+                live.append(req)
+        if not live:
+            return
+        n = len(live)
+        bucket = self._bucket_for(n)
+        padded = np.zeros((bucket, *self.model.input_shape), np.float32)
+        for i, req in enumerate(live):
+            padded[i] = req.image
+        fn = self._compiled(bucket)
+        t0 = time.monotonic()
+        out = jax.block_until_ready(fn(padded))
+        self.admission.observe_exec(time.monotonic() - t0)
+        now = time.monotonic()
+        with self._lock:
+            self.batches += 1
+            self.served += n
+            self.padded_images += bucket - n
+        self.throughput.update(n)
+        for i, req in enumerate(live):
+            self.latency.record(now - req.enqueued_at)
+            req.future.set_result(
+                jax.tree_util.tree_map(lambda a: a[i], out))
+
+    # -- observability -----------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = {"model": self.model.name,
+                   "submitted": self.submitted,
+                   "served": self.served,
+                   "batches": self.batches,
+                   "compiles": self.compiles,
+                   "padded_images": self.padded_images,
+                   "queue_depth": self._queue.qsize(),
+                   "buckets": list(self.buckets),
+                   "compiled_buckets": sorted(self._executables),
+                   "max_wait_ms": self.max_wait_s * 1e3}
+        out["latency"] = self.latency.percentiles()
+        out["img_per_sec"] = self.throughput.images_per_sec
+        out["admission"] = self.admission.stats()
+        return out

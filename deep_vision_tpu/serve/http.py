@@ -1,0 +1,219 @@
+"""Stdlib HTTP front-end for the batching engine — zero new dependencies.
+
+Routes (JSON in, JSON out):
+
+    GET  /v1/healthz   liveness + served model names
+    GET  /v1/stats     per-model engine stats (latency p50/p95/p99,
+                       throughput, shed counts, compile/bucket state)
+    POST /v1/classify  {"pixels": [[...]] | "image_b64": "...",
+                        "model"?, "deadline_ms"?, "top_k"?}
+    POST /v1/detect    same inputs + "score_threshold"?; YOLO models
+
+Image payloads: ``pixels`` is a preprocessed (H, W, C) float array (the
+machine-to-machine path, and what the tests/smoke use); ``image_b64`` is
+a base64-encoded image file decoded + preprocessed server-side exactly
+like ``cli.infer`` (requires PIL).  Shed requests answer 429 with the
+shed reason so clients can retry against another replica.
+"""
+
+from __future__ import annotations
+
+import base64
+import io
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class ServeError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+
+
+def _decode_pixels(body: dict, model):
+    """Body → one (H, W, C) float32 image in the model's input layout."""
+    import numpy as np
+
+    if "pixels" in body:
+        x = np.asarray(body["pixels"], np.float32)
+        if x.ndim == 2 and model.input_shape[-1] == 1:
+            x = x[..., None]
+        if x.shape != model.input_shape:
+            raise ServeError(
+                400, f"pixels shape {list(x.shape)} != model input "
+                     f"{list(model.input_shape)}")
+        return x
+    if "image_b64" in body:
+        try:
+            from PIL import Image
+        except ImportError as e:
+            raise ServeError(501, "image_b64 needs PIL on the server; "
+                                  "send preprocessed 'pixels'") from e
+        raw = base64.b64decode(body["image_b64"])
+        size = model.input_shape[0]
+        img = Image.open(io.BytesIO(raw))
+        if model.input_shape[-1] == 1:
+            # grayscale models (LeNet): MNIST-style preprocessing
+            from deep_vision_tpu.data.mnist import preprocess
+
+            arr = np.asarray(img.convert("L").resize((size - 4, size - 4)))
+            return preprocess(arr[None])[0][:size, :size]
+        arr = np.asarray(img.convert("RGB"))
+        if model.task == "classification":
+            from deep_vision_tpu.data.transforms import (
+                eval_transform,
+                imagenet_resize_for,
+            )
+
+            return eval_transform(arr, size, imagenet_resize_for(size))
+        # detection/pose: [0,1] inputs, not imagenet-normalized
+        from deep_vision_tpu.data.detection import resize_square
+
+        return resize_square(arr, size).astype(np.float32) / 255.0
+    raise ServeError(400, "body needs 'pixels' or 'image_b64'")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ----------------------------------------------------------
+
+    def log_message(self, fmt, *args):  # route access logs off stderr spam
+        if self.server.verbose:  # type: ignore[attr-defined]
+            super().log_message(fmt, *args)
+
+    def _reply(self, status: int, payload: dict):
+        blob = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def _body(self) -> dict:
+        length = int(self.headers.get("Content-Length") or 0)
+        if length <= 0:
+            raise ServeError(400, "empty body")
+        try:
+            return json.loads(self.rfile.read(length))
+        except json.JSONDecodeError as e:
+            raise ServeError(400, f"bad JSON: {e}") from e
+
+    def _engine(self, body: dict):
+        try:
+            model = self.server.registry.get(body.get("model"))
+        except KeyError as e:
+            raise ServeError(404, str(e)) from e
+        return model, self.server.engines[model.name]
+
+    def _infer_row(self, body: dict):
+        """Shared classify/detect request path: decode → engine → row."""
+        model, engine = self._engine(body)
+        x = _decode_pixels(body, model)
+        result = engine.infer(x, deadline_ms=body.get("deadline_ms"))
+        from deep_vision_tpu.serve.admission import Shed
+
+        if isinstance(result, Shed):
+            raise ServeError(429, f"shed: {result.reason} {result.detail}")
+        return model, result
+
+    # -- routes ------------------------------------------------------------
+
+    def do_GET(self):
+        if self.path == "/v1/healthz":
+            self._reply(200, {"status": "ok",
+                              "models": self.server.registry.names()})
+        elif self.path == "/v1/stats":
+            self._reply(200, {name: eng.stats()
+                              for name, eng in self.server.engines.items()})
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        try:
+            body = self._body()
+            if self.path == "/v1/classify":
+                self._reply(200, self._classify(body))
+            elif self.path == "/v1/detect":
+                self._reply(200, self._detect(body))
+            else:
+                self._reply(404, {"error": f"no route {self.path}"})
+        except ServeError as e:
+            self._reply(e.status, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — surface, don't kill worker
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _classify(self, body: dict) -> dict:
+        import numpy as np
+
+        model, row = self._infer_row(body)
+        if model.task != "classification":
+            raise ServeError(400, f"'{model.name}' is a {model.task} "
+                                  f"model; use /v1/detect")
+        logits = np.asarray(row)
+        k = min(int(body.get("top_k", 5)), logits.shape[-1])
+        top = np.argsort(logits)[-k:][::-1]
+        z = np.exp(logits - logits.max())
+        probs = z / z.sum()
+        return {"model": model.name,
+                "top": [{"class": int(c), "prob": float(probs[c]),
+                         "logit": float(logits[c])} for c in top]}
+
+    def _detect(self, body: dict) -> dict:
+        import jax
+        import numpy as np
+
+        model, row = self._infer_row(body)
+        if model.task != "detection":
+            raise ServeError(400, f"'{model.name}' is a {model.task} "
+                                  f"model; use /v1/classify")
+        from deep_vision_tpu.tasks.detection import postprocess
+
+        # row is the per-scale head outputs for one image; postprocess
+        # (ops/boxes.py batched NMS) wants a batch dim back
+        outs = jax.tree_util.tree_map(lambda a: a[None], row)
+        boxes, scores, classes, valid = postprocess(
+            outs, model.num_classes,
+            score_threshold=float(body.get("score_threshold", 0.3)))
+        n = int(np.asarray(valid[0]).sum())
+        return {"model": model.name, "detections": [
+            {"box": np.asarray(boxes[0, j]).round(4).tolist(),
+             "score": float(scores[0, j]),
+             "class": int(classes[0, j])} for j in range(n)]}
+
+
+class ServeServer:
+    """ThreadingHTTPServer wired to a registry + one engine per model."""
+
+    def __init__(self, registry, engines: dict, host: str = "127.0.0.1",
+                 port: int = 0, verbose: bool = False):
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.registry = registry
+        self.httpd.engines = engines
+        self.httpd.verbose = verbose
+        self._thread: threading.Thread | None = None
+
+    @property
+    def host(self) -> str:
+        return self.httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def serve_forever(self):
+        self.httpd.serve_forever()
+
+    def start_background(self) -> "ServeServer":
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        name="serve-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None

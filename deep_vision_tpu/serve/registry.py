@@ -1,0 +1,160 @@
+"""Model registry: name → ServingModel, loadable from two artifact kinds.
+
+  * a training workdir — the shared restore path (``core/restore.py``:
+    best-checkpoint preference, pipeline→monolithic conversion, EMA
+    params), then per-bucket AOT compiles of ``model.apply``;
+  * a StableHLO blob (``core/export.load_exported``) — Python-model-free
+    serving of the export CLI's artifact, pinned to the batch shape it
+    was traced at.
+
+Both present the same surface to the engine: ``compile_bucket(b)`` hands
+back a callable for a padded batch of exactly ``b`` images, so the
+batcher owns WHEN to compile (and counts it) while the model owns HOW.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+class ServingModel:
+    """One deployable model: metadata + per-bucket compiled forwards."""
+
+    def __init__(self, name: str, *, task: str, input_shape: tuple,
+                 num_classes: int, config_name: str | None = None,
+                 fixed_batch: int | None = None):
+        self.name = name
+        self.task = task
+        self.input_shape = tuple(input_shape)  # (H, W, C), batch excluded
+        self.num_classes = num_classes
+        self.config_name = config_name or name
+        # StableHLO blobs are traced at one batch shape; checkpoint-backed
+        # models compile any bucket (None = unconstrained)
+        self.fixed_batch = fixed_batch
+
+    def compile_bucket(self, batch: int):
+        raise NotImplementedError
+
+    def describe(self) -> dict:
+        return {"name": self.name, "task": self.task,
+                "input_shape": list(self.input_shape),
+                "num_classes": self.num_classes,
+                "fixed_batch": self.fixed_batch}
+
+
+class CheckpointServingModel(ServingModel):
+    """Workdir-checkpoint-backed: AOT-compile apply() per batch bucket."""
+
+    def __init__(self, name: str, cfg, model, state):
+        super().__init__(
+            name, task=cfg.task,
+            input_shape=(cfg.image_size, cfg.image_size, cfg.channels),
+            num_classes=cfg.num_classes, config_name=cfg.name)
+        self.cfg = cfg
+        self._model = model
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        self._variables = variables
+
+    def compile_bucket(self, batch: int):
+        import jax
+        import jax.numpy as jnp
+
+        def apply(variables, x):
+            return self._model.apply(variables, x, train=False)
+
+        x_spec = jax.ShapeDtypeStruct((batch, *self.input_shape),
+                                      jnp.float32)
+        v_spec = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            self._variables)
+        # AOT lower+compile: the engine's bucket dict is the jit cache,
+        # so a served shape can never hit a surprise trace mid-request
+        compiled = jax.jit(apply).lower(v_spec, x_spec).compile()
+        return functools.partial(compiled, self._variables)
+
+
+class ExportedServingModel(ServingModel):
+    """StableHLO-blob-backed (core/export): fixed batch, no Python model."""
+
+    def __init__(self, name: str, cfg, call, variables, fixed_batch: int):
+        super().__init__(
+            name, task=cfg.task,
+            input_shape=(cfg.image_size, cfg.image_size, cfg.channels),
+            num_classes=cfg.num_classes, config_name=cfg.name,
+            fixed_batch=fixed_batch)
+        self.cfg = cfg
+        self._call = call
+        self._variables = variables
+
+    def compile_bucket(self, batch: int):
+        if batch != self.fixed_batch:
+            raise ValueError(
+                f"StableHLO blob for '{self.name}' was exported at batch "
+                f"{self.fixed_batch}; bucket {batch} unavailable — "
+                f"re-export or serve from the checkpoint")
+        return functools.partial(self._call, self._variables)
+
+
+class ModelRegistry:
+    def __init__(self):
+        self._models: dict[str, ServingModel] = {}
+
+    def add(self, model: ServingModel) -> ServingModel:
+        self._models[model.name] = model
+        return model
+
+    def load_checkpoint(self, config_name: str, workdir: str,
+                        name: str | None = None) -> ServingModel:
+        from deep_vision_tpu.core.config import get_config
+        from deep_vision_tpu.core.restore import load_state
+
+        cfg = get_config(config_name)
+        model, state = load_state(cfg, workdir, tag="serve")
+        return self.add(CheckpointServingModel(
+            name or config_name, cfg, model, state))
+
+    def load_exported(self, config_name: str, blob_path: str, workdir: str,
+                      name: str | None = None) -> ServingModel:
+        """Serve a ``cli.infer export`` artifact.
+
+        The blob's inputs are (variables, x) — the same variables pytree
+        the exporting process restored — so the companion workdir supplies
+        them through the identical restore path.
+        """
+        from deep_vision_tpu.core.config import get_config
+        from deep_vision_tpu.core.export import load_exported
+        from deep_vision_tpu.core.restore import load_state
+
+        cfg = get_config(config_name)
+        _, state = load_state(cfg, workdir, tag="serve")
+        variables = {"params": state.params}
+        if state.batch_stats:
+            variables["batch_stats"] = state.batch_stats
+        call = load_exported(blob_path)
+        # the image input is the final positional arg, hence the last
+        # flattened aval (variables dict leaves sort first)
+        fixed_batch = int(call.in_avals[-1].shape[0])
+        return self.add(ExportedServingModel(
+            name or config_name, cfg, call, variables, fixed_batch))
+
+    def get(self, name: str | None = None) -> ServingModel:
+        if name is None:
+            if len(self._models) != 1:
+                raise KeyError(
+                    f"model name required (serving {sorted(self._models)})")
+            return next(iter(self._models.values()))
+        if name not in self._models:
+            raise KeyError(f"unknown model '{name}'; "
+                           f"serving {sorted(self._models)}")
+        return self._models[name]
+
+    def names(self) -> list[str]:
+        return sorted(self._models)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._models
+
+    def __len__(self) -> int:
+        return len(self._models)

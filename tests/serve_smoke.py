@@ -1,0 +1,52 @@
+"""`make serve-smoke`: boot the real HTTP server wiring on a random port
+against a LeNet/MNIST workdir fixture, issue one /v1/classify request,
+assert a 200.  Exercises exactly the `python -m deep_vision_tpu.cli.serve`
+path (cli.serve.build_server), just without serve_forever in the
+foreground — run directly, not under pytest."""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+import numpy as np
+
+# plain script (not pytest): make the repo root importable when invoked
+# as `python tests/serve_smoke.py` from the checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    from deep_vision_tpu.cli.serve import build_server
+
+    with tempfile.TemporaryDirectory() as workdir:
+        # empty LeNet workdir fixture: restore falls back to random init,
+        # which is the documented no-checkpoint smoke path
+        args = argparse.Namespace(
+            model="lenet5", workdir=workdir, stablehlo=None,
+            host="127.0.0.1", port=0, max_batch=4, max_wait_ms=2.0,
+            buckets=None, max_queue=64, warmup=False, verbose=False)
+        engine, server = build_server(args)
+        server.start_background()
+        try:
+            body = json.dumps(
+                {"pixels": np.zeros((32, 32, 1)).tolist()}).encode()
+            req = urllib.request.Request(
+                f"http://{server.host}:{server.port}/v1/classify",
+                data=body, headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status == 200, f"expected 200, got {r.status}"
+                top = json.loads(r.read())["top"]
+                assert len(top) == 5, top
+            print(f"serve-smoke PASS: 200 from port {server.port}, "
+                  f"top-1 class {top[0]['class']}")
+        finally:
+            server.shutdown()
+            engine.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

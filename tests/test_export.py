@@ -6,6 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deep_vision_tpu.core.export import export_forward, load_exported
+from deep_vision_tpu.models.common import ConvBN
 from deep_vision_tpu.models.lenet import LeNet5
 
 
@@ -18,6 +19,30 @@ def test_export_roundtrip(tmp_path):
     assert n > 1000
     fn = load_exported(path)
     xin = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 1))
+    out = fn(variables, xin)
+    ref = model.apply(variables, xin, train=False)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_export_batch_stats_roundtrip(tmp_path):
+    """A model with a second variables collection (batch_stats) must
+    survive serialize→deserialize with the pytree structure — collection
+    and key ordering — intact, and numerics matching: the loader passes
+    ``(variables, x)`` positionally, so any silent reordering of the
+    flattened inputs would bind running means to conv kernels."""
+    model = ConvBN(features=4)
+    x = jnp.zeros((2, 8, 8, 3))
+    variables = model.init({"params": jax.random.PRNGKey(0)}, x, train=False)
+    assert set(variables) == {"params", "batch_stats"}
+    path = str(tmp_path / "convbn.stablehlo")
+    export_forward(model, variables, (2, 8, 8, 3), path)
+    fn = load_exported(path)
+    # the exported input treedef is ((variables, x), {}) — exactly the
+    # call signature, so the variables pytree round-tripped
+    expected = jax.tree_util.tree_structure(
+        ((variables, jnp.zeros((2, 8, 8, 3))), {}))
+    assert fn.in_tree == expected
+    xin = jax.random.normal(jax.random.PRNGKey(1), (2, 8, 8, 3))
     out = fn(variables, xin)
     ref = model.apply(variables, xin, train=False)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)

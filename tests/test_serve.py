@@ -1,0 +1,211 @@
+"""Serving-engine contract (CPU, tier-1 fast): dynamic batching is
+numerically invisible, bucket padding compiles once per bucket, and
+doomed requests are shed — never executed.
+
+Uses LeNet at random init (the restore path's no-checkpoint fallback):
+serving correctness is about request plumbing, not learned weights."""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.core.metrics import LatencyHistogram
+from deep_vision_tpu.serve.admission import AdmissionController, Shed
+from deep_vision_tpu.serve.engine import BatchingEngine, power_of_two_buckets
+from deep_vision_tpu.serve.registry import ModelRegistry
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def lenet_serving(tmp_path_factory):
+    reg = ModelRegistry()
+    # empty workdir fixture → deterministic PRNGKey(0) random init
+    sm = reg.load_checkpoint(
+        "lenet5", str(tmp_path_factory.mktemp("lenet_workdir")))
+    return reg, sm
+
+
+def _images(n, shape=(32, 32, 1)):
+    return [np.random.RandomState(i).randn(*shape).astype(np.float32)
+            for i in range(n)]
+
+
+def test_batching_invariance(lenet_serving):
+    """N concurrent single requests == one N-batch call, bit-identical."""
+    _, sm = lenet_serving
+    imgs = _images(8)
+    with BatchingEngine(sm, buckets=[8], max_wait_ms=250) as eng:
+        futures = [eng.submit(im) for im in imgs]
+        rows = [np.asarray(f.result(60)) for f in futures]
+        assert eng.batches == 1  # all 8 coalesced into one execution
+    ref = np.asarray(sm.compile_bucket(8)(np.stack(imgs)))
+    for i in range(8):
+        assert np.array_equal(rows[i], ref[i])
+
+
+def test_bucket_padding_compiles_once(lenet_serving):
+    """Waves of 3 and 5 both pad to the 8-bucket: one compile total."""
+    _, sm = lenet_serving
+    imgs = _images(8)
+    with BatchingEngine(sm, buckets=[8], max_wait_ms=100) as eng:
+        for f in [eng.submit(im) for im in imgs[:3]]:
+            assert f.result(60) is not None
+        assert eng.compiles == 1
+        for f in [eng.submit(im) for im in imgs[:5]]:
+            assert f.result(60) is not None
+        assert eng.compiles == 1  # second wave hit the compiled bucket
+        assert eng.batches == 2
+        assert eng.served == 8
+        assert eng.padded_images == (8 - 3) + (8 - 5)
+
+
+def test_expired_deadline_is_shed_not_executed(lenet_serving):
+    _, sm = lenet_serving
+    img = _images(1)[0]
+    with BatchingEngine(sm, buckets=[4], max_wait_ms=5) as eng:
+        assert eng.infer(img) is not None  # prime EWMA + compile
+        served = eng.served
+        result = eng.infer(img, deadline_ms=0.0)
+        assert isinstance(result, Shed)
+        assert result.reason == "deadline"
+        assert not result  # Shed is falsy: `if result:` reads as served
+        assert eng.served == served  # never executed
+        assert eng.admission.stats()["shed_deadline"] == 1
+
+
+def test_queue_full_is_shed(lenet_serving):
+    _, sm = lenet_serving
+    img = _images(1)[0]
+    eng = BatchingEngine(sm, buckets=[1],
+                         admission=AdmissionController(max_queue=1))
+    # engine not started: the first request parks in the queue, the
+    # second exceeds max_queue and must shed immediately
+    first = eng.submit(img)
+    second = eng.submit(img).result(1)
+    assert isinstance(second, Shed) and second.reason == "queue_full"
+    eng.stop()  # drains the queue: parked request sheds as shutdown
+    assert first.result(1).reason == "shutdown"
+
+
+def test_power_of_two_buckets():
+    assert power_of_two_buckets(8) == [1, 2, 4, 8]
+    assert power_of_two_buckets(24) == [1, 2, 4, 8, 16, 24]
+    assert power_of_two_buckets(1) == [1]
+
+
+def test_latency_histogram_quantiles_and_merge():
+    h = LatencyHistogram()
+    for ms in range(1, 101):  # 1..100 ms, uniform
+        h.record(ms / 1e3)
+    p = h.percentiles()
+    assert p["count"] == 100
+    # log-spaced bins: quantiles are bin midpoints, ~12% relative error
+    assert 40 <= p["p50_ms"] <= 62
+    assert 83 <= p["p95_ms"] <= 110
+    assert 86 <= p["p99_ms"] <= 115
+    # mergeable: two half-histograms sum to the full one
+    a, b = LatencyHistogram(), LatencyHistogram()
+    for ms in range(1, 51):
+        a.record(ms / 1e3)
+    for ms in range(51, 101):
+        b.record(ms / 1e3)
+    a.merge(b.state_dict())
+    assert a.total == 100
+    merged = a.percentiles()
+    assert merged == pytest.approx(p)  # mean differs only by fp sum order
+    with pytest.raises(ValueError):
+        a.merge(LatencyHistogram(bins_per_decade=5).state_dict())
+
+
+def test_http_roundtrip(lenet_serving):
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = lenet_serving
+    eng = BatchingEngine(sm, buckets=[4], max_wait_ms=2).start()
+    srv = ServeServer(reg, {sm.name: eng}, port=0).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(base + "/v1/healthz") as r:
+            assert r.status == 200
+            assert json.loads(r.read())["models"] == ["lenet5"]
+        body = json.dumps(
+            {"pixels": np.zeros((32, 32, 1)).tolist()}).encode()
+        req = urllib.request.Request(
+            base + "/v1/classify", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 200
+            top = json.loads(r.read())["top"]
+            assert len(top) == 5
+        # expired deadline surfaces as 429, not a late answer
+        body = json.dumps({"pixels": np.zeros((32, 32, 1)).tolist(),
+                           "deadline_ms": 0}).encode()
+        req = urllib.request.Request(
+            base + "/v1/classify", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 429
+        with urllib.request.urlopen(base + "/v1/stats") as r:
+            stats = json.loads(r.read())["lenet5"]
+            assert stats["served"] >= 1
+            assert stats["latency"]["count"] >= 1
+            assert stats["admission"]["shed_deadline"] >= 1
+    finally:
+        srv.shutdown()
+        eng.stop()
+
+
+def test_exported_blob_serving(lenet_serving, tmp_path):
+    """StableHLO path: registry loads a cli.infer-export artifact and the
+    engine serves it at the blob's fixed batch, matching direct apply."""
+    import jax
+
+    from deep_vision_tpu.core.export import export_forward
+
+    reg, sm = lenet_serving
+    variables = sm._variables
+    path = str(tmp_path / "lenet.stablehlo")
+    export_forward(sm._model, variables, (4, 32, 32, 1), path)
+    sm2 = reg.load_exported("lenet5", path, str(tmp_path / "no_ckpt"),
+                            name="lenet5_hlo")
+    assert sm2.fixed_batch == 4
+    imgs = _images(4)
+    with BatchingEngine(sm2, max_wait_ms=100) as eng:
+        assert eng.buckets == [4]
+        rows = [np.asarray(f.result(60))
+                for f in [eng.submit(im) for im in imgs]]
+    ref = np.asarray(sm._model.apply(variables, jax.numpy.asarray(
+        np.stack(imgs)), train=False))
+    np.testing.assert_allclose(np.stack(rows), ref, atol=1e-5)
+
+
+def test_concurrent_submitters_all_answered(lenet_serving):
+    """Many client threads, small buckets: every request gets exactly one
+    result and none are lost across batch boundaries."""
+    _, sm = lenet_serving
+    imgs = _images(4)
+    results = []
+    lock = threading.Lock()
+    with BatchingEngine(sm, buckets=[1, 2, 4], max_wait_ms=5) as eng:
+        def client(k):
+            row = eng.infer(imgs[k % 4], timeout=60)
+            with lock:
+                results.append(row)
+
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(12)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        stats = eng.stats()
+    assert len(results) == 12
+    assert all(r is not None and not isinstance(r, Shed) for r in results)
+    assert stats["served"] == 12
+    assert stats["latency"]["count"] == 12
