@@ -16,7 +16,9 @@ test-all:
 	$(PY) -m pytest tests/ -q
 
 # boot the HTTP serving stack on a random port against a LeNet fixture,
-# issue one request, assert a 200 (the cli.serve wiring, end to end)
+# issue one request, assert a 200 — once synchronous (pipeline_depth=1)
+# and once pipelined (depth=2), checking one bulk D2H per batch
+# (the cli.serve wiring, end to end)
 serve-smoke:
 	$(PY) tests/serve_smoke.py
 
@@ -25,6 +27,10 @@ serve_%:
 
 bench-serve:
 	$(PY) bench.py --serve
+
+# the synchronous comparison run: same loads, in-flight window of 1
+bench-serve-sync:
+	$(PY) bench.py --serve --serve-pipeline-depth 1
 
 bench:
 	$(PY) bench.py
@@ -54,4 +60,4 @@ eval_%:
 list:
 	$(PY) -m deep_vision_tpu.cli.train --list -m x
 
-.PHONY: test test-all bench bench-serve serve-smoke list
+.PHONY: test test-all bench bench-serve bench-serve-sync serve-smoke list

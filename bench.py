@@ -514,7 +514,7 @@ def bench_infer(name: str = "resnet50", steps: int | None = None,
 
 def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                 duration_s: float = 2.0, max_batch: int = 8,
-                max_wait_ms: float = 2.0) -> dict:
+                max_wait_ms: float = 2.0, pipeline_depth: int = 2) -> dict:
     """Closed-loop load generator against the dynamic-batching engine
     (``deep_vision_tpu/serve``): C client threads each submit one image,
     wait for the answer, repeat — so C is the offered load (concurrency),
@@ -522,7 +522,11 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
     device batches.  One JSON line reports p50/p95/p99 request latency
     and sustained img/s at every load point — the knee where latency
     rises faster than throughput is the max_wait/bucket tuning signal
-    (docs/SERVING.md).
+    (docs/SERVING.md) — plus the pipelined executor's overlap block
+    (device-idle fraction, in-flight high-water mark, staged-buffer
+    reuse, bulk D2H bytes) so serving regressions are trackable the way
+    BENCH_r0*.json tracks training.  ``--serve-pipeline-depth 1`` is the
+    synchronous comparison run.
     """
     import sys
     import tempfile
@@ -543,8 +547,8 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
     sm = CheckpointServingModel(model_name, cfg, model, state)
     img = np.random.RandomState(0).randn(*sm.input_shape).astype(np.float32)
     points = []
-    with BatchingEngine(sm, max_batch=max_batch,
-                        max_wait_ms=max_wait_ms) as engine:
+    with BatchingEngine(sm, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                        pipeline_depth=pipeline_depth) as engine:
         engine.warmup()  # compiles excluded from every load point
         for clients in loads:
             latencies: list = []
@@ -576,14 +580,27 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                 "p95_ms": round(float(np.percentile(lat_ms, 95)), 2),
                 "p99_ms": round(float(np.percentile(lat_ms, 99)), 2)})
         stats = engine.stats()
+    pipe = stats["pipeline"]
+    staging = pipe["staging"]
     return {"metric": f"serve_{model_name}_img_per_sec",
             "value": points[-1]["img_per_sec"], "unit": "img/s",
             "model": model_name, "max_batch": max_batch,
             "max_wait_ms": max_wait_ms, "buckets": stats["buckets"],
+            "pipeline_depth": pipeline_depth,
             "loads": points,
             "engine": {"batches": stats["batches"],
                        "compiles": stats["compiles"],
                        "padded_images": stats["padded_images"]},
+            "overlap": {
+                "device_idle_frac": pipe["device_idle_frac"],
+                "max_inflight": pipe["max_inflight"],
+                "bulk_transfers": pipe["bulk_transfers"],
+                "bulk_transfer_mib": round(
+                    pipe["bulk_transfer_bytes"] / 2**20, 3),
+                "staged_buffers_allocated": staging["allocated"],
+                "staged_buffer_reuses": staging["reused"],
+                "exec_ewma_ms_by_bucket":
+                    stats["admission"]["exec_ewma_ms_by_bucket"]},
             "device_kind": jax.devices()[0].device_kind}
 
 
@@ -951,6 +968,10 @@ def main():
                         "(--serve offered-load points)")
     p.add_argument("--serve-duration", type=float, default=2.0,
                    help="seconds per offered-load point (--serve)")
+    p.add_argument("--serve-pipeline-depth", type=int, default=2,
+                   help="in-flight batch window (--serve): 1 = the "
+                        "synchronous comparison path, 2 = overlap batch "
+                        "formation/H2D with device compute")
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="measure the train step with the params-EMA "
                         "update in it (the Trainer's --ema-decay)")
@@ -991,7 +1012,8 @@ def main():
         print(json.dumps(bench_serve(
             model_name=args.serve_model,
             loads=tuple(int(c) for c in args.serve_loads.split(",")),
-            duration_s=args.serve_duration, max_batch=args.batch or 8)))
+            duration_s=args.serve_duration, max_batch=args.batch or 8,
+            pipeline_depth=args.serve_pipeline_depth)))
         return
     if args.infer:
         print(json.dumps(bench_infer(args.infer, steps=args.steps,

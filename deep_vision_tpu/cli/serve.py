@@ -38,6 +38,7 @@ def build_server(args):
     engine = BatchingEngine(
         sm, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         buckets=buckets,
+        pipeline_depth=getattr(args, "pipeline_depth", 2),
         admission=AdmissionController(max_queue=args.max_queue,
                                       max_wait_ms=args.max_wait_ms))
     engine.start()
@@ -72,6 +73,10 @@ def main(argv=None):
                         "of two up to --max-batch)")
     p.add_argument("--max-queue", type=int, default=256,
                    help="admission bound; beyond this requests shed 429")
+    p.add_argument("--pipeline-depth", type=int, default=2,
+                   help="dispatched-but-undrained batch window: 1 = "
+                        "synchronous, 2 = overlap batch N+1 formation/"
+                        "H2D with batch N compute (docs/SERVING.md)")
     p.add_argument("--warmup", action="store_true",
                    help="compile every bucket before accepting traffic")
     p.add_argument("--verbose", action="store_true",
@@ -85,7 +90,8 @@ def main(argv=None):
     print(f"[serve] {args.model} listening on "
           f"http://{server.host}:{server.port} "
           f"(buckets={engine.buckets}, max_wait={args.max_wait_ms}ms, "
-          f"max_queue={args.max_queue})")
+          f"max_queue={args.max_queue}, "
+          f"pipeline_depth={engine.pipeline_depth})")
     print(f"[serve] try: curl http://{server.host}:{server.port}/v1/healthz")
     try:
         server.serve_forever()

@@ -144,6 +144,15 @@ class Trainer:
             ema=getattr(self.config, "ema_decay", 0.0) > 0)
         return self._place_state(state)
 
+    @staticmethod
+    @functools.lru_cache(maxsize=None)
+    def _resharder(sharding):
+        """One jitted identity per DISTINCT target sharding (its own jit
+        cache then keys on leaf shape/dtype), so a reshard-restore
+        compiles O(distinct shardings), not O(leaves) — a fresh
+        ``jax.jit`` per leaf never hits the compile cache."""
+        return jax.jit(lambda a: a, out_shardings=sharding)
+
     def _place_state(self, state: TrainState) -> TrainState:
         """Place state on the mesh.  Models that partition their own state
         (e.g. pipeline stages over ``pipe`` —
@@ -169,7 +178,7 @@ class Trainer:
                 # be: keep it, or reshard device-side if the target differs
                 if leaf.sharding.is_equivalent_to(sharding, leaf.ndim):
                     return leaf
-                return jax.jit(lambda a: a, out_shardings=sharding)(leaf)
+                return self._resharder(sharding)(leaf)
             if multiproc:
                 # device_put can't build a multi-host global array from a
                 # host-local value; assemble it the way replicate() does.

@@ -1,9 +1,13 @@
 """In-process serving subsystem: dynamic micro-batching with deadlines,
 load shedding, and latency metrics over the training stack's restore path.
 
-    registry.py   checkpoint / StableHLO blob → ServingModel
-    engine.py     background-thread dynamic batcher, bucketed jit cache
+    registry.py   checkpoint / StableHLO blob → ServingModel (donated
+                  inputs, device-native unblocked outputs)
+    engine.py     pipelined background-thread dynamic batcher: bucketed
+                  jit cache, reused staging buffers, bounded in-flight
+                  window, one bulk D2H per batch
     admission.py  deadline-aware load shedding + queue-depth bound
+                  (per-bucket exec-time EWMAs)
     http.py       stdlib HTTP front-end (/v1/classify, /v1/detect, ...)
 
 Entry point: ``python -m deep_vision_tpu.cli.serve``; load generator:
@@ -11,8 +15,8 @@ Entry point: ``python -m deep_vision_tpu.cli.serve``; load generator:
 """
 
 from deep_vision_tpu.serve.admission import AdmissionController, Shed
-from deep_vision_tpu.serve.engine import BatchingEngine
+from deep_vision_tpu.serve.engine import BatchingEngine, StagingPool
 from deep_vision_tpu.serve.registry import ModelRegistry, ServingModel
 
 __all__ = ["AdmissionController", "BatchingEngine", "ModelRegistry",
-           "ServingModel", "Shed"]
+           "ServingModel", "Shed", "StagingPool"]

@@ -10,8 +10,14 @@ rather than executed late):
   * deadline feasibility — if ``now + estimated_service_time`` already
     exceeds the request's deadline, executing it wastes a batch slot on
     an answer nobody will read.  The estimate is the batcher's drain
-    window plus an EWMA of recent batch execution time (pessimistic
-    before any batch has run: only already-expired deadlines are shed).
+    window plus PER-BUCKET EWMAs of recent batch execution time — a
+    request that will pad into the 32-bucket is judged by the
+    32-bucket's history, not by a global average dragged down by
+    1-image batches — scaled by the pipelined engine's current
+    in-flight depth (each outstanding batch adds roughly one more
+    execution before this request's batch reaches the device).
+    Pessimistic before any batch has run: only already-expired
+    deadlines are shed.
 """
 
 from __future__ import annotations
@@ -38,28 +44,42 @@ class AdmissionController:
         self.max_queue = max_queue
         self._max_wait_s = max_wait_ms / 1e3
         self._alpha = ewma_alpha
-        self._exec_ewma_s: float | None = None
+        self._exec_ewma_s: float | None = None      # all-bucket fallback
+        self._bucket_ewma_s: dict[int, float] = {}  # bucket → EWMA
         self._lock = threading.Lock()
         self.shed_queue_full = 0
         self.shed_deadline = 0
 
-    def observe_exec(self, seconds: float):
-        """Feed one batch's wall-clock execution time into the EWMA."""
+    def observe_exec(self, seconds: float, bucket: int | None = None):
+        """Feed one batch's execution time into the EWMAs (global + the
+        bucket it actually ran in)."""
         with self._lock:
             if self._exec_ewma_s is None:
                 self._exec_ewma_s = seconds
             else:
                 self._exec_ewma_s += self._alpha * (seconds -
                                                     self._exec_ewma_s)
+            if bucket is not None:
+                prev = self._bucket_ewma_s.get(bucket)
+                self._bucket_ewma_s[bucket] = seconds if prev is None \
+                    else prev + self._alpha * (seconds - prev)
 
-    def estimated_service_s(self) -> float:
-        """Worst-case time-to-result for a request admitted right now:
-        a full drain window plus one batch execution."""
+    def estimated_service_s(self, bucket: int | None = None,
+                            inflight: int = 0) -> float:
+        """Worst-case time-to-result for a request admitted right now: a
+        full drain window, one execution of the bucket it will likely
+        run in (global EWMA until that bucket has history), plus one
+        more execution per batch already in the pipeline ahead of it."""
         with self._lock:
-            return self._max_wait_s + (self._exec_ewma_s or 0.0)
+            e = self._bucket_ewma_s.get(bucket) if bucket is not None \
+                else None
+            if e is None:
+                e = self._exec_ewma_s or 0.0
+            return self._max_wait_s + (1 + max(0, inflight)) * e
 
     def admit(self, queue_depth: int, deadline: float | None,
-              now: float | None = None) -> Shed | None:
+              now: float | None = None, bucket: int | None = None,
+              inflight: int = 0) -> Shed | None:
         """None = admitted; a ``Shed`` = rejected (reason inside)."""
         if queue_depth >= self.max_queue:
             with self._lock:
@@ -68,7 +88,7 @@ class AdmissionController:
                         f"queue depth {queue_depth} >= {self.max_queue}")
         if deadline is not None:
             now = time.monotonic() if now is None else now
-            est = self.estimated_service_s()
+            est = self.estimated_service_s(bucket, inflight)
             if now + est > deadline:
                 with self._lock:
                     self.shed_deadline += 1
@@ -96,4 +116,7 @@ class AdmissionController:
             return {"shed_queue_full": self.shed_queue_full,
                     "shed_deadline": self.shed_deadline,
                     "exec_ewma_ms": (self._exec_ewma_s or 0.0) * 1e3,
+                    "exec_ewma_ms_by_bucket": {
+                        str(b): round(v * 1e3, 3)
+                        for b, v in sorted(self._bucket_ewma_s.items())},
                     "max_queue": self.max_queue}

@@ -1,18 +1,42 @@
-"""Background-thread dynamic micro-batcher.
+"""Pipelined background-thread dynamic micro-batcher.
 
-The training stack amortizes XLA dispatch over ``lax.scan`` steps; the
-serving stack amortizes it over dynamically-formed batches.  Requests
-enqueue with an optional deadline; the batcher thread drains the queue up
-to ``max_batch`` or ``max_wait_ms`` (whichever comes first), pads the
-batch to a small set of power-of-two buckets so every served shape hits
-an already-compiled program (the bucket dict IS the jit cache — a miss is
-an explicit, counted compile, never a surprise mid-request trace),
-executes, and scatters the output rows back to per-request futures.
+The training stack amortizes XLA dispatch over ``lax.scan`` steps and
+hides host work behind device compute with double-buffered prefetch
+(~2% dispatch idle, docs/PERF.md); the serving stack applies the same
+argument to dynamically-formed request batches with a two-stage
+pipeline:
+
+  batcher thread   drains the queue up to ``max_batch``/``max_wait_ms``,
+                   stages the batch into a REUSED preallocated host
+                   buffer for its bucket (no per-batch ``np.zeros``),
+                   issues the H2D transfer + compiled program
+                   asynchronously (JAX dispatch returns before the
+                   device finishes), and hands the in-flight record off;
+  drainer thread   waits on completed batches in dispatch order, fetches
+                   the WHOLE output pytree with one bulk
+                   ``jax.device_get`` per batch (not one device slice
+                   per request per leaf), and scatters numpy rows to
+                   per-request futures on the host.
+
+A ``pipeline_depth``-bounded semaphore caps dispatched-but-undrained
+batches, so batch N+1's formation, staging, and H2D overlap batch N's
+device compute while memory stays bounded.  ``pipeline_depth=1`` is the
+synchronous mode: the batcher completes each batch inline (same staging
+buffers, same single bulk transfer — bit-identical outputs, no overlap).
+
+Bucketing is unchanged from the original engine: batches pad to a small
+set of power-of-two buckets so every served shape hits an
+already-compiled program (the bucket dict IS the jit cache — a miss is
+an explicit, counted compile, never a surprise mid-request trace).
+Compiled bucket programs donate their input buffer where the runtime
+allows (registry.py), so the padded batch's device allocation is
+recycled into the outputs.
 
 Deadline handling is two-phase: admission (``admission.py``) sheds
-requests that cannot possibly make their deadline at submit time, and the
-batcher re-checks at batch-formation time so a request that expired while
-queued is dropped rather than executed late.
+requests that cannot possibly make their deadline at submit time —
+using a per-bucket execution-time EWMA and the current in-flight depth
+— and the batcher re-checks at batch-formation time so a request that
+expired while queued is dropped rather than executed late.
 """
 
 from __future__ import annotations
@@ -48,18 +72,73 @@ class _Request:
         self.future = future
 
 
+class _Inflight:
+    """One dispatched batch awaiting its bulk D2H + scatter."""
+
+    __slots__ = ("requests", "bucket", "out", "buffer", "dispatched_at")
+
+    def __init__(self, requests, bucket, out, buffer, dispatched_at):
+        self.requests = requests
+        self.bucket = bucket
+        self.out = out
+        self.buffer = buffer
+        self.dispatched_at = dispatched_at
+
+
+class StagingPool:
+    """Per-bucket free-list of preallocated host batch buffers.
+
+    A buffer is checked out at batch formation, pinned for the batch's
+    whole device lifetime (the H2D may read it asynchronously), and
+    returned after the drainer's bulk fetch — so steady state holds at
+    most ``pipeline_depth + 1`` buffers per active bucket, reused
+    forever.  ``allocated``/``reused`` make the reuse testable.
+    """
+
+    def __init__(self, input_shape: tuple):
+        self._input_shape = tuple(input_shape)
+        self._free: dict[int, list[np.ndarray]] = {}
+        self._lock = threading.Lock()
+        self.allocated = 0
+        self.reused = 0
+
+    def acquire(self, bucket: int) -> np.ndarray:
+        with self._lock:
+            free = self._free.setdefault(bucket, [])
+            if free:
+                self.reused += 1
+                return free.pop()
+            self.allocated += 1
+        return np.zeros((bucket, *self._input_shape), np.float32)
+
+    def release(self, bucket: int, buf: np.ndarray):
+        with self._lock:
+            self._free.setdefault(bucket, []).append(buf)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"allocated": self.allocated, "reused": self.reused,
+                    "pooled": {b: len(v) for b, v in self._free.items()}}
+
+
 class BatchingEngine:
-    """Dynamic batcher for one ServingModel.
+    """Pipelined dynamic batcher for one ServingModel.
 
     Use as a context manager or call ``start()``/``stop()``.  ``submit``
     returns a ``concurrent.futures.Future`` resolving to either the
-    output pytree row for that image or a ``Shed``; ``infer`` is the
-    blocking convenience wrapper.
+    output pytree row (numpy, host-side) for that image or a ``Shed``;
+    ``infer`` is the blocking convenience wrapper.
+
+    ``pipeline_depth`` bounds dispatched-but-undrained batches: depth 1
+    is the strictly synchronous path (complete inline, no drainer
+    thread); depth ≥ 2 overlaps batch N+1's formation/staging/H2D with
+    batch N's device compute.
     """
 
     def __init__(self, model, *, max_batch: int = 32,
                  max_wait_ms: float = 5.0, buckets: list[int] | None = None,
-                 admission: AdmissionController | None = None):
+                 admission: AdmissionController | None = None,
+                 pipeline_depth: int = 2):
         self.model = model
         if model.fixed_batch is not None:
             # a StableHLO blob serves exactly its traced shape
@@ -68,20 +147,35 @@ class BatchingEngine:
             power_of_two_buckets(max_batch)
         self.max_batch = self.buckets[-1]
         self.max_wait_s = max_wait_ms / 1e3
+        self.pipeline_depth = max(1, int(pipeline_depth))
         self.admission = admission or AdmissionController(
             max_wait_ms=max_wait_ms)
         self.latency = LatencyHistogram()
         self.throughput = ThroughputMeter(warmup_steps=1)
+        self.staging = StagingPool(model.input_shape)
         self._queue: queue.Queue[_Request] = queue.Queue()
         self._executables: dict = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._drainer: threading.Thread | None = None
+        # in-flight window: acquired at dispatch, released after drain
+        self._inflight_sem = threading.BoundedSemaphore(self.pipeline_depth)
+        self._inflight_q: queue.Queue[_Inflight | None] = queue.Queue()
+        self._inflight = 0
+        self.max_inflight = 0
         self.submitted = 0
         self.served = 0
         self.batches = 0
         self.compiles = 0
         self.padded_images = 0
+        self.bulk_transfers = 0
+        self.bulk_transfer_bytes = 0
+        # device-idle accounting (host proxy: wall time with an EMPTY
+        # in-flight window between the first dispatch and the last drain)
+        self._first_dispatch: float | None = None
+        self._last_done: float | None = None
+        self._idle_s = 0.0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -92,6 +186,11 @@ class BatchingEngine:
                 target=self._loop, name=f"batcher-{self.model.name}",
                 daemon=True)
             self._thread.start()
+            if self.pipeline_depth > 1:
+                self._drainer = threading.Thread(
+                    target=self._drain_loop,
+                    name=f"drainer-{self.model.name}", daemon=True)
+                self._drainer.start()
         return self
 
     def stop(self, timeout: float = 5.0):
@@ -99,6 +198,12 @@ class BatchingEngine:
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
+        if self._drainer is not None:
+            # batcher has exited: every dispatched batch is already in
+            # the drain queue, so the sentinel lands after the last one
+            self._inflight_q.put(None)
+            self._drainer.join(timeout)
+            self._drainer = None
         # anything still queued will never run — tell its caller
         while True:
             try:
@@ -129,8 +234,13 @@ class BatchingEngine:
             else None
         with self._lock:
             self.submitted += 1
+            inflight = self._inflight
         fut: Future = Future()
-        shed = self.admission.admit(self._queue.qsize(), deadline, now)
+        depth = self._queue.qsize()
+        shed = self.admission.admit(
+            depth, deadline, now,
+            bucket=self._bucket_for(min(depth + 1, self.max_batch)),
+            inflight=inflight)
         if shed is not None:
             fut.set_result(shed)
             return fut
@@ -142,7 +252,7 @@ class BatchingEngine:
               timeout: float | None = 30.0):
         return self.submit(image, deadline_ms).result(timeout)
 
-    # -- batcher thread ----------------------------------------------------
+    # -- batcher thread (stage + dispatch) ---------------------------------
 
     def _loop(self):
         while not self._stop.is_set():
@@ -161,7 +271,7 @@ class BatchingEngine:
                 except queue.Empty:
                     break
             try:
-                self._run_batch(batch)
+                self._dispatch(batch)
             except Exception as e:  # deliver, don't kill the batcher
                 for req in batch:
                     if not req.future.done():
@@ -182,7 +292,14 @@ class BatchingEngine:
                 self.compiles += 1
         return fn
 
-    def _run_batch(self, batch: list[_Request]):
+    def _acquire_slot(self) -> bool:
+        """Block until an in-flight slot frees (or the engine stops)."""
+        while not self._stop.is_set():
+            if self._inflight_sem.acquire(timeout=0.05):
+                return True
+        return False
+
+    def _dispatch(self, batch: list[_Request]):
         import jax
 
         live = []
@@ -196,28 +313,94 @@ class BatchingEngine:
             return
         n = len(live)
         bucket = self._bucket_for(n)
-        padded = np.zeros((bucket, *self.model.input_shape), np.float32)
+        fn = self._compiled(bucket)  # compile OUTSIDE the in-flight window
+        if not self._acquire_slot():
+            for req in live:
+                req.future.set_result(Shed("shutdown", "engine stopped"))
+            return
+        buf = self.staging.acquire(bucket)
         for i, req in enumerate(live):
-            padded[i] = req.image
-        fn = self._compiled(bucket)
+            buf[i] = req.image
+        if n < bucket:
+            buf[n:] = 0.0  # reused buffer: clear stale pad rows
         t0 = time.monotonic()
-        out = jax.block_until_ready(fn(padded))
-        self.admission.observe_exec(time.monotonic() - t0)
-        now = time.monotonic()
+        # async H2D + dispatch: jax returns device futures immediately;
+        # the staged buffer stays checked out until the drainer is done
+        # with the batch, so the transfer may read it at its leisure
+        out = fn(jax.device_put(buf))
+        rec = _Inflight(live, bucket, out, buf, t0)
+        with self._lock:
+            if self._inflight == 0 and self._last_done is not None:
+                self._idle_s += t0 - self._last_done
+            if self._first_dispatch is None:
+                self._first_dispatch = t0
+            self._inflight += 1
+            self.max_inflight = max(self.max_inflight, self._inflight)
+        if self.pipeline_depth > 1:
+            self._inflight_q.put(rec)
+        else:
+            self._finish(rec)
+
+    # -- drainer thread (bulk D2H + scatter) -------------------------------
+
+    def _drain_loop(self):
+        while True:
+            rec = self._inflight_q.get()
+            if rec is None:
+                return
+            self._finish(rec)
+
+    def _finish(self, rec: _Inflight):
+        try:
+            self._complete(rec)
+        except Exception as e:
+            for req in rec.requests:
+                if not req.future.done():
+                    req.future.set_exception(e)
+        finally:
+            self.staging.release(rec.bucket, rec.buffer)
+            with self._lock:
+                self._inflight -= 1
+                self._last_done = time.monotonic()
+            self._inflight_sem.release()
+
+    def _complete(self, rec: _Inflight):
+        import jax
+
+        # ONE bulk D2H for the whole output pytree — not a device slice
+        # + transfer per request per leaf
+        host = jax.device_get(rec.out)
+        t_done = time.monotonic()
+        n = len(rec.requests)
+        # per-batch device occupancy ≈ completion minus the later of its
+        # dispatch or the previous batch's completion (under pipelining,
+        # dispatch→done includes waiting behind the batch ahead)
+        with self._lock:
+            busy_from = rec.dispatched_at if self._last_done is None \
+                else max(rec.dispatched_at, self._last_done)
+        self.admission.observe_exec(t_done - busy_from, bucket=rec.bucket)
+        nbytes = int(sum(np.asarray(a).nbytes
+                         for a in jax.tree_util.tree_leaves(host)))
         with self._lock:
             self.batches += 1
             self.served += n
-            self.padded_images += bucket - n
+            self.padded_images += rec.bucket - n
+            self.bulk_transfers += 1
+            self.bulk_transfer_bytes += nbytes
         self.throughput.update(n)
-        for i, req in enumerate(live):
-            self.latency.record(now - req.enqueued_at)
+        for i, req in enumerate(rec.requests):
+            self.latency.record(t_done - req.enqueued_at)
             req.future.set_result(
-                jax.tree_util.tree_map(lambda a: a[i], out))
+                jax.tree_util.tree_map(lambda a: np.asarray(a)[i], host))
 
     # -- observability -----------------------------------------------------
 
     def stats(self) -> dict:
         with self._lock:
+            span = None
+            if self._first_dispatch is not None and \
+                    self._last_done is not None:
+                span = self._last_done - self._first_dispatch
             out = {"model": self.model.name,
                    "submitted": self.submitted,
                    "served": self.served,
@@ -227,7 +410,19 @@ class BatchingEngine:
                    "queue_depth": self._queue.qsize(),
                    "buckets": list(self.buckets),
                    "compiled_buckets": sorted(self._executables),
-                   "max_wait_ms": self.max_wait_s * 1e3}
+                   "max_wait_ms": self.max_wait_s * 1e3,
+                   "pipeline": {
+                       "depth": self.pipeline_depth,
+                       "inflight": self._inflight,
+                       "max_inflight": self.max_inflight,
+                       "bulk_transfers": self.bulk_transfers,
+                       "bulk_transfer_bytes": self.bulk_transfer_bytes,
+                       # host proxy: fraction of the first-dispatch →
+                       # last-drain span with an empty in-flight window
+                       "device_idle_frac": (
+                           round(self._idle_s / span, 4)
+                           if span and span > 0 else None)}}
+        out["pipeline"]["staging"] = self.staging.stats()
         out["latency"] = self.latency.percentiles()
         out["img_per_sec"] = self.throughput.images_per_sec
         out["admission"] = self.admission.stats()

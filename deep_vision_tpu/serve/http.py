@@ -4,7 +4,11 @@ Routes (JSON in, JSON out):
 
     GET  /v1/healthz   liveness + served model names
     GET  /v1/stats     per-model engine stats (latency p50/p95/p99,
-                       throughput, shed counts, compile/bucket state)
+                       throughput, shed counts, compile/bucket state,
+                       and the pipelined executor's overlap block:
+                       depth, in-flight high-water mark, device-idle
+                       fraction, staged-buffer reuse, bulk D2H
+                       transfer count/bytes, per-bucket exec EWMAs)
     POST /v1/classify  {"pixels": [[...]] | "image_b64": "...",
                         "model"?, "deadline_ms"?, "top_k"?}
     POST /v1/detect    same inputs + "score_threshold"?; YOLO models

@@ -10,15 +10,36 @@
 Both present the same surface to the engine: ``compile_bucket(b)`` hands
 back a callable for a padded batch of exactly ``b`` images, so the
 batcher owns WHEN to compile (and counts it) while the model owns HOW.
+
+Execution contract (what the pipelined engine relies on):
+
+  * callables accept either a host numpy batch or an already-transferred
+    ``jax.Array`` (the engine stages + ``device_put``s itself so H2D
+    overlaps the previous batch's compute; direct callers may pass
+    numpy);
+  * outputs are DEVICE-NATIVE and unblocked — the callable never calls
+    ``block_until_ready``/``device_get``, so dispatch returns
+    immediately and the engine's drainer performs the single bulk D2H
+    per batch;
+  * checkpoint-backed programs are compiled with the image argument
+    DONATED (``donates_inputs``) where the runtime allows, recycling the
+    padded batch's device allocation into the outputs; StableHLO blobs
+    keep their exported (non-donating) signature.
 """
 
 from __future__ import annotations
 
 import functools
+import warnings
+
+import numpy as np
 
 
 class ServingModel:
     """One deployable model: metadata + per-bucket compiled forwards."""
+
+    #: whether compile_bucket programs donate their image input buffer
+    donates_inputs = False
 
     def __init__(self, name: str, *, task: str, input_shape: tuple,
                  num_classes: int, config_name: str | None = None,
@@ -39,11 +60,14 @@ class ServingModel:
         return {"name": self.name, "task": self.task,
                 "input_shape": list(self.input_shape),
                 "num_classes": self.num_classes,
-                "fixed_batch": self.fixed_batch}
+                "fixed_batch": self.fixed_batch,
+                "donates_inputs": self.donates_inputs}
 
 
 class CheckpointServingModel(ServingModel):
     """Workdir-checkpoint-backed: AOT-compile apply() per batch bucket."""
+
+    donates_inputs = True
 
     def __init__(self, name: str, cfg, model, state):
         super().__init__(
@@ -70,9 +94,32 @@ class CheckpointServingModel(ServingModel):
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
             self._variables)
         # AOT lower+compile: the engine's bucket dict is the jit cache,
-        # so a served shape can never hit a surprise trace mid-request
-        compiled = jax.jit(apply).lower(v_spec, x_spec).compile()
-        return functools.partial(compiled, self._variables)
+        # so a served shape can never hit a surprise trace mid-request.
+        # The image buffer is donated — each padded batch's device
+        # allocation is recycled into the outputs (a no-op where the
+        # backend declines; jax falls back to copying)
+        with warnings.catch_warnings():
+            # lowering warns when the donated image buffer can't alias
+            # any output (e.g. classification logits are smaller than
+            # the batch) — donation is best-effort by contract
+            warnings.filterwarnings(
+                "ignore", message="Some donated buffers were not usable")
+            compiled = jax.jit(apply, donate_argnums=(1,)).lower(
+                v_spec, x_spec).compile()
+        variables = self._variables
+
+        def call(x):
+            # keep donation meaningful for direct numpy callers too:
+            # transfer first, hand the committed device buffer over
+            if not isinstance(x, jax.Array):
+                x = jax.device_put(np.asarray(x, np.float32))
+            with warnings.catch_warnings():
+                warnings.filterwarnings(
+                    "ignore",
+                    message="Some donated buffers were not usable")
+                return compiled(variables, x)
+
+        return call
 
 
 class ExportedServingModel(ServingModel):

@@ -71,7 +71,15 @@ class MeanAPEvaluator:
                  gt_boxes[gt_classes == c]))
 
     # IoU grid for the COCO-standard average: 0.50, 0.55, ..., 0.95.
+    # Invariant: a detection whose IoU lands EXACTLY on a grid value
+    # (e.g. 80/100 overlap vs threshold 0.80) must count as matched at
+    # that threshold.  ``np.arange(...).round(2)`` happens to produce
+    # the same nearest-doubles as the IoU arithmetic today, but that is
+    # representation luck, not a guarantee — so ``_class_ap`` compares
+    # against ``threshold - IOU_EPS`` to make boundary inclusion
+    # explicit and robust to any future grid construction.
     COCO_IOUS = tuple(np.arange(0.50, 0.96, 0.05).round(2))
+    IOU_EPS = 1e-9
 
     def _class_entries(self, c: int) -> list:
         """Score-sorted detections with their per-gt IoU vectors AND the
@@ -99,6 +107,8 @@ class MeanAPEvaluator:
         gt above threshold."""
         if not entries:
             return 0.0
+        # boundary-exact IoUs count as matched (see IOU_EPS invariant)
+        thr = iou_threshold - self.IOU_EPS
         matched: dict[int, set] = {}
         tp = np.zeros(len(entries))
         fp = np.zeros(len(entries))
@@ -110,14 +120,14 @@ class MeanAPEvaluator:
             j = -1
             if coco_matching:
                 for cand in order:
-                    if ious[cand] < iou_threshold:
+                    if ious[cand] < thr:
                         break
                     if int(cand) not in taken:
                         j = int(cand)
                         break
             else:
                 jmax = int(np.argmax(ious))
-                if ious[jmax] >= iou_threshold and jmax not in taken:
+                if ious[jmax] >= thr and jmax not in taken:
                     j = jmax
             if j >= 0:
                 tp[i] = 1

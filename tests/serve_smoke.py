@@ -1,8 +1,11 @@
 """`make serve-smoke`: boot the real HTTP server wiring on a random port
 against a LeNet/MNIST workdir fixture, issue one /v1/classify request,
-assert a 200.  Exercises exactly the `python -m deep_vision_tpu.cli.serve`
-path (cli.serve.build_server), just without serve_forever in the
-foreground — run directly, not under pytest."""
+assert a 200 — once on the synchronous path (pipeline_depth=1) and once
+on the pipelined executor (depth=2, the production default), asserting
+the pipelined run's scatter did exactly one bulk D2H per batch.
+Exercises exactly the `python -m deep_vision_tpu.cli.serve` path
+(cli.serve.build_server), just without serve_forever in the foreground —
+run directly, not under pytest."""
 
 import argparse
 import json
@@ -18,7 +21,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def main():
+def smoke_one(pipeline_depth: int) -> None:
     from deep_vision_tpu.cli.serve import build_server
 
     with tempfile.TemporaryDirectory() as workdir:
@@ -27,7 +30,8 @@ def main():
         args = argparse.Namespace(
             model="lenet5", workdir=workdir, stablehlo=None,
             host="127.0.0.1", port=0, max_batch=4, max_wait_ms=2.0,
-            buckets=None, max_queue=64, warmup=False, verbose=False)
+            buckets=None, max_queue=64, warmup=False, verbose=False,
+            pipeline_depth=pipeline_depth)
         engine, server = build_server(args)
         server.start_background()
         try:
@@ -40,11 +44,26 @@ def main():
                 assert r.status == 200, f"expected 200, got {r.status}"
                 top = json.loads(r.read())["top"]
                 assert len(top) == 5, top
-            print(f"serve-smoke PASS: 200 from port {server.port}, "
-                  f"top-1 class {top[0]['class']}")
+            with urllib.request.urlopen(
+                    f"http://{server.host}:{server.port}/v1/stats",
+                    timeout=60) as r:
+                stats = json.loads(r.read())["lenet5"]
+            pipe = stats["pipeline"]
+            assert pipe["depth"] == pipeline_depth, pipe
+            # the scatter contract: ONE bulk D2H per executed batch
+            assert pipe["bulk_transfers"] == stats["batches"] >= 1, pipe
+            print(f"serve-smoke PASS (pipeline_depth={pipeline_depth}): "
+                  f"200 from port {server.port}, top-1 class "
+                  f"{top[0]['class']}, {pipe['bulk_transfers']} bulk "
+                  f"transfer(s) for {stats['batches']} batch(es)")
         finally:
             server.shutdown()
             engine.stop()
+
+
+def main():
+    for depth in (1, 2):
+        smoke_one(depth)
     return 0
 
 

@@ -267,6 +267,29 @@ def test_map_coco_average_partial_overlap():
     assert res["mAP50_95"] == pytest.approx(0.7)
 
 
+def test_map_boundary_iou_counts_as_matched():
+    """A detection EXACTLY on a COCO grid threshold matches at that
+    threshold by construction (IOU_EPS comparison slack), independent of
+    how the grid doubles were produced — previously this held only
+    because np.arange(...).round(2) and the IoU arithmetic happened to
+    round to the same nearest doubles."""
+    ev = MeanAPEvaluator(num_classes=1)
+    for thr in MeanAPEvaluator.COCO_IOUS:
+        # gt 10×10 at origin; det [0,0,10,10t] nests inside it, so
+        # union = gt area and IoU = inter/union = 100t/100 = exactly t
+        ev.add(np.array([[0.0, 0.0, 10.0, 10.0 * thr]]), np.array([0.9]),
+               np.array([0]), np.array([[0.0, 0.0, 10.0, 10.0]]),
+               np.array([0]))
+    res = ev.compute()
+    # image k's IoU is grid point k: it matches thresholds 0..k, so
+    # mAP50_95 = mean over thresholds of AP with (10−k)/10 recall ...
+    # the key regression signal is the primary threshold: every image
+    # with IoU ≥ 0.5 (all 10) must match at 0.5 despite 5 of them
+    # sitting exactly ON a grid value
+    assert res["mAP"] == pytest.approx(1.0)
+    assert res["mAP50_95"] > 0.0
+
+
 def test_map_matching_rules_crowded_objects():
     """The two matching rules diverge on crowded scenes, and each metric
     uses its own: det2's argmax-IoU gt is taken by det1, so VOC-devkit

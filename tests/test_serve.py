@@ -1,6 +1,8 @@
 """Serving-engine contract (CPU, tier-1 fast): dynamic batching is
-numerically invisible, bucket padding compiles once per bucket, and
-doomed requests are shed — never executed.
+numerically invisible, bucket padding compiles once per bucket, doomed
+requests are shed — never executed — and the pipelined executor (bounded
+in-flight window, reused staging buffers, one bulk D2H per batch) is
+bit-identical to the synchronous depth-1 path.
 
 Uses LeNet at random init (the restore path's no-checkpoint fallback):
 serving correctness is about request plumbing, not learned weights."""
@@ -183,6 +185,110 @@ def test_exported_blob_serving(lenet_serving, tmp_path):
     ref = np.asarray(sm._model.apply(variables, jax.numpy.asarray(
         np.stack(imgs)), train=False))
     np.testing.assert_allclose(np.stack(rows), ref, atol=1e-5)
+
+
+def test_pipelined_bit_identical_to_sync(lenet_serving):
+    """The same request stream through pipeline_depth=2 and the
+    synchronous depth=1 path yields bit-identical rows."""
+    _, sm = lenet_serving
+    imgs = _images(16)
+
+    def run(depth):
+        with BatchingEngine(sm, buckets=[1, 2, 4], max_wait_ms=2,
+                            pipeline_depth=depth) as eng:
+            rows = [np.asarray(f.result(60))
+                    for f in [eng.submit(im) for im in imgs]]
+            stats = eng.stats()
+        return rows, stats
+
+    sync_rows, sync_stats = run(1)
+    pipe_rows, pipe_stats = run(2)
+    for a, b in zip(sync_rows, pipe_rows):
+        assert np.array_equal(a, b)
+    assert sync_stats["pipeline"]["depth"] == 1
+    assert pipe_stats["pipeline"]["depth"] == 2
+
+
+def test_one_bulk_transfer_per_batch(lenet_serving):
+    """The acceptance contract: the result scatter performs EXACTLY one
+    device→host transfer per executed batch — counted, not eyeballed —
+    and moves the whole padded output (bucket rows × 10 logits f32)."""
+    _, sm = lenet_serving
+    imgs = _images(8)
+    with BatchingEngine(sm, buckets=[8], max_wait_ms=250,
+                        pipeline_depth=2) as eng:
+        for f in [eng.submit(im) for im in imgs]:
+            assert f.result(60) is not None
+        stats = eng.stats()
+    pipe = stats["pipeline"]
+    assert stats["batches"] == 1
+    assert pipe["bulk_transfers"] == stats["batches"]
+    assert pipe["bulk_transfer_bytes"] == 8 * 10 * 4
+
+
+def test_inflight_window_bounded(lenet_serving):
+    """Under a flood of tiny batches the dispatched-but-undrained window
+    never exceeds pipeline_depth."""
+    _, sm = lenet_serving
+    imgs = _images(2)
+    with BatchingEngine(sm, buckets=[1, 2], max_wait_ms=0.5,
+                        pipeline_depth=2) as eng:
+        futures = [eng.submit(imgs[k % 2]) for k in range(40)]
+        for f in futures:
+            assert f.result(60) is not None
+        stats = eng.stats()
+    assert stats["served"] == 40
+    assert 1 <= stats["pipeline"]["max_inflight"] <= 2
+    assert stats["pipeline"]["inflight"] == 0  # all drained at stop
+
+
+def test_staged_buffers_reused(lenet_serving):
+    """Many batches into one bucket allocate at most depth+1 staging
+    buffers — the rest are reuses, never per-batch np.zeros."""
+    _, sm = lenet_serving
+    imgs = _images(4)
+    with BatchingEngine(sm, buckets=[4], max_wait_ms=250,
+                        pipeline_depth=2) as eng:
+        for _ in range(6):  # 6 sequential full batches, same bucket
+            for f in [eng.submit(im) for im in imgs]:
+                assert f.result(60) is not None
+        stats = eng.stats()
+    staging = stats["pipeline"]["staging"]
+    assert stats["batches"] == 6
+    assert staging["allocated"] <= 3  # depth + 1
+    assert staging["reused"] == stats["batches"] - staging["allocated"]
+
+
+def test_per_bucket_ewma(lenet_serving):
+    """Mixed bucket sizes train SEPARATE exec-time EWMAs, and each
+    converges to its own bucket's service time."""
+    from deep_vision_tpu.serve.admission import AdmissionController
+
+    adm = AdmissionController(max_wait_ms=1.0)
+    for _ in range(50):
+        adm.observe_exec(0.002, bucket=1)
+        adm.observe_exec(0.020, bucket=8)
+    by_bucket = adm.stats()["exec_ewma_ms_by_bucket"]
+    assert by_bucket["1"] == pytest.approx(2.0, rel=0.05)
+    assert by_bucket["8"] == pytest.approx(20.0, rel=0.05)
+    # feasibility uses the bucket that will actually run: a 12 ms
+    # deadline is feasible for the 1-bucket, doomed for the 8-bucket
+    now = 1000.0
+    assert adm.admit(0, now + 0.012, now, bucket=1) is None
+    shed = adm.admit(0, now + 0.012, now, bucket=8)
+    assert isinstance(shed, Shed) and shed.reason == "deadline"
+    # each in-flight batch ahead adds one more execution to the estimate
+    assert adm.estimated_service_s(bucket=8, inflight=2) == pytest.approx(
+        0.001 + 3 * 0.020, rel=0.06)
+    # engine end-to-end: serving mixed sizes populates both EWMAs
+    _, sm = lenet_serving
+    with BatchingEngine(sm, buckets=[1, 8], max_wait_ms=1,
+                        pipeline_depth=2) as eng:
+        assert eng.infer(_images(1)[0], timeout=60) is not None
+        for f in [eng.submit(im) for im in _images(8)]:
+            assert f.result(60) is not None
+        by_bucket = eng.stats()["admission"]["exec_ewma_ms_by_bucket"]
+    assert "1" in by_bucket and "8" in by_bucket
 
 
 def test_concurrent_submitters_all_answered(lenet_serving):
