@@ -22,6 +22,12 @@ test-all:
 serve-smoke:
 	$(PY) tests/serve_smoke.py
 
+# the chaos lane alone: deterministic fault injection against a real
+# engine — poison isolation, watchdog restarts, exec-timeout fast-fail,
+# healthz 200→503→200 (docs/SERVING.md "Failure model & operations")
+serve-chaos:
+	DVT_SERVE_FAULT_SEED=0 $(PY) -m pytest tests/test_faults.py -q -m chaos
+
 serve_%:
 	$(PY) -m deep_vision_tpu.cli.serve -m $* --workdir $(WORKDIR)/$*
 
@@ -60,4 +66,5 @@ eval_%:
 list:
 	$(PY) -m deep_vision_tpu.cli.train --list -m x
 
-.PHONY: test test-all bench bench-serve bench-serve-sync serve-smoke list
+.PHONY: test test-all bench bench-serve bench-serve-sync serve-smoke \
+	serve-chaos list

@@ -514,7 +514,8 @@ def bench_infer(name: str = "resnet50", steps: int | None = None,
 
 def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                 duration_s: float = 2.0, max_batch: int = 8,
-                max_wait_ms: float = 2.0, pipeline_depth: int = 2) -> dict:
+                max_wait_ms: float = 2.0, pipeline_depth: int = 2,
+                faults: str = "", fault_seed: int = 0) -> dict:
     """Closed-loop load generator against the dynamic-batching engine
     (``deep_vision_tpu/serve``): C client threads each submit one image,
     wait for the answer, repeat — so C is the offered load (concurrency),
@@ -527,6 +528,12 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
     reuse, bulk D2H bytes) so serving regressions are trackable the way
     BENCH_r0*.json tracks training.  ``--serve-pipeline-depth 1`` is the
     synchronous comparison run.
+
+    ``--faults`` (a deterministic spec, docs/SERVING.md) exercises the
+    failure paths under load — each load point then also reports its
+    error count, and the JSON gains a ``health`` block (state machine,
+    retries, quarantines, watchdog restarts) so fault-tolerance overhead
+    and behavior are benchmarkable, not just unit-tested.
     """
     import sys
     import tempfile
@@ -537,6 +544,8 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
     from deep_vision_tpu.core.config import get_config
     from deep_vision_tpu.core.restore import load_state
     from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.admission import Shed
+    from deep_vision_tpu.serve.faults import FaultPlane, Quarantined
     from deep_vision_tpu.serve.registry import CheckpointServingModel
 
     cfg = get_config(model_name)
@@ -548,21 +557,31 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
     img = np.random.RandomState(0).randn(*sm.input_shape).astype(np.float32)
     points = []
     with BatchingEngine(sm, max_batch=max_batch, max_wait_ms=max_wait_ms,
-                        pipeline_depth=pipeline_depth) as engine:
+                        pipeline_depth=pipeline_depth,
+                        faults=FaultPlane(faults, fault_seed)) as engine:
         engine.warmup()  # compiles excluded from every load point
         for clients in loads:
             latencies: list = []
+            errors = [0]
             lock = threading.Lock()
             stop_at = time.perf_counter() + duration_s
 
             def client():
-                local = []
+                local, local_err = [], 0
                 while time.perf_counter() < stop_at:
                     t0 = time.perf_counter()
-                    engine.infer(img, timeout=60)
+                    try:
+                        r = engine.infer(img, timeout=60)
+                        if isinstance(r, (Shed, Quarantined)):
+                            local_err += 1
+                            continue
+                    except Exception:  # noqa: BLE001 — injected faults
+                        local_err += 1
+                        continue
                     local.append(time.perf_counter() - t0)
                 with lock:
                     latencies.extend(local)
+                    errors[0] += local_err
 
             threads = [threading.Thread(target=client)
                        for _ in range(clients)]
@@ -575,6 +594,7 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
             lat_ms = np.asarray(latencies) * 1e3
             points.append({
                 "clients": clients, "requests": len(latencies),
+                "errors": errors[0],
                 "img_per_sec": round(len(latencies) / elapsed, 1),
                 "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
                 "p95_ms": round(float(np.percentile(lat_ms, 95)), 2),
@@ -582,12 +602,23 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
         stats = engine.stats()
     pipe = stats["pipeline"]
     staging = pipe["staging"]
+    health = stats["health"]
     return {"metric": f"serve_{model_name}_img_per_sec",
             "value": points[-1]["img_per_sec"], "unit": "img/s",
             "model": model_name, "max_batch": max_batch,
             "max_wait_ms": max_wait_ms, "buckets": stats["buckets"],
             "pipeline_depth": pipeline_depth,
+            "faults": faults or None,
             "loads": points,
+            "health": {
+                "state": health["state"],
+                "batch_failures": health["batch_failures"],
+                "retry_executions": health["retry_executions"],
+                "quarantined": health["quarantined"],
+                "watchdog_restarts": health["watchdog_restarts"],
+                "exec_timeouts": health["exec_timeouts"],
+                **({"faults": health["faults"]}
+                   if "faults" in health else {})},
             "engine": {"batches": stats["batches"],
                        "compiles": stats["compiles"],
                        "padded_images": stats["padded_images"]},
@@ -968,6 +999,12 @@ def main():
                         "(--serve offered-load points)")
     p.add_argument("--serve-duration", type=float, default=2.0,
                    help="seconds per offered-load point (--serve)")
+    p.add_argument("--faults", default="",
+                   help="fault-injection spec for --serve (e.g. "
+                        "'compute:exception:p=0.05'): benchmark the "
+                        "failure paths under load (docs/SERVING.md)")
+    p.add_argument("--fault-seed", type=int, default=0,
+                   help="seed for probabilistic fault firing (--faults)")
     p.add_argument("--serve-pipeline-depth", type=int, default=2,
                    help="in-flight batch window (--serve): 1 = the "
                         "synchronous comparison path, 2 = overlap batch "
@@ -1013,7 +1050,8 @@ def main():
             model_name=args.serve_model,
             loads=tuple(int(c) for c in args.serve_loads.split(",")),
             duration_s=args.serve_duration, max_batch=args.batch or 8,
-            pipeline_depth=args.serve_pipeline_depth)))
+            pipeline_depth=args.serve_pipeline_depth,
+            faults=args.faults, fault_seed=args.fault_seed)))
         return
     if args.infer:
         print(json.dumps(bench_infer(args.infer, steps=args.steps,

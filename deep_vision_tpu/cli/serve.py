@@ -11,7 +11,12 @@
     python -m deep_vision_tpu.cli.serve -m yolov3_voc --workdir runs/y \\
         --max-batch 16 --max-wait-ms 8 --max-queue 512 --warmup
 
-Knobs and architecture: docs/SERVING.md.  Smoke: ``make serve-smoke``.
+    # chaos: boot with a deterministic fault spec (docs/SERVING.md)
+    python -m deep_vision_tpu.cli.serve -m lenet5 --workdir runs/l \\
+        --faults 'compute:exception:times=1' --fault-seed 0
+
+Knobs and architecture: docs/SERVING.md.  Smoke: ``make serve-smoke``;
+chaos suite: ``make serve-chaos``.
 """
 
 from __future__ import annotations
@@ -24,6 +29,7 @@ def build_server(args):
     test so `make serve-smoke` boots exactly the production wiring."""
     from deep_vision_tpu.serve.admission import AdmissionController
     from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.faults import FaultPlane
     from deep_vision_tpu.serve.http import ServeServer
     from deep_vision_tpu.serve.registry import ModelRegistry
 
@@ -35,18 +41,32 @@ def build_server(args):
         sm = registry.load_checkpoint(args.model, args.workdir)
     buckets = [int(b) for b in args.buckets.split(",")] if args.buckets \
         else None
+    fault_spec = getattr(args, "faults", None)
+    faults = FaultPlane(fault_spec, getattr(args, "fault_seed", 0)) \
+        if fault_spec else None  # None → engine reads DVT_SERVE_FAULTS
     engine = BatchingEngine(
         sm, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         buckets=buckets,
         pipeline_depth=getattr(args, "pipeline_depth", 2),
+        faults=faults,
+        watchdog_interval_s=getattr(args, "watchdog_interval_ms", 50.0)
+        / 1e3,
+        restart_budget=getattr(args, "restart_budget", 3),
+        exec_timeout_k=getattr(args, "exec_timeout_k", 10.0),
+        exec_timeout_min_s=getattr(args, "exec_timeout_min_s", 2.0),
+        retry_budget=getattr(args, "retry_budget", 16),
+        degraded_after=getattr(args, "degraded_after", 1),
+        dead_after=getattr(args, "dead_after", 5),
         admission=AdmissionController(max_queue=args.max_queue,
                                       max_wait_ms=args.max_wait_ms))
     engine.start()
     if args.warmup:
         print(f"[serve] warming {engine.buckets} ...")
         engine.warmup()
-    server = ServeServer(registry, {sm.name: engine}, host=args.host,
-                         port=args.port, verbose=args.verbose)
+    server = ServeServer(
+        registry, {sm.name: engine}, host=args.host, port=args.port,
+        verbose=args.verbose,
+        max_body_bytes=int(getattr(args, "max_body_mb", 32) * 2**20))
     return engine, server
 
 
@@ -81,6 +101,38 @@ def main(argv=None):
                    help="compile every bucket before accepting traffic")
     p.add_argument("--verbose", action="store_true",
                    help="per-request HTTP access logs")
+    # -- fault tolerance (docs/SERVING.md "Failure model & operations") --
+    p.add_argument("--faults", default=None,
+                   help="deterministic fault-injection spec, e.g. "
+                        "'compute:exception:times=1;d2h:latency:"
+                        "delay_ms=20' (default: env DVT_SERVE_FAULTS; "
+                        "empty = disabled)")
+    p.add_argument("--fault-seed", type=int, default=0,
+                   help="seed for probabilistic (p=) fault firing")
+    p.add_argument("--watchdog-interval-ms", type=float, default=50.0,
+                   help="supervision tick; 0 disables the watchdog "
+                        "(thread restarts + exec-timeout fast-fail)")
+    p.add_argument("--restart-budget", type=int, default=3,
+                   help="watchdog thread restarts before the engine "
+                        "goes sticky-DEAD (healthz 503)")
+    p.add_argument("--exec-timeout-k", type=float, default=10.0,
+                   help="a batch older than k × its bucket's exec EWMA "
+                        "fast-fails the in-flight window")
+    p.add_argument("--exec-timeout-min-s", type=float, default=2.0,
+                   help="exec-timeout floor (also the pre-EWMA bound)")
+    p.add_argument("--retry-budget", type=int, default=16,
+                   help="bisect-retry executions per failed batch before "
+                        "the remainder is quarantined")
+    p.add_argument("--degraded-after", type=int, default=1,
+                   help="consecutive batch failures before DEGRADED "
+                        "(healthz 503)")
+    p.add_argument("--dead-after", type=int, default=5,
+                   help="consecutive batch failures before DEAD")
+    p.add_argument("--drain-deadline", type=float, default=5.0,
+                   help="shutdown grace: reject new submits immediately, "
+                        "finish admitted work up to this many seconds")
+    p.add_argument("--max-body-mb", type=float, default=32.0,
+                   help="reject request bodies over this size with 413")
     args = p.parse_args(argv)
 
     from deep_vision_tpu.core.compile_cache import enable_compile_cache
@@ -92,6 +144,9 @@ def main(argv=None):
           f"(buckets={engine.buckets}, max_wait={args.max_wait_ms}ms, "
           f"max_queue={args.max_queue}, "
           f"pipeline_depth={engine.pipeline_depth})")
+    if engine.faults.enabled:
+        print(f"[serve] FAULT INJECTION ACTIVE: '{engine.faults.spec}' "
+              f"(seed {engine.faults.seed})")
     print(f"[serve] try: curl http://{server.host}:{server.port}/v1/healthz")
     try:
         server.serve_forever()
@@ -99,7 +154,7 @@ def main(argv=None):
         print("[serve] shutting down")
     finally:
         server.shutdown()
-        engine.stop()
+        engine.stop(drain_deadline=args.drain_deadline)
     return 0
 
 

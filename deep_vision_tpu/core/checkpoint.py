@@ -52,6 +52,12 @@ class Checkpointer:
     def latest_step(self) -> int | None:
         return self._mgr.latest_step()
 
+    def all_steps(self) -> list[int]:
+        """Retained checkpoint steps, ascending — the restore fallback
+        (core/restore.py) walks these newest-first when the latest
+        checkpoint is corrupt or partially written."""
+        return sorted(self._mgr.all_steps())
+
     def _state_meta(self, step: int | None) -> dict:
         """The stored state payload's metadata dict ({} when absent) —
         the one place that knows the save() payload nesting."""

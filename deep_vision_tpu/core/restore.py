@@ -6,6 +6,13 @@ deployment path all build serving states through one function: workdir
 checkpoint discovery (``checkpoints_best`` preferred), pipeline-layout →
 monolithic conversion for runs trained with ``--mesh ...,pipe=p``, and the
 EMA-params preference (serve the averaged copy — the weights eval scored).
+
+A corrupt or partially-written latest checkpoint (killed mid-save, torn
+copy) does NOT take the serving path down: restore walks the retained
+steps newest-first and falls back to the previous step, logging which
+step was actually restored.  Callers that need the answer
+programmatically pass ``info={}`` and read ``info["step"]`` /
+``info["fallback"]`` back (serve/registry.py surfaces it per model).
 """
 
 from __future__ import annotations
@@ -14,13 +21,18 @@ import functools
 import os
 
 
-def load_state(cfg, workdir, *, log=print, tag: str = "restore"):
+def load_state(cfg, workdir, *, log=print, tag: str = "restore",
+               info: dict | None = None):
     """Restore (model, TrainState) ready to serve from ``workdir``.
 
     Prefers ``checkpoints_best`` over ``checkpoints``; converts
     pipeline-trained layouts to monolithic; serves EMA params when the run
-    trained with them.  Falls back to a fresh random init (with a warning)
-    when no checkpoint exists — the synthetic / smoke-test path.
+    trained with them.  Falls back step-by-step when the newest retained
+    checkpoint fails to restore, and to a fresh random init (with a
+    warning) when no restorable checkpoint exists — the synthetic /
+    smoke-test path.  ``info`` (optional dict) receives ``step`` (the
+    step actually restored, None for random init), ``dir``, and
+    ``fallback`` (True when an earlier step than the newest was used).
     """
     import jax
     import jax.numpy as jnp
@@ -29,6 +41,8 @@ def load_state(cfg, workdir, *, log=print, tag: str = "restore"):
     from deep_vision_tpu.core.optim import build_optimizer
     from deep_vision_tpu.core.state import TrainState
 
+    if info is None:
+        info = {}
     model = cfg.model()
     x = jnp.zeros((1, cfg.image_size, cfg.image_size, cfg.channels))
 
@@ -40,44 +54,60 @@ def load_state(cfg, workdir, *, log=print, tag: str = "restore"):
             tx=build_optimizer(cfg.optimizer),
             batch_stats=variables.get("batch_stats", {}))
 
+    def restore_step(ckpt, step):
+        if ckpt.state_subtree_keys("params", step) == {"stem", "stages"}:
+            # pipeline-trained run (cli.train --mesh ...,pipe=p):
+            # restore the pipelined layout, convert to monolithic
+            # (no monolithic init needed — the merged variables
+            # build the serving state directly)
+            return restore_pipelined(cfg, model, ckpt, x, step=step), \
+                "pipeline layout → monolithic"
+        state = fresh_state()
+        if ckpt.has_state_key("ema_params", step):
+            # serve the averaged copy — the weights eval scored
+            # and the deployment artifact (README: params EMA)
+            state = state.replace(
+                ema_params=jax.tree_util.tree_map(
+                    jnp.array, state.params))
+            state, _ = ckpt.restore(state, step=step)
+            return state.replace(params=state.ema_params), "EMA weights"
+        state, _ = ckpt.restore(state, step=step)
+        return state, ""
+
     for sub in ("checkpoints_best", "checkpoints"):
         d = os.path.join(workdir, sub)
-        if os.path.isdir(d):
-            ckpt = ckpt_lib.Checkpointer(d)
-            if ckpt.latest_step() is not None:
-                if ckpt.state_subtree_keys("params") == {"stem", "stages"}:
-                    # pipeline-trained run (cli.train --mesh ...,pipe=p):
-                    # restore the pipelined layout, convert to monolithic
-                    # (no monolithic init needed — the merged variables
-                    # build the serving state directly)
-                    state = restore_pipelined(cfg, model, ckpt, x)
-                    log(f"[{tag}] restored from {d} step "
-                        f"{ckpt.latest_step()} (pipeline layout → "
-                        f"monolithic)")
-                    break
-                state = fresh_state()
-                if ckpt.has_state_key("ema_params"):
-                    # serve the averaged copy — the weights eval scored
-                    # and the deployment artifact (README: params EMA)
-                    state = state.replace(
-                        ema_params=jax.tree_util.tree_map(
-                            jnp.array, state.params))
-                    state, _ = ckpt.restore(state)
-                    state = state.replace(params=state.ema_params)
-                    log(f"[{tag}] restored from {d} step "
-                        f"{ckpt.latest_step()} (EMA weights)")
-                else:
-                    state, _ = ckpt.restore(state)
-                    log(f"[{tag}] restored from {d} step "
-                        f"{ckpt.latest_step()}")
-                break
-    else:
-        state = fresh_state()
-        log(f"[{tag}] WARNING: no checkpoint found, using random init")
+        if not os.path.isdir(d):
+            continue
+        ckpt = ckpt_lib.Checkpointer(d)
+        steps = sorted(ckpt.all_steps(), reverse=True)
+        for step in steps:
+            try:
+                state, how = restore_step(ckpt, step)
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except Exception as e:  # noqa: BLE001 — corrupt/partial step
+                log(f"[{tag}] WARNING: checkpoint step {step} under {d} "
+                    f"failed to restore ({type(e).__name__}: {e}); "
+                    f"falling back to the previous retained step")
+                continue
+            fallback = step != steps[0]
+            info.update({"step": step, "dir": d, "fallback": fallback})
+            log(f"[{tag}] restored from {d} step {step}"
+                + (f" ({how})" if how else "")
+                + (" [FALLBACK: newer step was corrupt]" if fallback
+                   else ""))
+            return model, state
+        if steps:
+            log(f"[{tag}] WARNING: every retained checkpoint under {d} "
+                f"failed to restore; trying the next source")
+    state = fresh_state()
+    info.update({"step": None, "dir": None, "fallback": False})
+    log(f"[{tag}] WARNING: no restorable checkpoint found, "
+        f"using random init")
     return model, state
 
 
-def restore_pipelined(cfg, model, ckpt, x):
+def restore_pipelined(cfg, model, ckpt, x, step: int | None = None):
     """Restore a pipeline-trained checkpoint (params = {stem, stages})
     and build the monolithic serving state from the converted layout.
     Serves the EMA copy when the run trained with one."""
@@ -98,12 +128,12 @@ def restore_pipelined(cfg, model, ckpt, x):
             f"'{cfg.name}' builds no pipelined family: {e}") from e
     pv = jax.jit(functools.partial(pm.init, train=False))(
         {"params": jax.random.PRNGKey(0)}, x)
-    has_ema = ckpt.has_state_key("ema_params")
+    has_ema = ckpt.has_state_key("ema_params", step)
     pstate = TrainState.create(
         apply_fn=pm.apply, params=pv["params"],
         tx=build_optimizer(cfg.optimizer),
         batch_stats=pv.get("batch_stats", {}), ema=has_ema)
-    pstate, _ = ckpt.restore(pstate)
+    pstate, _ = ckpt.restore(pstate, step=step)
     params = pstate.ema_params if has_ema else pstate.params
     merged = pm.export_monolithic_variables(params, pstate.batch_stats)
     return TrainState.create(

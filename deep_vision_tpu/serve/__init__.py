@@ -1,14 +1,22 @@
 """In-process serving subsystem: dynamic micro-batching with deadlines,
-load shedding, and latency metrics over the training stack's restore path.
+load shedding, fault tolerance, and latency metrics over the training
+stack's restore path.
 
     registry.py   checkpoint / StableHLO blob → ServingModel (donated
                   inputs, device-native unblocked outputs)
     engine.py     pipelined background-thread dynamic batcher: bucketed
                   jit cache, reused staging buffers, bounded in-flight
-                  window, one bulk D2H per batch
+                  window, one bulk D2H per batch; supervised by a
+                  watchdog (thread restarts, exec-timeout fast-fail)
+                  with bisect-retry poison isolation
     admission.py  deadline-aware load shedding + queue-depth bound
-                  (per-bucket exec-time EWMAs)
-    http.py       stdlib HTTP front-end (/v1/classify, /v1/detect, ...)
+                  (per-bucket exec-time EWMAs, Retry-After hints)
+    health.py     heartbeats + the OK → DEGRADED → DEAD state machine
+    faults.py     deterministic fault-injection plane (seeded; enabled
+                  via --faults / DVT_SERVE_FAULTS; chaos suite:
+                  make serve-chaos)
+    http.py       stdlib HTTP front-end (/v1/classify, /v1/detect,
+                  deep /v1/healthz with 503-on-degraded, ...)
 
 Entry point: ``python -m deep_vision_tpu.cli.serve``; load generator:
 ``python bench.py --serve``; architecture notes: docs/SERVING.md.
@@ -16,7 +24,14 @@ Entry point: ``python -m deep_vision_tpu.cli.serve``; load generator:
 
 from deep_vision_tpu.serve.admission import AdmissionController, Shed
 from deep_vision_tpu.serve.engine import BatchingEngine, StagingPool
+from deep_vision_tpu.serve.faults import (
+    FaultPlane,
+    InjectedFault,
+    Quarantined,
+)
+from deep_vision_tpu.serve.health import EngineHealth
 from deep_vision_tpu.serve.registry import ModelRegistry, ServingModel
 
-__all__ = ["AdmissionController", "BatchingEngine", "ModelRegistry",
+__all__ = ["AdmissionController", "BatchingEngine", "EngineHealth",
+           "FaultPlane", "InjectedFault", "ModelRegistry", "Quarantined",
            "ServingModel", "Shed", "StagingPool"]

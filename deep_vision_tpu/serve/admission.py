@@ -29,10 +29,18 @@ import time
 
 @dataclasses.dataclass
 class Shed:
-    """Result delivered to a request the engine refused to execute."""
+    """Result delivered to a request the engine refused to execute.
+
+    ``retry_after_s`` is a hint for the client (surfaced as the HTTP
+    ``Retry-After`` header on 429s): for ``queue_full`` it is the
+    current estimated service time — when the backlog should have
+    drained enough to admit a retry.  Deadline sheds carry no hint (a
+    retry can't make a deadline the first attempt already missed), nor
+    do shutdown sheds (this replica is going away)."""
 
     reason: str          # "queue_full" | "deadline" | "shutdown"
     detail: str = ""
+    retry_after_s: float | None = None
 
     def __bool__(self):  # `if result:` reads as "was served"
         return False
@@ -77,6 +85,14 @@ class AdmissionController:
                 e = self._exec_ewma_s or 0.0
             return self._max_wait_s + (1 + max(0, inflight)) * e
 
+    def bucket_ewma_s(self, bucket: int | None = None) -> float | None:
+        """Raw exec EWMA for ``bucket`` (global fallback, None before
+        any batch has run) — the watchdog's exec-timeout base."""
+        with self._lock:
+            e = self._bucket_ewma_s.get(bucket) if bucket is not None \
+                else None
+            return e if e is not None else self._exec_ewma_s
+
     def admit(self, queue_depth: int, deadline: float | None,
               now: float | None = None, bucket: int | None = None,
               inflight: int = 0) -> Shed | None:
@@ -85,7 +101,9 @@ class AdmissionController:
             with self._lock:
                 self.shed_queue_full += 1
             return Shed("queue_full",
-                        f"queue depth {queue_depth} >= {self.max_queue}")
+                        f"queue depth {queue_depth} >= {self.max_queue}",
+                        retry_after_s=self.estimated_service_s(
+                            bucket, inflight))
         if deadline is not None:
             now = time.monotonic() if now is None else now
             est = self.estimated_service_s(bucket, inflight)

@@ -37,6 +37,32 @@ requests that cannot possibly make their deadline at submit time —
 using a per-bucket execution-time EWMA and the current in-flight depth
 — and the batcher re-checks at batch-formation time so a request that
 expired while queued is dropped rather than executed late.
+
+Fault tolerance (docs/SERVING.md "Failure model & operations"):
+
+  * both worker threads publish **heartbeats** (``serve/health.py``); a
+    **watchdog** thread restarts a dead batcher/drainer (bounded by
+    ``restart_budget``) and fast-fails the in-flight window when a
+    batch's wall age exceeds ``exec_timeout`` = max(floor, k × the
+    bucket's exec EWMA), so a hung device call can't park futures
+    forever;
+  * a dispatched batch that raises doesn't fail all N futures —
+    **bisect-retry** re-executes cohort halves (bounded by
+    ``retry_budget``, exponential backoff) to quarantine the poison
+    request and serve the innocent ones; quarantined requests resolve
+    to a structured ``Quarantined`` result;
+  * failures feed the engine's OK → DEGRADED → DEAD **state machine**
+    (``EngineHealth``), surfaced via ``/v1/healthz`` (503 when not OK)
+    and the ``health`` block in stats;
+  * ``submit`` before ``start()`` / after ``stop()`` fails fast with
+    ``Shed("shutdown")``; ``stop(drain_deadline=...)`` rejects new
+    submits immediately but finishes admitted work up to the deadline;
+  * a deterministic **fault plane** (``serve/faults.py``, enabled via
+    ``--faults`` / ``DVT_SERVE_FAULTS``) injects exceptions, latency,
+    hangs, NaN output, poison requests, and thread deaths at each stage
+    so all of the above is exercised by the chaos suite
+    (``make serve-chaos``) — every injection point guards on
+    ``faults.enabled`` first, keeping the no-faults hot path identical.
 """
 
 from __future__ import annotations
@@ -50,6 +76,13 @@ import numpy as np
 
 from deep_vision_tpu.core.metrics import LatencyHistogram, ThroughputMeter
 from deep_vision_tpu.serve.admission import AdmissionController, Shed
+from deep_vision_tpu.serve.faults import (
+    FaultPlane,
+    InjectedFault,
+    KillThread,
+    Quarantined,
+)
+from deep_vision_tpu.serve.health import EngineHealth
 
 
 def power_of_two_buckets(max_batch: int) -> list[int]:
@@ -63,26 +96,31 @@ def power_of_two_buckets(max_batch: int) -> list[int]:
 
 
 class _Request:
-    __slots__ = ("image", "deadline", "enqueued_at", "future")
+    __slots__ = ("image", "deadline", "enqueued_at", "future", "poison")
 
-    def __init__(self, image, deadline, enqueued_at, future):
+    def __init__(self, image, deadline, enqueued_at, future, poison=False):
         self.image = image
         self.deadline = deadline
         self.enqueued_at = enqueued_at
         self.future = future
+        self.poison = poison
 
 
 class _Inflight:
     """One dispatched batch awaiting its bulk D2H + scatter."""
 
-    __slots__ = ("requests", "bucket", "out", "buffer", "dispatched_at")
+    __slots__ = ("requests", "bucket", "out", "buffer", "dispatched_at",
+                 "cancelled", "cancel")
 
-    def __init__(self, requests, bucket, out, buffer, dispatched_at):
+    def __init__(self, requests, bucket, out, buffer, dispatched_at,
+                 cancel=None):
         self.requests = requests
         self.bucket = bucket
         self.out = out
         self.buffer = buffer
         self.dispatched_at = dispatched_at
+        self.cancelled = False   # watchdog fast-failed this window
+        self.cancel = cancel     # Event breaking injected hangs (faults on)
 
 
 class StagingPool:
@@ -125,20 +163,39 @@ class BatchingEngine:
     """Pipelined dynamic batcher for one ServingModel.
 
     Use as a context manager or call ``start()``/``stop()``.  ``submit``
-    returns a ``concurrent.futures.Future`` resolving to either the
-    output pytree row (numpy, host-side) for that image or a ``Shed``;
-    ``infer`` is the blocking convenience wrapper.
+    returns a ``concurrent.futures.Future`` resolving to the output
+    pytree row (numpy, host-side) for that image, a ``Shed``, or a
+    ``Quarantined``; ``infer`` is the blocking convenience wrapper.
 
     ``pipeline_depth`` bounds dispatched-but-undrained batches: depth 1
     is the strictly synchronous path (complete inline, no drainer
     thread); depth ≥ 2 overlaps batch N+1's formation/staging/H2D with
     batch N's device compute.
+
+    Supervision knobs (all off the hot path — see module docstring):
+    ``watchdog_interval_s`` (0 disables the watchdog), ``restart_budget``
+    (thread restarts before the engine goes sticky-DEAD),
+    ``exec_timeout_k``/``exec_timeout_min_s`` (stuck-batch fast-fail),
+    ``retry_budget``/``singleton_retries``/``retry_backoff_ms``
+    (bisect-retry isolation), ``degraded_after``/``dead_after`` (state
+    machine thresholds), ``faults`` (injection plane; defaults to the
+    ``DVT_SERVE_FAULTS`` env spec, disabled when unset).
     """
 
     def __init__(self, model, *, max_batch: int = 32,
                  max_wait_ms: float = 5.0, buckets: list[int] | None = None,
                  admission: AdmissionController | None = None,
-                 pipeline_depth: int = 2):
+                 pipeline_depth: int = 2,
+                 faults: FaultPlane | None = None,
+                 watchdog_interval_s: float = 0.05,
+                 restart_budget: int = 3,
+                 exec_timeout_k: float = 10.0,
+                 exec_timeout_min_s: float = 2.0,
+                 retry_budget: int = 16,
+                 singleton_retries: int = 1,
+                 retry_backoff_ms: float = 2.0,
+                 retry_backoff_max_ms: float = 100.0,
+                 degraded_after: int = 1, dead_after: int = 5):
         self.model = model
         if model.fixed_batch is not None:
             # a StableHLO blob serves exactly its traced shape
@@ -153,16 +210,33 @@ class BatchingEngine:
         self.latency = LatencyHistogram()
         self.throughput = ThroughputMeter(warmup_steps=1)
         self.staging = StagingPool(model.input_shape)
+        self.faults = faults or FaultPlane.from_env()
+        self.health = EngineHealth(degraded_after=degraded_after,
+                                   dead_after=dead_after)
+        self.watchdog_interval_s = watchdog_interval_s
+        self.restart_budget = restart_budget
+        self.exec_timeout_k = exec_timeout_k
+        self.exec_timeout_min_s = exec_timeout_min_s
+        self.retry_budget = retry_budget
+        self.singleton_retries = singleton_retries
+        self.retry_backoff_ms = retry_backoff_ms
+        self.retry_backoff_max_ms = retry_backoff_max_ms
+        # NaN-output validation only costs when the fault plane is live
+        self._validate = self.faults.enabled
         self._queue: queue.Queue[_Request] = queue.Queue()
         self._executables: dict = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
+        self._accepting = False
         self._thread: threading.Thread | None = None
         self._drainer: threading.Thread | None = None
+        self._watchdog: threading.Thread | None = None
         # in-flight window: acquired at dispatch, released after drain
         self._inflight_sem = threading.BoundedSemaphore(self.pipeline_depth)
         self._inflight_q: queue.Queue[_Inflight | None] = queue.Queue()
         self._inflight = 0
+        self._forming = 0  # requests the batcher holds but hasn't dispatched
+        self._inflight_recs: list[_Inflight] = []  # watchdog visibility
         self.max_inflight = 0
         self.submitted = 0
         self.served = 0
@@ -171,6 +245,12 @@ class BatchingEngine:
         self.padded_images = 0
         self.bulk_transfers = 0
         self.bulk_transfer_bytes = 0
+        # fault-tolerance accounting
+        self.batch_failures = 0
+        self.retry_executions = 0
+        self.quarantined = 0
+        self.exec_timeouts = 0
+        self.shed_shutdown = 0
         # device-idle accounting (host proxy: wall time with an EMPTY
         # in-flight window between the first dispatch and the last drain)
         self._first_dispatch: float | None = None
@@ -182,6 +262,8 @@ class BatchingEngine:
     def start(self) -> "BatchingEngine":
         if self._thread is None:
             self._stop.clear()
+            self.faults.cancel.clear()
+            self.health.revive()
             self._thread = threading.Thread(
                 target=self._loop, name=f"batcher-{self.model.name}",
                 daemon=True)
@@ -191,10 +273,34 @@ class BatchingEngine:
                     target=self._drain_loop,
                     name=f"drainer-{self.model.name}", daemon=True)
                 self._drainer.start()
+            if self.watchdog_interval_s > 0:
+                self._watchdog = threading.Thread(
+                    target=self._watchdog_loop,
+                    name=f"watchdog-{self.model.name}", daemon=True)
+                self._watchdog.start()
+            self._accepting = True
         return self
 
-    def stop(self, timeout: float = 5.0):
+    def stop(self, timeout: float = 5.0,
+             drain_deadline: float | None = None):
+        """Stop the engine.  New submits fail fast immediately; with a
+        ``drain_deadline`` (seconds) admitted work is finished first —
+        whatever hasn't completed by the deadline sheds as shutdown."""
+        self._accepting = False
+        if drain_deadline is not None and self._thread is not None:
+            t_end = time.monotonic() + drain_deadline
+            while time.monotonic() < t_end:
+                with self._lock:
+                    busy = self._inflight
+                if busy == 0 and self._forming == 0 \
+                        and self._queue.qsize() == 0:
+                    break
+                time.sleep(0.005)
         self._stop.set()
+        self.faults.cancel.set()  # release any injected hang
+        if self._watchdog is not None:
+            self._watchdog.join(timeout)
+            self._watchdog = None
         if self._thread is not None:
             self._thread.join(timeout)
             self._thread = None
@@ -210,7 +316,8 @@ class BatchingEngine:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
-            req.future.set_result(Shed("shutdown", "engine stopped"))
+            if not req.future.done():
+                req.future.set_result(Shed("shutdown", "engine stopped"))
 
     def __enter__(self):
         return self.start()
@@ -229,13 +336,23 @@ class BatchingEngine:
     # -- request path ------------------------------------------------------
 
     def submit(self, image, deadline_ms: float | None = None) -> Future:
+        fut: Future = Future()
+        if not self._accepting:
+            # fail fast: nothing drains the queue before start()/after
+            # stop(), so enqueueing would park the future forever
+            with self._lock:
+                self.submitted += 1
+                self.shed_shutdown += 1
+            fut.set_result(Shed(
+                "shutdown", "engine is not accepting requests "
+                            "(stopped or not started)"))
+            return fut
         now = time.monotonic()
         deadline = now + deadline_ms / 1e3 if deadline_ms is not None \
             else None
         with self._lock:
             self.submitted += 1
             inflight = self._inflight
-        fut: Future = Future()
         depth = self._queue.qsize()
         shed = self.admission.admit(
             depth, deadline, now,
@@ -244,8 +361,9 @@ class BatchingEngine:
         if shed is not None:
             fut.set_result(shed)
             return fut
+        poison = self.faults.mark_poison() if self.faults.enabled else False
         self._queue.put(_Request(np.asarray(image, np.float32), deadline,
-                                 now, fut))
+                                 now, fut, poison))
         return fut
 
     def infer(self, image, deadline_ms: float | None = None,
@@ -255,27 +373,42 @@ class BatchingEngine:
     # -- batcher thread (stage + dispatch) ---------------------------------
 
     def _loop(self):
-        while not self._stop.is_set():
-            try:
-                first = self._queue.get(timeout=0.05)
-            except queue.Empty:
-                continue
-            batch = [first]
-            drain_until = time.monotonic() + self.max_wait_s
-            while len(batch) < self.max_batch:
-                remaining = drain_until - time.monotonic()
-                if remaining <= 0:
-                    break
+        try:
+            while not self._stop.is_set():
+                self.health.beat("batcher")
+                if self.faults.enabled:
+                    self.faults.inject("batcher", stop=self._stop)
                 try:
-                    batch.append(self._queue.get(timeout=remaining))
+                    first = self._queue.get(timeout=0.05)
                 except queue.Empty:
-                    break
-            try:
-                self._dispatch(batch)
-            except Exception as e:  # deliver, don't kill the batcher
-                for req in batch:
-                    if not req.future.done():
-                        req.future.set_exception(e)
+                    continue
+                # non-zero while requests are in hand but not yet in the
+                # in-flight window, so stop(drain_deadline=...) can't
+                # slip between queue drain and dispatch
+                self._forming = 1
+                try:
+                    batch = [first]
+                    drain_until = time.monotonic() + self.max_wait_s
+                    while len(batch) < self.max_batch:
+                        remaining = drain_until - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        try:
+                            batch.append(
+                                self._queue.get(timeout=remaining))
+                        except queue.Empty:
+                            break
+                    try:
+                        self._dispatch(batch)
+                    except Exception as e:  # deliver, don't kill batcher
+                        for req in batch:
+                            if not req.future.done():
+                                req.future.set_exception(e)
+                        self.health.record_failure()
+                finally:
+                    self._forming = 0
+        except KillThread:
+            return  # injected death: the watchdog notices and restarts
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -295,6 +428,7 @@ class BatchingEngine:
     def _acquire_slot(self) -> bool:
         """Block until an in-flight slot frees (or the engine stops)."""
         while not self._stop.is_set():
+            self.health.beat("batcher")
             if self._inflight_sem.acquire(timeout=0.05):
                 return True
         return False
@@ -319,16 +453,33 @@ class BatchingEngine:
                 req.future.set_result(Shed("shutdown", "engine stopped"))
             return
         buf = self.staging.acquire(bucket)
-        for i, req in enumerate(live):
-            buf[i] = req.image
-        if n < bucket:
-            buf[n:] = 0.0  # reused buffer: clear stale pad rows
-        t0 = time.monotonic()
-        # async H2D + dispatch: jax returns device futures immediately;
-        # the staged buffer stays checked out until the drainer is done
-        # with the batch, so the transfer may read it at its leisure
-        out = fn(jax.device_put(buf))
-        rec = _Inflight(live, bucket, out, buf, t0)
+        try:
+            if self.faults.enabled:
+                self.faults.inject("staging", stop=self._stop)
+            for i, req in enumerate(live):
+                buf[i] = req.image
+            if n < bucket:
+                buf[n:] = 0.0  # reused buffer: clear stale pad rows
+            t0 = time.monotonic()
+            if self.faults.enabled:
+                self.faults.inject("dispatch", stop=self._stop)
+                self.faults.inject("compute", stop=self._stop)
+                if self.faults.cohort_poisoned(live):
+                    raise InjectedFault(
+                        f"poisoned request in cohort of {n}")
+            # async H2D + dispatch: jax returns device futures
+            # immediately; the staged buffer stays checked out until the
+            # drainer is done with the batch, so the transfer may read
+            # it at its leisure
+            out = fn(jax.device_put(buf))
+        except Exception as e:
+            # dispatch-side batch failure: free the slot, then isolate
+            self.staging.release(bucket, buf)
+            self._inflight_sem.release()
+            self._cohort_failed(live, e)
+            return
+        rec = _Inflight(live, bucket, out, buf, t0,
+                        threading.Event() if self.faults.enabled else None)
         with self._lock:
             if self._inflight == 0 and self._last_done is not None:
                 self._idle_s += t0 - self._last_done
@@ -336,6 +487,7 @@ class BatchingEngine:
                 self._first_dispatch = t0
             self._inflight += 1
             self.max_inflight = max(self.max_inflight, self._inflight)
+            self._inflight_recs.append(rec)
         if self.pipeline_depth > 1:
             self._inflight_q.put(rec)
         else:
@@ -344,32 +496,56 @@ class BatchingEngine:
     # -- drainer thread (bulk D2H + scatter) -------------------------------
 
     def _drain_loop(self):
-        while True:
-            rec = self._inflight_q.get()
-            if rec is None:
-                return
-            self._finish(rec)
+        try:
+            while True:
+                self.health.beat("drainer")
+                try:
+                    rec = self._inflight_q.get(timeout=0.25)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        return
+                    continue
+                if rec is None:
+                    if self._stop.is_set():
+                        return  # shutdown sentinel
+                    continue  # stale sentinel from a previous stop
+                self._finish(rec)
+        except KillThread:
+            return  # injected death: the watchdog notices and restarts
 
     def _finish(self, rec: _Inflight):
         try:
             self._complete(rec)
         except Exception as e:
-            for req in rec.requests:
-                if not req.future.done():
-                    req.future.set_exception(e)
+            self._cohort_failed(rec.requests, e)
         finally:
             self.staging.release(rec.bucket, rec.buffer)
             with self._lock:
                 self._inflight -= 1
+                try:
+                    self._inflight_recs.remove(rec)
+                except ValueError:
+                    pass
                 self._last_done = time.monotonic()
             self._inflight_sem.release()
 
     def _complete(self, rec: _Inflight):
         import jax
 
+        mode = None
+        if self.faults.enabled:
+            mode = self.faults.inject("d2h", stop=self._stop,
+                                      cancel=rec.cancel)
         # ONE bulk D2H for the whole output pytree — not a device slice
         # + transfer per request per leaf
         host = jax.device_get(rec.out)
+        if mode == "nan":
+            host = jax.tree_util.tree_map(
+                lambda a: np.full_like(np.asarray(a), np.nan), host)
+        if self._validate:
+            self._check_outputs(host)
+        if rec.cancelled:
+            return  # watchdog already fast-failed these futures
         t_done = time.monotonic()
         n = len(rec.requests)
         # per-batch device occupancy ≈ completion minus the later of its
@@ -390,10 +566,227 @@ class BatchingEngine:
         self.throughput.update(n)
         for i, req in enumerate(rec.requests):
             self.latency.record(t_done - req.enqueued_at)
-            req.future.set_result(
-                jax.tree_util.tree_map(lambda a: np.asarray(a)[i], host))
+            if not req.future.done():
+                req.future.set_result(
+                    jax.tree_util.tree_map(lambda a: np.asarray(a)[i],
+                                           host))
+        self.health.record_success(t_done)
+
+    @staticmethod
+    def _check_outputs(host):
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(host):
+            arr = np.asarray(leaf)
+            if arr.dtype.kind == "f" and np.isnan(arr).any():
+                raise InjectedFault("NaN in model output")
+
+    # -- batch-failure isolation (bisect-retry) ----------------------------
+
+    def _cohort_failed(self, requests: list[_Request], err: Exception):
+        """A dispatched or drained cohort raised: record the failure,
+        then bisect-retry to quarantine the poison request(s) and serve
+        the innocent ones.  Runs synchronously in the failing thread —
+        off the happy path, bounded by ``retry_budget``."""
+        with self._lock:
+            self.batch_failures += 1
+        self.health.record_failure()
+        pending = [r for r in requests if not r.future.done()]
+        if not pending:
+            return
+        budget = [self.retry_budget]
+        self._isolate(pending, err, budget)
+
+    def _backoff(self, budget: list[int]):
+        attempt = self.retry_budget - budget[0]
+        delay_ms = min(self.retry_backoff_max_ms,
+                       self.retry_backoff_ms * (2 ** max(0, attempt)))
+        if delay_ms > 0:
+            time.sleep(delay_ms / 1e3)
+
+    def _isolate(self, cohort: list[_Request], err: Exception,
+                 budget: list[int]):
+        if self._stop.is_set():
+            for r in cohort:
+                if not r.future.done():
+                    r.future.set_result(Shed("shutdown", "engine stopped"))
+            return
+        if len(cohort) == 1:
+            # transient benefit of the doubt before quarantining
+            for _ in range(self.singleton_retries):
+                if budget[0] <= 0:
+                    break
+                self._backoff(budget)
+                budget[0] -= 1
+                try:
+                    self._execute_subset(cohort)
+                    return
+                except Exception as e:  # noqa: BLE001 — keep isolating
+                    err = e
+            self._quarantine(cohort[0], err, exhausted=False)
+            return
+        mid = len(cohort) // 2
+        for sub in (cohort[:mid], cohort[mid:]):
+            if budget[0] <= 0:
+                for r in sub:
+                    self._quarantine(r, err, exhausted=True)
+                continue
+            self._backoff(budget)
+            budget[0] -= 1
+            try:
+                self._execute_subset(sub)
+            except Exception as e:  # noqa: BLE001 — keep bisecting
+                self._isolate(sub, e, budget)
+
+    def _quarantine(self, req: _Request, err: Exception, exhausted: bool):
+        with self._lock:
+            self.quarantined += 1
+        if not req.future.done():
+            req.future.set_result(Quarantined(
+                "retry_budget" if exhausted else "poison",
+                f"{type(err).__name__}: {err}"))
+
+    def _execute_subset(self, requests: list[_Request]):
+        """Synchronous re-execution of a retry cohort: own staging
+        buffer, inline D2H — deliberately outside the pipeline window so
+        retries can't wedge the happy path."""
+        import jax
+
+        with self._lock:
+            self.retry_executions += 1
+        n = len(requests)
+        bucket = self._bucket_for(n)
+        fn = self._compiled(bucket)
+        buf = self.staging.acquire(bucket)
+        try:
+            for i, req in enumerate(requests):
+                buf[i] = req.image
+            if n < bucket:
+                buf[n:] = 0.0
+            if self.faults.enabled:
+                self.faults.inject("compute", stop=self._stop)
+                if self.faults.cohort_poisoned(requests):
+                    raise InjectedFault(
+                        f"poisoned request in retry cohort of {n}")
+            host = jax.device_get(fn(jax.device_put(buf)))
+            if self._validate:
+                self._check_outputs(host)
+        finally:
+            self.staging.release(bucket, buf)
+        t_done = time.monotonic()
+        nbytes = int(sum(np.asarray(a).nbytes
+                         for a in jax.tree_util.tree_leaves(host)))
+        with self._lock:
+            self.batches += 1
+            self.served += n
+            self.padded_images += bucket - n
+            self.bulk_transfers += 1
+            self.bulk_transfer_bytes += nbytes
+        self.throughput.update(n)
+        for i, req in enumerate(requests):
+            self.latency.record(t_done - req.enqueued_at)
+            if not req.future.done():
+                req.future.set_result(
+                    jax.tree_util.tree_map(lambda a: np.asarray(a)[i],
+                                           host))
+        self.health.record_success(t_done)
+
+    # -- watchdog thread (supervision) -------------------------------------
+
+    def _watchdog_loop(self):
+        while not self._stop.is_set():
+            time.sleep(self.watchdog_interval_s)
+            if self._stop.is_set():
+                return
+            try:
+                self._watchdog_tick(time.monotonic())
+            except Exception:  # noqa: BLE001 — the supervisor never dies
+                pass
+
+    def _watchdog_tick(self, now: float):
+        t = self._thread
+        if t is not None and not t.is_alive():
+            self._restart("batcher")
+        d = self._drainer
+        if self.pipeline_depth > 1 and d is not None and not d.is_alive():
+            self._restart("drainer")
+        # stuck compute: any in-flight batch older than its exec budget
+        with self._lock:
+            recs = [r for r in self._inflight_recs if not r.cancelled]
+        for rec in recs:
+            ewma = self.admission.bucket_ewma_s(rec.bucket)
+            limit = self.exec_timeout_min_s if not ewma else \
+                max(self.exec_timeout_min_s, self.exec_timeout_k * ewma)
+            if now - rec.dispatched_at > limit:
+                self._fail_inflight_window(now - rec.dispatched_at, limit)
+                break
+
+    def _restart(self, which: str):
+        if self._stop.is_set():
+            return
+        self.health.record_failure()
+        if self.health.watchdog_restarts >= self.restart_budget:
+            self.health.force_dead(
+                f"{which} died and the restart budget "
+                f"({self.restart_budget}) is exhausted")
+            return
+        self.health.record_restart()
+        thread = threading.Thread(
+            target=self._loop if which == "batcher" else self._drain_loop,
+            name=f"{which}-{self.model.name}", daemon=True)
+        if which == "batcher":
+            self._thread = thread
+        else:
+            self._drainer = thread
+        thread.start()
+
+    def _fail_inflight_window(self, age_s: float, limit_s: float):
+        """A batch exceeded its exec timeout: fail every in-flight
+        future fast so callers aren't parked behind a hung device call.
+        The drainer's eventual result for a cancelled record is
+        discarded (``rec.cancelled``); injected hangs are released via
+        each record's cancel event."""
+        with self._lock:
+            recs = [r for r in self._inflight_recs if not r.cancelled]
+            for rec in recs:
+                rec.cancelled = True
+            self.exec_timeouts += 1
+        if not recs:
+            return
+        self.health.record_failure()
+        err = TimeoutError(
+            f"in-flight batch exceeded exec timeout: age {age_s * 1e3:.0f}"
+            f"ms > limit {limit_s * 1e3:.0f}ms; failing the window fast")
+        for rec in recs:
+            if rec.cancel is not None:
+                rec.cancel.set()
+            for req in rec.requests:
+                if not req.future.done():
+                    req.future.set_exception(err)
 
     # -- observability -----------------------------------------------------
+
+    def health_report(self) -> dict:
+        now = time.monotonic()
+        rep = self.health.report(now)
+        t, d = self._thread, self._drainer
+        rep["batcher_alive"] = bool(t is not None and t.is_alive())
+        rep["drainer_alive"] = bool(d is not None and d.is_alive()) \
+            if self.pipeline_depth > 1 else None
+        rep["accepting"] = self._accepting
+        with self._lock:
+            rep["inflight"] = self._inflight
+            rep["batch_failures"] = self.batch_failures
+            rep["retry_executions"] = self.retry_executions
+            rep["quarantined"] = self.quarantined
+            rep["exec_timeouts"] = self.exec_timeouts
+            rep["shed_shutdown"] = self.shed_shutdown
+            done = self._last_done
+        rep["last_batch_age_s"] = round(now - done, 4) \
+            if done is not None else None
+        if self.faults.enabled:
+            rep["faults"] = self.faults.stats()
+        return rep
 
     def stats(self) -> dict:
         with self._lock:
@@ -426,4 +819,5 @@ class BatchingEngine:
         out["latency"] = self.latency.percentiles()
         out["img_per_sec"] = self.throughput.images_per_sec
         out["admission"] = self.admission.stats()
+        out["health"] = self.health_report()
         return out

@@ -2,13 +2,17 @@
 
 Routes (JSON in, JSON out):
 
-    GET  /v1/healthz   liveness + served model names
+    GET  /v1/healthz   DEEP health: per-engine thread liveness,
+                       heartbeat ages, last-completed-batch age,
+                       consecutive failures, and the OK → DEGRADED →
+                       DEAD state machine — 503 when any engine is
+                       DEGRADED/DEAD so load balancers drain traffic,
+                       200 again after recovery
     GET  /v1/stats     per-model engine stats (latency p50/p95/p99,
                        throughput, shed counts, compile/bucket state,
-                       and the pipelined executor's overlap block:
-                       depth, in-flight high-water mark, device-idle
-                       fraction, staged-buffer reuse, bulk D2H
-                       transfer count/bytes, per-bucket exec EWMAs)
+                       the pipelined executor's overlap block, and the
+                       ``health`` block: state, failures, retries,
+                       quarantines, watchdog restarts)
     POST /v1/classify  {"pixels": [[...]] | "image_b64": "...",
                         "model"?, "deadline_ms"?, "top_k"?}
     POST /v1/detect    same inputs + "score_threshold"?; YOLO models
@@ -17,7 +21,10 @@ Image payloads: ``pixels`` is a preprocessed (H, W, C) float array (the
 machine-to-machine path, and what the tests/smoke use); ``image_b64`` is
 a base64-encoded image file decoded + preprocessed server-side exactly
 like ``cli.infer`` (requires PIL).  Shed requests answer 429 with the
-shed reason so clients can retry against another replica.
+shed reason (queue-full sheds add a ``Retry-After`` header) so clients
+can retry against another replica; quarantined (poison) requests answer
+500 with the isolation detail.  Bodies over ``max_body_bytes`` (default
+32 MiB) are rejected 413 before any buffer is allocated.
 """
 
 from __future__ import annotations
@@ -25,14 +32,19 @@ from __future__ import annotations
 import base64
 import io
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+DEFAULT_MAX_BODY_BYTES = 32 * 2**20
+
 
 class ServeError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: dict | None = None):
         super().__init__(message)
         self.status = status
+        self.headers = headers
 
 
 def _decode_pixels(body: dict, model):
@@ -87,11 +99,14 @@ class _Handler(BaseHTTPRequestHandler):
         if self.server.verbose:  # type: ignore[attr-defined]
             super().log_message(fmt, *args)
 
-    def _reply(self, status: int, payload: dict):
+    def _reply(self, status: int, payload: dict,
+               headers: dict | None = None):
         blob = json.dumps(payload).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(blob)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(blob)
 
@@ -99,6 +114,14 @@ class _Handler(BaseHTTPRequestHandler):
         length = int(self.headers.get("Content-Length") or 0)
         if length <= 0:
             raise ServeError(400, "empty body")
+        cap = getattr(self.server, "max_body_bytes",
+                      DEFAULT_MAX_BODY_BYTES)
+        if length > cap:
+            # reject BEFORE allocating an attacker-sized buffer; the
+            # connection is closed (the unread body would desync keep-alive)
+            self.close_connection = True
+            raise ServeError(
+                413, f"body of {length} bytes exceeds the {cap}-byte cap")
         try:
             return json.loads(self.rfile.read(length))
         except json.JSONDecodeError as e:
@@ -114,20 +137,37 @@ class _Handler(BaseHTTPRequestHandler):
     def _infer_row(self, body: dict):
         """Shared classify/detect request path: decode → engine → row."""
         model, engine = self._engine(body)
+        if engine.faults.enabled:
+            engine.faults.inject("decode")
         x = _decode_pixels(body, model)
         result = engine.infer(x, deadline_ms=body.get("deadline_ms"))
         from deep_vision_tpu.serve.admission import Shed
+        from deep_vision_tpu.serve.faults import Quarantined
 
         if isinstance(result, Shed):
-            raise ServeError(429, f"shed: {result.reason} {result.detail}")
+            headers = None
+            if result.retry_after_s:
+                headers = {"Retry-After":
+                           max(1, math.ceil(result.retry_after_s))}
+            raise ServeError(429, f"shed: {result.reason} {result.detail}",
+                             headers=headers)
+        if isinstance(result, Quarantined):
+            raise ServeError(
+                500, f"quarantined: {result.reason} {result.detail}")
         return model, result
 
     # -- routes ------------------------------------------------------------
 
     def do_GET(self):
         if self.path == "/v1/healthz":
-            self._reply(200, {"status": "ok",
-                              "models": self.server.registry.names()})
+            engines = self.server.engines
+            reports = {name: eng.health_report()
+                       for name, eng in engines.items()}
+            healthy = all(r["state"] == "ok" for r in reports.values())
+            self._reply(200 if healthy else 503,
+                        {"status": "ok" if healthy else "unhealthy",
+                         "models": self.server.registry.names(),
+                         "engines": reports})
         elif self.path == "/v1/stats":
             self._reply(200, {name: eng.stats()
                               for name, eng in self.server.engines.items()})
@@ -144,7 +184,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
         except ServeError as e:
-            self._reply(e.status, {"error": str(e)})
+            self._reply(e.status, {"error": str(e)}, headers=e.headers)
         except Exception as e:  # noqa: BLE001 — surface, don't kill worker
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
 
@@ -191,11 +231,13 @@ class ServeServer:
     """ThreadingHTTPServer wired to a registry + one engine per model."""
 
     def __init__(self, registry, engines: dict, host: str = "127.0.0.1",
-                 port: int = 0, verbose: bool = False):
+                 port: int = 0, verbose: bool = False,
+                 max_body_bytes: int = DEFAULT_MAX_BODY_BYTES):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.registry = registry
         self.httpd.engines = engines
         self.httpd.verbose = verbose
+        self.httpd.max_body_bytes = max_body_bytes
         self._thread: threading.Thread | None = None
 
     @property

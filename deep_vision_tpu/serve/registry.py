@@ -52,6 +52,11 @@ class ServingModel:
         # StableHLO blobs are traced at one batch shape; checkpoint-backed
         # models compile any bucket (None = unconstrained)
         self.fixed_batch = fixed_batch
+        # which checkpoint step the weights came from (None = random
+        # init) and whether restore fell back past a corrupt newer step
+        # — set by the registry loaders, surfaced in describe()
+        self.restored_step: int | None = None
+        self.restore_fallback = False
 
     def compile_bucket(self, batch: int):
         raise NotImplementedError
@@ -61,7 +66,9 @@ class ServingModel:
                 "input_shape": list(self.input_shape),
                 "num_classes": self.num_classes,
                 "fixed_batch": self.fixed_batch,
-                "donates_inputs": self.donates_inputs}
+                "donates_inputs": self.donates_inputs,
+                "restored_step": self.restored_step,
+                "restore_fallback": self.restore_fallback}
 
 
 class CheckpointServingModel(ServingModel):
@@ -158,9 +165,12 @@ class ModelRegistry:
         from deep_vision_tpu.core.restore import load_state
 
         cfg = get_config(config_name)
-        model, state = load_state(cfg, workdir, tag="serve")
-        return self.add(CheckpointServingModel(
-            name or config_name, cfg, model, state))
+        info: dict = {}
+        model, state = load_state(cfg, workdir, tag="serve", info=info)
+        sm = CheckpointServingModel(name or config_name, cfg, model, state)
+        sm.restored_step = info.get("step")
+        sm.restore_fallback = bool(info.get("fallback"))
+        return self.add(sm)
 
     def load_exported(self, config_name: str, blob_path: str, workdir: str,
                       name: str | None = None) -> ServingModel:
@@ -175,7 +185,8 @@ class ModelRegistry:
         from deep_vision_tpu.core.restore import load_state
 
         cfg = get_config(config_name)
-        _, state = load_state(cfg, workdir, tag="serve")
+        info: dict = {}
+        _, state = load_state(cfg, workdir, tag="serve", info=info)
         variables = {"params": state.params}
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
@@ -183,8 +194,11 @@ class ModelRegistry:
         # the image input is the final positional arg, hence the last
         # flattened aval (variables dict leaves sort first)
         fixed_batch = int(call.in_avals[-1].shape[0])
-        return self.add(ExportedServingModel(
-            name or config_name, cfg, call, variables, fixed_batch))
+        sm = ExportedServingModel(
+            name or config_name, cfg, call, variables, fixed_batch)
+        sm.restored_step = info.get("step")
+        sm.restore_fallback = bool(info.get("fallback"))
+        return self.add(sm)
 
     def get(self, name: str | None = None) -> ServingModel:
         if name is None:

@@ -1,11 +1,13 @@
 """`make serve-smoke`: boot the real HTTP server wiring on a random port
 against a LeNet/MNIST workdir fixture, issue one /v1/classify request,
-assert a 200 — once on the synchronous path (pipeline_depth=1) and once
-on the pipelined executor (depth=2, the production default), asserting
-the pipelined run's scatter did exactly one bulk D2H per batch.
-Exercises exactly the `python -m deep_vision_tpu.cli.serve` path
-(cli.serve.build_server), just without serve_forever in the foreground —
-run directly, not under pytest."""
+assert a 200 — once on the synchronous path (pipeline_depth=1), once on
+the pipelined executor (depth=2, the production default; asserting the
+scatter did exactly one bulk D2H per batch), and once with an injected
+transient compute failure (the request must still answer 200 through
+bisect-retry and deep health must settle back to OK).  Exercises exactly
+the `python -m deep_vision_tpu.cli.serve` path (cli.serve.build_server),
+just without serve_forever in the foreground — run directly, not under
+pytest."""
 
 import argparse
 import json
@@ -21,7 +23,7 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def smoke_one(pipeline_depth: int) -> None:
+def smoke_one(pipeline_depth: int, faults: str = "") -> None:
     from deep_vision_tpu.cli.serve import build_server
 
     with tempfile.TemporaryDirectory() as workdir:
@@ -31,39 +33,58 @@ def smoke_one(pipeline_depth: int) -> None:
             model="lenet5", workdir=workdir, stablehlo=None,
             host="127.0.0.1", port=0, max_batch=4, max_wait_ms=2.0,
             buckets=None, max_queue=64, warmup=False, verbose=False,
-            pipeline_depth=pipeline_depth)
+            pipeline_depth=pipeline_depth, faults=faults, fault_seed=0)
         engine, server = build_server(args)
         server.start_background()
+        base = f"http://{server.host}:{server.port}"
         try:
+            with urllib.request.urlopen(base + "/v1/healthz",
+                                        timeout=60) as r:
+                health = json.loads(r.read())
+                assert r.status == 200 and health["status"] == "ok", health
+                rep = health["engines"]["lenet5"]
+                assert rep["batcher_alive"] and rep["accepting"], rep
             body = json.dumps(
                 {"pixels": np.zeros((32, 32, 1)).tolist()}).encode()
             req = urllib.request.Request(
-                f"http://{server.host}:{server.port}/v1/classify",
-                data=body, headers={"Content-Type": "application/json"})
+                base + "/v1/classify", data=body,
+                headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=60) as r:
                 assert r.status == 200, f"expected 200, got {r.status}"
                 top = json.loads(r.read())["top"]
                 assert len(top) == 5, top
-            with urllib.request.urlopen(
-                    f"http://{server.host}:{server.port}/v1/stats",
-                    timeout=60) as r:
+            with urllib.request.urlopen(base + "/v1/stats",
+                                        timeout=60) as r:
                 stats = json.loads(r.read())["lenet5"]
             pipe = stats["pipeline"]
             assert pipe["depth"] == pipeline_depth, pipe
             # the scatter contract: ONE bulk D2H per executed batch
             assert pipe["bulk_transfers"] == stats["batches"] >= 1, pipe
-            print(f"serve-smoke PASS (pipeline_depth={pipeline_depth}): "
+            health = stats["health"]
+            assert health["state"] == "ok", health
+            if faults:
+                # the injected failure actually fired AND was recovered
+                # from (bisect-retry re-executed the cohort)
+                assert health["batch_failures"] >= 1, health
+                assert health["retry_executions"] >= 1, health
+                assert health["faults"]["injected"], health
+            print(f"serve-smoke PASS (pipeline_depth={pipeline_depth}"
+                  + (f", faults='{faults}'" if faults else "") + "): "
                   f"200 from port {server.port}, top-1 class "
                   f"{top[0]['class']}, {pipe['bulk_transfers']} bulk "
-                  f"transfer(s) for {stats['batches']} batch(es)")
+                  f"transfer(s) for {stats['batches']} batch(es), "
+                  f"health {health['state']}")
         finally:
             server.shutdown()
-            engine.stop()
+            engine.stop(drain_deadline=5.0)
 
 
 def main():
     for depth in (1, 2):
         smoke_one(depth)
+    # fault-injected pass: one transient compute failure — the request
+    # must still answer 200 (bisect-retry), health must settle back OK
+    smoke_one(2, faults="compute:exception:times=1")
     return 0
 
 

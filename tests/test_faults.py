@@ -1,0 +1,387 @@
+"""Chaos suite (CPU, tier-1 fast, deterministic under the fixed seed):
+the serving engine's FAILURE paths are tested paths — crash-only style.
+
+Every scenario drives a real engine through the in-tree fault plane
+(``serve/faults.py``) and asserts the recovery contract:
+
+  * a poisoned request is quarantined by bisect-retry while every
+    innocent cohort member is served the same bits it would have gotten
+    in a clean batch;
+  * a transient batch failure is retried to success and the state
+    machine returns to OK;
+  * ``/v1/healthz`` flips 200 → 503 → 200 around a failure, so a load
+    balancer would drain and readmit this replica at the right moments;
+  * a killed worker thread is restarted by the watchdog and traffic
+    resumes;
+  * a hung drain is fast-failed at the exec timeout instead of parking
+    its futures for the hang's full duration;
+  * lifecycle misuse (submit before start / after stop) fails fast;
+  * oversized HTTP bodies bounce 413 before allocation;
+  * a corrupt newest checkpoint falls back to the previous retained
+    step (``core/restore.py``).
+
+Run alone via ``make serve-chaos`` (``pytest -m chaos``)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.serve.admission import Shed
+from deep_vision_tpu.serve.engine import BatchingEngine
+from deep_vision_tpu.serve.faults import (
+    FaultPlane,
+    InjectedFault,
+    Quarantined,
+    parse_faults,
+)
+from deep_vision_tpu.serve.registry import ModelRegistry
+
+pytestmark = [pytest.mark.serve, pytest.mark.chaos]
+
+
+@pytest.fixture(scope="module")
+def lenet_serving(tmp_path_factory):
+    reg = ModelRegistry()
+    # empty workdir fixture → deterministic PRNGKey(0) random init
+    sm = reg.load_checkpoint(
+        "lenet5", str(tmp_path_factory.mktemp("lenet_workdir")))
+    return reg, sm
+
+
+def _images(n, shape=(32, 32, 1)):
+    return [np.random.RandomState(i).randn(*shape).astype(np.float32)
+            for i in range(n)]
+
+
+def _wait_until(cond, timeout=10.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(interval)
+    return cond()
+
+
+# -- fault plane unit behavior ---------------------------------------------
+
+
+def test_fault_spec_parse():
+    faults = parse_faults(
+        "compute:poison:nth=3;d2h:latency:delay_ms=20;"
+        "batcher:die:times=1:after=2")
+    assert [(f.stage, f.mode) for f in faults] == \
+        [("compute", "poison"), ("d2h", "latency"), ("batcher", "die")]
+    assert faults[0].nth == 3
+    assert faults[1].delay_ms == 20.0
+    assert faults[2].times == 1 and faults[2].after == 2
+    assert parse_faults("") == [] and parse_faults(None) == []
+    for bad in ("compute", "nowhere:exception", "compute:explode",
+                "compute:exception:bogus=1", "compute:exception:times"):
+        with pytest.raises(ValueError):
+            parse_faults(bad)
+
+
+def test_fault_plane_deterministic_under_seed():
+    def firing_pattern(seed):
+        plane = FaultPlane("compute:exception:p=0.5", seed)
+        pattern = []
+        for _ in range(64):
+            try:
+                plane.inject("compute")
+                pattern.append(False)
+            except InjectedFault:
+                pattern.append(True)
+        return pattern
+
+    a, b = firing_pattern(7), firing_pattern(7)
+    assert a == b  # same seed → identical firing sequence
+    assert True in a and False in a  # p=0.5 actually mixes
+    assert firing_pattern(8) != a  # and the seed matters
+
+
+def test_fault_plane_disabled_is_inert():
+    plane = FaultPlane("")
+    assert not plane.enabled
+    assert plane.inject("compute") is None
+    assert plane.mark_poison() is False
+
+
+# -- batch-failure isolation -----------------------------------------------
+
+
+def test_poison_request_quarantined_innocents_served(lenet_serving):
+    """A cohort of 8 with one poison member: bisect-retry converges on
+    exactly the poisoned request; the other 7 get the same bits a clean
+    batch would have produced."""
+    _, sm = lenet_serving
+    imgs = _images(8)
+    with BatchingEngine(sm, buckets=[8], max_wait_ms=250,
+                        faults=FaultPlane("compute:poison:nth=3"),
+                        retry_backoff_ms=0) as eng:
+        futures = [eng.submit(im) for im in imgs]
+        results = [f.result(60) for f in futures]
+    assert isinstance(results[3], Quarantined)
+    assert results[3].reason == "poison"
+    assert not results[3]  # falsy, like Shed: `if result:` = "served"
+    ref = np.asarray(sm.compile_bucket(8)(np.stack(imgs)))
+    for i in (0, 1, 2, 4, 5, 6, 7):
+        assert np.array_equal(np.asarray(results[i]), ref[i]), i
+    assert eng.quarantined == 1
+    assert eng.batch_failures == 1  # ONE original cohort failure
+    assert eng.retry_executions >= 3  # bisection actually bisected
+    assert eng.served == 7
+
+
+def test_transient_failure_retried_to_success(lenet_serving):
+    """One injected compute exception: the split cohorts re-execute
+    cleanly, every request is served, and health returns to OK."""
+    _, sm = lenet_serving
+    imgs = _images(4)
+    with BatchingEngine(sm, buckets=[4], max_wait_ms=250,
+                        faults=FaultPlane("compute:exception:times=1"),
+                        retry_backoff_ms=0) as eng:
+        futures = [eng.submit(im) for im in imgs]
+        results = [f.result(60) for f in futures]
+        report = eng.health_report()
+    ref = np.asarray(sm.compile_bucket(4)(np.stack(imgs)))
+    for i in range(4):
+        assert np.array_equal(np.asarray(results[i]), ref[i]), i
+    assert eng.batch_failures == 1
+    assert eng.retry_executions == 2  # two halves, each clean
+    assert eng.quarantined == 0
+    assert report["state"] == "ok"  # success reset the state machine
+    assert report["faults"]["injected"] == {"compute:exception": 1}
+
+
+# -- deep health over HTTP --------------------------------------------------
+
+
+def test_healthz_flips_200_503_200(lenet_serving):
+    """The load-balancer contract: healthy 200 → failure flips 503
+    (drain me) → first good batch flips back 200 (readmit me)."""
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = lenet_serving
+    eng = BatchingEngine(sm, buckets=[1], max_wait_ms=1,
+                         faults=FaultPlane("compute:exception:times=1"),
+                         degraded_after=1, singleton_retries=0,
+                         retry_backoff_ms=0).start()
+    srv = ServeServer(reg, {sm.name: eng}, port=0).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    body = json.dumps({"pixels": np.zeros((32, 32, 1)).tolist()}).encode()
+
+    def healthz():
+        try:
+            with urllib.request.urlopen(base + "/v1/healthz") as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def classify():
+        req = urllib.request.Request(
+            base + "/v1/classify", data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return r.status
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    try:
+        status, payload = healthz()
+        assert status == 200 and payload["status"] == "ok"
+        # singleton_retries=0: the injected failure quarantines the lone
+        # request (500) and leaves the engine DEGRADED — no success yet
+        assert classify() == 500
+        status, payload = healthz()
+        assert status == 503
+        rep = payload["engines"]["lenet5"]
+        assert rep["state"] == "degraded"
+        assert rep["quarantined"] == 1
+        # the injection is exhausted: the next batch succeeds and the
+        # state machine (and the probe) recover on their own
+        assert classify() == 200
+        status, payload = healthz()
+        assert status == 200
+        assert payload["engines"]["lenet5"]["state"] == "ok"
+    finally:
+        srv.shutdown()
+        eng.stop()
+
+
+# -- watchdog supervision ---------------------------------------------------
+
+
+def test_batcher_killed_then_restarted(lenet_serving):
+    """mode=die kills the batcher thread; the watchdog restarts it and
+    traffic resumes without operator action."""
+    _, sm = lenet_serving
+    img = _images(1)[0]
+    with BatchingEngine(sm, buckets=[1], max_wait_ms=1,
+                        faults=FaultPlane("batcher:die:times=1"),
+                        watchdog_interval_s=0.01) as eng:
+        assert _wait_until(
+            lambda: eng.health.watchdog_restarts >= 1), \
+            "watchdog never restarted the dead batcher"
+        result = eng.infer(img, timeout=60)  # served by the NEW thread
+        assert result is not None and not isinstance(result, Shed)
+        report = eng.health_report()
+    assert report["watchdog_restarts"] >= 1
+    assert report["batcher_alive"]
+    assert report["state"] == "ok"  # the served batch reset the machine
+    assert report["faults"]["injected"] == {"batcher:die": 1}
+
+
+def test_restart_budget_exhaustion_is_sticky_dead(lenet_serving):
+    """A thread that keeps dying burns the restart budget and the engine
+    goes sticky-DEAD — traffic can't revive it, only a stop/start."""
+    _, sm = lenet_serving
+    with BatchingEngine(sm, buckets=[1], max_wait_ms=1,
+                        faults=FaultPlane("batcher:die"),  # every time
+                        watchdog_interval_s=0.01,
+                        restart_budget=2) as eng:
+        assert _wait_until(lambda: eng.health.state == "dead"), \
+            "restart-budget exhaustion never forced DEAD"
+        report = eng.health_report()
+        assert report["watchdog_restarts"] == 2
+        assert "restart budget" in report["dead_reason"]
+
+
+def test_hang_is_fast_failed_at_exec_timeout(lenet_serving):
+    """An injected 30 s hang in the drain path: the watchdog fails the
+    in-flight window at the ~0.2 s exec timeout, so the caller sees a
+    fast TimeoutError — and the next request is served normally."""
+    _, sm = lenet_serving
+    img = _images(1)[0]
+    with BatchingEngine(sm, buckets=[1], max_wait_ms=1, pipeline_depth=2,
+                        faults=FaultPlane("d2h:hang:hang_s=30:times=1"),
+                        watchdog_interval_s=0.02,
+                        exec_timeout_min_s=0.2) as eng:
+        t0 = time.monotonic()
+        fut = eng.submit(img)
+        with pytest.raises(TimeoutError):
+            fut.result(20)
+        assert time.monotonic() - t0 < 5.0  # vastly under the 30 s hang
+        assert eng.exec_timeouts == 1
+        # hang exhausted (times=1): the engine recovers by itself
+        result = eng.infer(img, timeout=60)
+        assert result is not None and not isinstance(result, Shed)
+        assert eng.health_report()["state"] == "ok"
+
+
+# -- lifecycle --------------------------------------------------------------
+
+
+def test_submit_outside_lifecycle_fails_fast(lenet_serving):
+    _, sm = lenet_serving
+    img = _images(1)[0]
+    eng = BatchingEngine(sm, buckets=[1])
+    before = eng.submit(img).result(1)  # before start()
+    assert isinstance(before, Shed) and before.reason == "shutdown"
+    eng.start()
+    assert eng.infer(img, timeout=60) is not None
+    eng.stop()
+    after = eng.submit(img).result(1)  # after stop()
+    assert isinstance(after, Shed) and after.reason == "shutdown"
+    assert eng.shed_shutdown == 2
+
+
+def test_stop_drain_deadline_finishes_admitted_work(lenet_serving):
+    """stop(drain_deadline=...) rejects new submits immediately but
+    serves everything already admitted before tearing down."""
+    _, sm = lenet_serving
+    imgs = _images(4)
+    eng = BatchingEngine(sm, buckets=[4], max_wait_ms=20).start()
+    eng.warmup()
+    futures = [eng.submit(im) for im in imgs]
+    eng.stop(drain_deadline=30.0)
+    results = [f.result(1) for f in futures]  # already resolved
+    assert all(r is not None and not isinstance(r, Shed)
+               for r in results)
+    assert eng.served == 4
+
+
+# -- HTTP body cap ----------------------------------------------------------
+
+
+def test_oversized_body_rejected_413(lenet_serving):
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = lenet_serving
+    eng = BatchingEngine(sm, buckets=[1], max_wait_ms=1).start()
+    srv = ServeServer(reg, {sm.name: eng}, port=0,
+                      max_body_bytes=1024).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        body = b'{"pixels": [' + b"0," * 4096 + b"0]}"
+        req = urllib.request.Request(
+            base + "/v1/classify", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=60)
+        assert exc.value.code == 413
+        # the rejection closed that connection without wedging the
+        # server: a fresh in-cap request still answers
+        with urllib.request.urlopen(base + "/v1/healthz",
+                                    timeout=60) as r:
+            assert r.status == 200
+        assert eng.served == 0  # the oversized body never reached it
+    finally:
+        srv.shutdown()
+        eng.stop()
+
+
+# -- checkpoint restore fallback -------------------------------------------
+
+
+def test_restore_falls_back_past_corrupt_step(tmp_path):
+    """Save steps 1 and 2 with distinguishable params, corrupt step 2 on
+    disk: load_state restores step 1 and reports the fallback."""
+    import os
+
+    import jax
+
+    from deep_vision_tpu.core import checkpoint as ckpt_lib
+    from deep_vision_tpu.core.config import get_config
+    from deep_vision_tpu.core.restore import load_state
+
+    workdir = str(tmp_path / "wd")
+    cfg = get_config("lenet5")
+    logs: list = []
+    _, state = load_state(cfg, workdir, log=logs.append)  # fresh init
+    bumped = state.replace(params=jax.tree_util.tree_map(
+        lambda a: a + 1.0, state.params))
+    ckpt = ckpt_lib.Checkpointer(os.path.join(workdir, "checkpoints"))
+    ckpt.save(1, state)
+    ckpt.save(2, bumped)
+    ckpt.close()
+    # corrupt step 2 in place: garbage in every file, dir still listed
+    step2 = os.path.join(workdir, "checkpoints", "2")
+    for root, _, files in os.walk(step2):
+        for f in files:
+            with open(os.path.join(root, f), "wb") as fh:
+                fh.write(b"\x00corrupt\x00")
+    ckpt2 = ckpt_lib.Checkpointer(os.path.join(workdir, "checkpoints"))
+    assert 2 in ckpt2.all_steps()  # still retained — restore must fail it
+    ckpt2.close()
+
+    info: dict = {}
+    logs.clear()
+    _, restored = load_state(cfg, workdir, log=logs.append, info=info)
+    assert info["step"] == 1
+    assert info["fallback"] is True
+    leaf = jax.tree_util.tree_leaves(restored.params)[0]
+    want = jax.tree_util.tree_leaves(state.params)[0]
+    assert np.allclose(np.asarray(leaf), np.asarray(want))  # step 1 bits
+    assert any("falling back" in m for m in logs)
+    assert any("FALLBACK" in m for m in logs)
+
+    # the registry surfaces which step actually backs the served model
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", workdir)
+    assert sm.restored_step == 1 and sm.restore_fallback is True
+    assert sm.describe()["restore_fallback"] is True

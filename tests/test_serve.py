@@ -81,17 +81,33 @@ def test_expired_deadline_is_shed_not_executed(lenet_serving):
 
 
 def test_queue_full_is_shed(lenet_serving):
+    import time
+
+    from deep_vision_tpu.serve.faults import FaultPlane
+
     _, sm = lenet_serving
     img = _images(1)[0]
+    # kill the batcher on its first iteration (watchdog disabled, so it
+    # stays dead): the queue backs up while submits stay accepted
     eng = BatchingEngine(sm, buckets=[1],
-                         admission=AdmissionController(max_queue=1))
-    # engine not started: the first request parks in the queue, the
-    # second exceeds max_queue and must shed immediately
+                         admission=AdmissionController(max_queue=1),
+                         faults=FaultPlane("batcher:die:times=1"),
+                         watchdog_interval_s=0).start()
+    deadline = time.monotonic() + 10
+    while eng._thread.is_alive() and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert not eng._thread.is_alive()
+    # nothing drains: the first request parks in the queue, the second
+    # exceeds max_queue and must shed immediately — with a Retry-After
+    # hint so HTTP clients can back off against another replica
     first = eng.submit(img)
     second = eng.submit(img).result(1)
     assert isinstance(second, Shed) and second.reason == "queue_full"
+    assert second.retry_after_s is None or second.retry_after_s >= 0
     eng.stop()  # drains the queue: parked request sheds as shutdown
     assert first.result(1).reason == "shutdown"
+    # and once stopped, submits fail fast instead of parking forever
+    assert eng.submit(img).result(1).reason == "shutdown"
 
 
 def test_power_of_two_buckets():
