@@ -16,11 +16,19 @@ test-all:
 	$(PY) -m pytest tests/ -q
 
 # boot the HTTP serving stack on a random port against a LeNet fixture,
-# issue one request, assert a 200 — once synchronous (pipeline_depth=1)
-# and once pipelined (depth=2), checking one bulk D2H per batch
-# (the cli.serve wiring, end to end)
+# issue one request, assert a 200 — once synchronous (pipeline_depth=1),
+# once pipelined (depth=2), once fault-injected, and once replicated over
+# 2 fake host devices (the cli.serve wiring, end to end; one bulk D2H
+# per batch throughout)
 serve-smoke:
 	$(PY) tests/serve_smoke.py
+
+# just the multi-device pass: 2 forced host devices, a 2-replica engine
+# at depth 2 with a fault-injected cohort (serve/replicas.py routing,
+# per-replica health, recovery)
+serve-multi:
+	XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+		$(PY) tests/serve_smoke.py --multi
 
 # the chaos lane alone: deterministic fault injection against a real
 # engine — poison isolation, watchdog restarts, exec-timeout fast-fail,
@@ -37,6 +45,12 @@ bench-serve:
 # the synchronous comparison run: same loads, in-flight window of 1
 bench-serve-sync:
 	$(PY) bench.py --serve --serve-pipeline-depth 1
+
+# device-scaling sweep: img/s + p99 at replica counts 1, 2, 4, 8
+# (docs/PERF.md "Device scaling"); >1.6x at 1->2 expected on real
+# multi-chip hardware, routing overhead on a single shared device
+bench-serve-scaling:
+	$(PY) bench.py --serve --serve-devices 8
 
 bench:
 	$(PY) bench.py
@@ -66,5 +80,5 @@ eval_%:
 list:
 	$(PY) -m deep_vision_tpu.cli.train --list -m x
 
-.PHONY: test test-all bench bench-serve bench-serve-sync serve-smoke \
-	serve-chaos list
+.PHONY: test test-all bench bench-serve bench-serve-sync \
+	bench-serve-scaling serve-smoke serve-multi serve-chaos list

@@ -515,7 +515,8 @@ def bench_infer(name: str = "resnet50", steps: int | None = None,
 def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                 duration_s: float = 2.0, max_batch: int = 8,
                 max_wait_ms: float = 2.0, pipeline_depth: int = 2,
-                faults: str = "", fault_seed: int = 0) -> dict:
+                faults: str = "", fault_seed: int = 0,
+                serve_devices: int = 1) -> dict:
     """Closed-loop load generator against the dynamic-batching engine
     (``deep_vision_tpu/serve``): C client threads each submit one image,
     wait for the answer, repeat — so C is the offered load (concurrency),
@@ -534,6 +535,13 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
     error count, and the JSON gains a ``health`` block (state machine,
     retries, quarantines, watchdog restarts) so fault-tolerance overhead
     and behavior are benchmarkable, not just unit-tested.
+
+    ``serve_devices > 1`` replicates the engine over that many local
+    devices (serve/replicas.py) and the JSON gains ``replicas`` —
+    per-replica batches, img/s, and in-flight high-water — plus the
+    routing counters; ``bench.py --serve --serve-devices N`` sweeps
+    replica counts 1, 2, 4, ... N and emits the device-scaling table
+    (docs/PERF.md).
     """
     import sys
     import tempfile
@@ -555,10 +563,22 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                                   log=lambda m: print(m, file=sys.stderr))
     sm = CheckpointServingModel(model_name, cfg, model, state)
     img = np.random.RandomState(0).randn(*sm.input_shape).astype(np.float32)
+    if serve_devices > 1:
+        from deep_vision_tpu.serve.replicas import (ReplicatedEngine,
+                                                    local_devices)
+
+        engine_ctx = ReplicatedEngine(
+            sm, devices=local_devices(serve_devices),
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            pipeline_depth=pipeline_depth,
+            faults=FaultPlane(faults, fault_seed))
+    else:
+        engine_ctx = BatchingEngine(
+            sm, max_batch=max_batch, max_wait_ms=max_wait_ms,
+            pipeline_depth=pipeline_depth,
+            faults=FaultPlane(faults, fault_seed))
     points = []
-    with BatchingEngine(sm, max_batch=max_batch, max_wait_ms=max_wait_ms,
-                        pipeline_depth=pipeline_depth,
-                        faults=FaultPlane(faults, fault_seed)) as engine:
+    with engine_ctx as engine:
         engine.warmup()  # compiles excluded from every load point
         for clients in loads:
             latencies: list = []
@@ -603,7 +623,7 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
     pipe = stats["pipeline"]
     staging = pipe["staging"]
     health = stats["health"]
-    return {"metric": f"serve_{model_name}_img_per_sec",
+    out = {"metric": f"serve_{model_name}_img_per_sec",
             "value": points[-1]["img_per_sec"], "unit": "img/s",
             "model": model_name, "max_batch": max_batch,
             "max_wait_ms": max_wait_ms, "buckets": stats["buckets"],
@@ -633,6 +653,47 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                 "exec_ewma_ms_by_bucket":
                     stats["admission"]["exec_ewma_ms_by_bucket"]},
             "device_kind": jax.devices()[0].device_kind}
+    if "replicas" in stats:
+        out["serve_devices"] = serve_devices
+        out["replicas"] = [
+            {"replica": r["replica"], "device": r["device"],
+             "state": r["state"], "batches": r["batches"],
+             "routed_batches": r["routed_batches"],
+             "img_per_sec": r["img_per_sec"],
+             "max_inflight": r["max_inflight"]}
+            for r in stats["replicas"]]
+        out["routing"] = stats["routing"]
+        out["admission_free_replicas"] = \
+            stats["admission"]["free_replicas"]
+    return out
+
+
+def bench_serve_scaling(serve_devices: int, **kwargs) -> dict:
+    """Device-scaling sweep: run the serve bench at replica counts
+    1, 2, 4, ... ``serve_devices`` and emit one JSON with the scaling
+    table (img/s + p99 at the top load point per count) plus the full
+    detail of the widest run.  On real multi-chip hardware 1→2 replicas
+    should show >1.6× offered-throughput capacity (docs/PERF.md); on a
+    single shared host device the table measures routing overhead
+    instead."""
+    counts, c = [], 1
+    while c < serve_devices:
+        counts.append(c)
+        c *= 2
+    counts.append(serve_devices)
+    table, last = [], None
+    for k in counts:
+        last = bench_serve(serve_devices=k, **kwargs)
+        top = last["loads"][-1]
+        table.append({"replicas": k,
+                      "img_per_sec": top["img_per_sec"],
+                      "p50_ms": top["p50_ms"], "p99_ms": top["p99_ms"],
+                      "errors": top["errors"]})
+    base = table[0]["img_per_sec"] or 1.0
+    for row in table:
+        row["speedup_vs_1"] = round(row["img_per_sec"] / base, 2)
+    last["scaling"] = table
+    return last
 
 
 def bench_all() -> list[dict]:
@@ -1009,6 +1070,11 @@ def main():
                    help="in-flight batch window (--serve): 1 = the "
                         "synchronous comparison path, 2 = overlap batch "
                         "formation/H2D with device compute")
+    p.add_argument("--serve-devices", type=int, default=1,
+                   help="device-scaling sweep (--serve): bench replica "
+                        "counts 1, 2, 4, ... N and emit the scaling "
+                        "table (img/s + p99 per count) plus the "
+                        "per-replica block of the widest run")
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="measure the train step with the params-EMA "
                         "update in it (the Trainer's --ema-decay)")
@@ -1046,12 +1112,17 @@ def main():
                                              batch=args.batch or 1)))
         return
     if args.serve:
-        print(json.dumps(bench_serve(
+        serve_kwargs = dict(
             model_name=args.serve_model,
             loads=tuple(int(c) for c in args.serve_loads.split(",")),
             duration_s=args.serve_duration, max_batch=args.batch or 8,
             pipeline_depth=args.serve_pipeline_depth,
-            faults=args.faults, fault_seed=args.fault_seed)))
+            faults=args.faults, fault_seed=args.fault_seed)
+        if args.serve_devices > 1:
+            print(json.dumps(bench_serve_scaling(args.serve_devices,
+                                                 **serve_kwargs)))
+        else:
+            print(json.dumps(bench_serve(**serve_kwargs)))
         return
     if args.infer:
         print(json.dumps(bench_infer(args.infer, steps=args.steps,

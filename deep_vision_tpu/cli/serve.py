@@ -15,6 +15,13 @@
     python -m deep_vision_tpu.cli.serve -m lenet5 --workdir runs/l \\
         --faults 'compute:exception:times=1' --fault-seed 0
 
+    # multi-device: one engine replica per chip behind one queue, or
+    # shard each padded batch across all chips (docs/SERVING.md)
+    python -m deep_vision_tpu.cli.serve -m resnet50 --workdir runs/r50 \\
+        --serve-devices 0
+    python -m deep_vision_tpu.cli.serve -m resnet50 --workdir runs/r50 \\
+        --shard-batches --max-batch 256
+
 Knobs and architecture: docs/SERVING.md.  Smoke: ``make serve-smoke``;
 chaos suite: ``make serve-chaos``.
 """
@@ -26,12 +33,21 @@ import argparse
 
 def build_server(args):
     """argparse namespace → (engine, ServeServer); shared with the smoke
-    test so `make serve-smoke` boots exactly the production wiring."""
+    test so `make serve-smoke` boots exactly the production wiring.
+
+    Device scaling (docs/SERVING.md "Multi-device serving"):
+    ``--serve-devices N`` replicates the engine over the first N local
+    devices behind one queue (N=0 → all local devices; default 1 keeps
+    the single-engine path byte-for-byte); ``--shard-batches`` instead
+    builds ONE engine whose padded batches span the data axis of a mesh
+    over those devices (mutually exclusive by construction — replication
+    parallelizes many small batches, sharding one large batch)."""
     from deep_vision_tpu.serve.admission import AdmissionController
-    from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.engine import BatchingEngine, sharded_buckets
     from deep_vision_tpu.serve.faults import FaultPlane
     from deep_vision_tpu.serve.http import ServeServer
     from deep_vision_tpu.serve.registry import ModelRegistry
+    from deep_vision_tpu.serve.replicas import ReplicatedEngine, local_devices
 
     registry = ModelRegistry()
     if args.stablehlo:
@@ -44,8 +60,19 @@ def build_server(args):
     fault_spec = getattr(args, "faults", None)
     faults = FaultPlane(fault_spec, getattr(args, "fault_seed", 0)) \
         if fault_spec else None  # None → engine reads DVT_SERVE_FAULTS
-    engine = BatchingEngine(
-        sm, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+    serve_devices = int(getattr(args, "serve_devices", 1))
+    shard_batches = bool(getattr(args, "shard_batches", False))
+    if shard_batches:
+        # shard over N devices (0/1 → every local device)
+        devices = local_devices(serve_devices if serve_devices > 1
+                                else None)
+    elif serve_devices != 1:
+        # replicate over N devices (0 → every local device)
+        devices = local_devices(serve_devices or None)
+    else:
+        devices = None  # the PR 1–3 single-engine path, untouched
+    engine_kwargs = dict(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         buckets=buckets,
         pipeline_depth=getattr(args, "pipeline_depth", 2),
         faults=faults,
@@ -59,6 +86,18 @@ def build_server(args):
         dead_after=getattr(args, "dead_after", 5),
         admission=AdmissionController(max_queue=args.max_queue,
                                       max_wait_ms=args.max_wait_ms))
+    if shard_batches:
+        from deep_vision_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh({"data": len(devices)}, devices=devices)
+        if engine_kwargs["buckets"] is None:
+            engine_kwargs["buckets"] = sharded_buckets(
+                args.max_batch, len(devices))
+        engine = BatchingEngine(sm.for_mesh(mesh), **engine_kwargs)
+    elif devices is not None and len(devices) > 1:
+        engine = ReplicatedEngine(sm, devices=devices, **engine_kwargs)
+    else:
+        engine = BatchingEngine(sm, **engine_kwargs)
     engine.start()
     if args.warmup:
         print(f"[serve] warming {engine.buckets} ...")
@@ -97,6 +136,18 @@ def main(argv=None):
                    help="dispatched-but-undrained batch window: 1 = "
                         "synchronous, 2 = overlap batch N+1 formation/"
                         "H2D with batch N compute (docs/SERVING.md)")
+    p.add_argument("--serve-devices", type=int, default=1,
+                   help="replicate the engine over this many local "
+                        "devices behind one queue (0 = all; default 1 "
+                        "= single-device engine); params are copied "
+                        "per device once, batches route to the least-"
+                        "loaded replica")
+    p.add_argument("--shard-batches", action="store_true",
+                   help="instead of replicating, shard each padded "
+                        "batch across the data axis of a mesh over "
+                        "--serve-devices devices (0/1 = all) — one "
+                        "logical big batch uses every chip; buckets "
+                        "become multiples of the device count")
     p.add_argument("--warmup", action="store_true",
                    help="compile every bucket before accepting traffic")
     p.add_argument("--verbose", action="store_true",
@@ -144,6 +195,12 @@ def main(argv=None):
           f"(buckets={engine.buckets}, max_wait={args.max_wait_ms}ms, "
           f"max_queue={args.max_queue}, "
           f"pipeline_depth={engine.pipeline_depth})")
+    if hasattr(engine, "replicas"):
+        print(f"[serve] {len(engine.replicas)} replicas: "
+              + ", ".join(r.model.placement_desc() or "default"
+                          for r in engine.replicas))
+    elif getattr(engine.model, "placement", None) is not None:
+        print(f"[serve] sharded batches: {engine.model.placement_desc()}")
     if engine.faults.enabled:
         print(f"[serve] FAULT INJECTION ACTIVE: '{engine.faults.spec}' "
               f"(seed {engine.faults.seed})")

@@ -15,6 +15,11 @@ stack's restore path.
     faults.py     deterministic fault-injection plane (seeded; enabled
                   via --faults / DVT_SERVE_FAULTS; chaos suite:
                   make serve-chaos)
+    replicas.py   multi-device serving: N per-device engine replicas
+                  behind one queue, least-outstanding-work routing,
+                  DEAD-replica evacuation (--serve-devices); the
+                  sharded big-batch path pairs registry.for_mesh with
+                  engine.sharded_buckets (--shard-batches)
     http.py       stdlib HTTP front-end (/v1/classify, /v1/detect,
                   deep /v1/healthz with 503-on-degraded, ...)
 
@@ -31,7 +36,8 @@ from deep_vision_tpu.serve.faults import (
 )
 from deep_vision_tpu.serve.health import EngineHealth
 from deep_vision_tpu.serve.registry import ModelRegistry, ServingModel
+from deep_vision_tpu.serve.replicas import ReplicatedEngine
 
 __all__ = ["AdmissionController", "BatchingEngine", "EngineHealth",
            "FaultPlane", "InjectedFault", "ModelRegistry", "Quarantined",
-           "ServingModel", "Shed", "StagingPool"]
+           "ReplicatedEngine", "ServingModel", "Shed", "StagingPool"]

@@ -95,6 +95,15 @@ def power_of_two_buckets(max_batch: int) -> list[int]:
     return buckets
 
 
+def sharded_buckets(max_batch: int, num_devices: int) -> list[int]:
+    """Bucket ladder for the sharded big-batch path (``--shard-batches``):
+    every bucket a multiple of ``num_devices`` so the padded mega-batch
+    lays evenly across the mesh's data axis — n, 2n, 4n, ... max."""
+    n = max(1, int(num_devices))
+    top = max(1, max_batch // n)
+    return [n * b for b in power_of_two_buckets(top)]
+
+
 class _Request:
     __slots__ = ("image", "deadline", "enqueued_at", "future", "poison")
 
@@ -195,11 +204,23 @@ class BatchingEngine:
                  singleton_retries: int = 1,
                  retry_backoff_ms: float = 2.0,
                  retry_backoff_max_ms: float = 100.0,
-                 degraded_after: int = 1, dead_after: int = 5):
+                 degraded_after: int = 1, dead_after: int = 5,
+                 external_batcher: bool = False,
+                 rescue=None):
         self.model = model
         if model.fixed_batch is not None:
-            # a StableHLO blob serves exactly its traced shape
-            buckets = [model.fixed_batch]
+            # a StableHLO blob serves exactly its traced shapes; an
+            # explicitly conflicting bucket list is an operator error —
+            # name the exported sizes instead of overriding silently
+            available = getattr(model, "bucket_sizes",
+                                [model.fixed_batch])
+            if buckets and any(b not in available for b in buckets):
+                raise ValueError(
+                    f"model '{model.name}' was exported with bucket "
+                    f"sizes {available}; requested buckets "
+                    f"{sorted(buckets)} unavailable — re-export or "
+                    f"serve from the checkpoint")
+            buckets = buckets or list(available)
         self.buckets = sorted(buckets) if buckets else \
             power_of_two_buckets(max_batch)
         self.max_batch = self.buckets[-1]
@@ -223,6 +244,15 @@ class BatchingEngine:
         self.retry_backoff_max_ms = retry_backoff_max_ms
         # NaN-output validation only costs when the fault plane is live
         self._validate = self.faults.enabled
+        # replica mode (serve/replicas.py): the ReplicatedEngine owns
+        # the queue + batch formation and feeds formed cohorts through
+        # dispatch_cohort(); no batcher thread runs here and the
+        # watchdog supervises only the drainer
+        self.external_batcher = external_batcher
+        # rescue(requests, err) -> bool: offered the still-pending
+        # requests of a fast-failed in-flight window BEFORE they get
+        # their TimeoutError; True = another replica took them over
+        self._rescue = rescue
         self._queue: queue.Queue[_Request] = queue.Queue()
         self._executables: dict = {}
         self._lock = threading.Lock()
@@ -260,14 +290,15 @@ class BatchingEngine:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "BatchingEngine":
-        if self._thread is None:
+        if not self._accepting:
             self._stop.clear()
             self.faults.cancel.clear()
             self.health.revive()
-            self._thread = threading.Thread(
-                target=self._loop, name=f"batcher-{self.model.name}",
-                daemon=True)
-            self._thread.start()
+            if not self.external_batcher:
+                self._thread = threading.Thread(
+                    target=self._loop, name=f"batcher-{self.model.name}",
+                    daemon=True)
+                self._thread.start()
             if self.pipeline_depth > 1:
                 self._drainer = threading.Thread(
                     target=self._drain_loop,
@@ -286,8 +317,9 @@ class BatchingEngine:
         """Stop the engine.  New submits fail fast immediately; with a
         ``drain_deadline`` (seconds) admitted work is finished first —
         whatever hasn't completed by the deadline sheds as shutdown."""
+        was_running = self._accepting
         self._accepting = False
-        if drain_deadline is not None and self._thread is not None:
+        if drain_deadline is not None and was_running:
             t_end = time.monotonic() + drain_deadline
             while time.monotonic() < t_end:
                 with self._lock:
@@ -398,17 +430,30 @@ class BatchingEngine:
                                 self._queue.get(timeout=remaining))
                         except queue.Empty:
                             break
-                    try:
-                        self._dispatch(batch)
-                    except Exception as e:  # deliver, don't kill batcher
-                        for req in batch:
-                            if not req.future.done():
-                                req.future.set_exception(e)
-                        self.health.record_failure()
+                    self.dispatch_cohort(batch)
                 finally:
                     self._forming = 0
         except KillThread:
             return  # injected death: the watchdog notices and restarts
+
+    def dispatch_cohort(self, batch: list[_Request]):
+        """Dispatch an already-formed cohort into this engine's
+        pipeline.  The internal batcher calls it after queue drain; in
+        replica mode (``external_batcher=True``) the ReplicatedEngine's
+        router calls it directly — blocking here while this replica's
+        in-flight window is full is the router's backpressure.
+        Exceptions are delivered to the cohort's futures, never raised
+        (a failed batch must not kill the calling thread)."""
+        self._forming = max(self._forming, len(batch))
+        try:
+            self._dispatch(batch)
+        except Exception as e:  # deliver, don't kill the caller
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            self.health.record_failure()
+        finally:
+            self._forming = 0
 
     def _bucket_for(self, n: int) -> int:
         for b in self.buckets:
@@ -425,6 +470,25 @@ class BatchingEngine:
                 self.compiles += 1
         return fn
 
+    def _fill(self, buf: np.ndarray, requests: list[_Request]):
+        """Stage a cohort into a pooled buffer: scatter rows, zero the
+        stale pad tail (buffers are REUSED, so old rows linger)."""
+        n = len(requests)
+        for i, req in enumerate(requests):
+            buf[i] = req.image
+        if n < buf.shape[0]:
+            buf[n:] = 0.0
+
+    def _put(self, buf: np.ndarray):
+        """H2D transfer honoring the model view's placement: the
+        replica's pinned device or the big-batch mesh sharding
+        (registry.for_device/for_mesh); None = runtime default.  Both
+        the pipelined dispatch and the synchronous retry path transfer
+        through here, so they can never diverge on placement."""
+        import jax
+
+        return jax.device_put(buf, self.model.placement)
+
     def _acquire_slot(self) -> bool:
         """Block until an in-flight slot frees (or the engine stops)."""
         while not self._stop.is_set():
@@ -434,8 +498,6 @@ class BatchingEngine:
         return False
 
     def _dispatch(self, batch: list[_Request]):
-        import jax
-
         live = []
         for req in batch:
             expired = self.admission.expired(req.deadline)
@@ -456,10 +518,7 @@ class BatchingEngine:
         try:
             if self.faults.enabled:
                 self.faults.inject("staging", stop=self._stop)
-            for i, req in enumerate(live):
-                buf[i] = req.image
-            if n < bucket:
-                buf[n:] = 0.0  # reused buffer: clear stale pad rows
+            self._fill(buf, live)
             t0 = time.monotonic()
             if self.faults.enabled:
                 self.faults.inject("dispatch", stop=self._stop)
@@ -471,7 +530,7 @@ class BatchingEngine:
             # immediately; the staged buffer stays checked out until the
             # drainer is done with the batch, so the transfer may read
             # it at its leisure
-            out = fn(jax.device_put(buf))
+            out = fn(self._put(buf))
         except Exception as e:
             # dispatch-side batch failure: free the slot, then isolate
             self.staging.release(bucket, buf)
@@ -657,18 +716,18 @@ class BatchingEngine:
         n = len(requests)
         bucket = self._bucket_for(n)
         fn = self._compiled(bucket)
+        # same allocation contract as the pipelined path: pooled staging
+        # buffer + the shared placement-aware transfer — never a fresh
+        # np.zeros / bare device_put per retry batch
         buf = self.staging.acquire(bucket)
         try:
-            for i, req in enumerate(requests):
-                buf[i] = req.image
-            if n < bucket:
-                buf[n:] = 0.0
+            self._fill(buf, requests)
             if self.faults.enabled:
                 self.faults.inject("compute", stop=self._stop)
                 if self.faults.cohort_poisoned(requests):
                     raise InjectedFault(
                         f"poisoned request in retry cohort of {n}")
-            host = jax.device_get(fn(jax.device_put(buf)))
+            host = jax.device_get(fn(self._put(buf)))
             if self._validate:
                 self._check_outputs(host)
         finally:
@@ -705,7 +764,8 @@ class BatchingEngine:
 
     def _watchdog_tick(self, now: float):
         t = self._thread
-        if t is not None and not t.is_alive():
+        if not self.external_batcher and t is not None \
+                and not t.is_alive():
             self._restart("batcher")
         d = self._drainer
         if self.pipeline_depth > 1 and d is not None and not d.is_alive():
@@ -760,7 +820,17 @@ class BatchingEngine:
         for rec in recs:
             if rec.cancel is not None:
                 rec.cancel.set()
-            for req in rec.requests:
+            pending = [r for r in rec.requests if not r.future.done()]
+            if pending and self._rescue is not None:
+                # replica mode: offer the cohort to a healthy replica
+                # before failing anyone (serve/replicas.py bisect-retries
+                # it there) — rescue must never raise into the watchdog
+                try:
+                    if self._rescue(pending, err):
+                        continue
+                except Exception:  # noqa: BLE001
+                    pass
+            for req in pending:
                 if not req.future.done():
                     req.future.set_exception(err)
 
@@ -770,10 +840,18 @@ class BatchingEngine:
         now = time.monotonic()
         rep = self.health.report(now)
         t, d = self._thread, self._drainer
-        rep["batcher_alive"] = bool(t is not None and t.is_alive())
+        # external-batcher replicas have no batcher thread of their own
+        rep["batcher_alive"] = None if self.external_batcher else \
+            bool(t is not None and t.is_alive())
         rep["drainer_alive"] = bool(d is not None and d.is_alive()) \
             if self.pipeline_depth > 1 else None
         rep["accepting"] = self._accepting
+        # what /v1/healthz keys 503 on: a single engine serves only
+        # while fully OK; a ReplicatedEngine overrides this to "any
+        # replica not DEAD" (docs/SERVING.md)
+        rep["can_serve"] = rep["state"] == "ok"
+        rep["placement"] = self.model.placement_desc() \
+            if hasattr(self.model, "placement_desc") else None
         with self._lock:
             rep["inflight"] = self._inflight
             rep["batch_failures"] = self.batch_failures

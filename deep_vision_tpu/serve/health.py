@@ -14,8 +14,14 @@ the engine's failure signals converge:
     DEAD; any successful batch resets to OK.  ``force_dead`` (restart
     budget exhausted) is sticky — only an operator restart revives it.
   * **healthz semantics** — ``/v1/healthz`` returns 503 while any
-    engine is DEGRADED or DEAD so load balancers drain traffic to
-    healthy replicas, and 200 again once a batch completes.
+    engine *cannot serve*, and 200 again once it can.  A single
+    ``BatchingEngine`` can't serve when DEGRADED or DEAD (drain
+    traffic to healthy replicas); a ``ReplicatedEngine`` aggregates
+    one ``EngineHealth`` per replica plus its own for the router, and
+    can't serve only when the router is sticky-DEAD or *every* replica
+    is DEAD — one dead replica out of N reports ``degraded`` with
+    per-replica detail, still 200 (the ``can_serve`` key in each
+    engine's report carries the distinction to ``http.py``).
 
 The failure *counters* live on the engine (retries, quarantines,
 timeouts — they're batch-plumbing); the *verdict* lives here.

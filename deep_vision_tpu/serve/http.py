@@ -5,9 +5,10 @@ Routes (JSON in, JSON out):
     GET  /v1/healthz   DEEP health: per-engine thread liveness,
                        heartbeat ages, last-completed-batch age,
                        consecutive failures, and the OK → DEGRADED →
-                       DEAD state machine — 503 when any engine is
-                       DEGRADED/DEAD so load balancers drain traffic,
-                       200 again after recovery
+                       DEAD state machine — 503 when any engine can't
+                       serve (single engine: DEGRADED/DEAD; replicated
+                       engine: every replica DEAD) so load balancers
+                       drain traffic, 200 again after recovery
     GET  /v1/stats     per-model engine stats (latency p50/p95/p99,
                        throughput, shed counts, compile/bucket state,
                        the pipelined executor's overlap block, and the
@@ -163,7 +164,11 @@ class _Handler(BaseHTTPRequestHandler):
             engines = self.server.engines
             reports = {name: eng.health_report()
                        for name, eng in engines.items()}
-            healthy = all(r["state"] == "ok" for r in reports.values())
+            # each engine decides its own serve-ability: a single
+            # engine only while fully OK, a ReplicatedEngine while ANY
+            # replica is routable (per-replica states are in its report)
+            healthy = all(r.get("can_serve", r["state"] == "ok")
+                          for r in reports.values())
             self._reply(200 if healthy else 503,
                         {"status": "ok" if healthy else "unhealthy",
                          "models": self.server.registry.names(),

@@ -25,11 +25,26 @@ Execution contract (what the pipelined engine relies on):
     DONATED (``donates_inputs``) where the runtime allows, recycling the
     padded batch's device allocation into the outputs; StableHLO blobs
     keep their exported (non-donating) signature.
+
+Multi-device placement (serve/replicas.py, docs/SERVING.md):
+
+  * ``placement`` is the model's input sharding (None = runtime default
+    device).  The engine transfers every staged batch with
+    ``jax.device_put(buf, placement)`` so the SAME engine code drives
+    the default device, a pinned replica device, or a sharded mesh;
+  * ``for_device(dev)`` returns a per-device VIEW: the variables are
+    ``device_put`` to that device exactly once (at replica-set build,
+    i.e. registry load time) and every bucket program is AOT-compiled
+    pinned to it via sharded ``ShapeDtypeStruct``s — N replica views of
+    one checkpoint share the host restore but own their device copies;
+  * ``for_mesh(mesh)`` returns a data-sharded VIEW for the big-batch
+    path: variables replicated over the mesh, bucket programs compiled
+    with the batch dim laid across the ``data`` axis, so one logical
+    padded mega-batch uses every chip (``--shard-batches``).
 """
 
 from __future__ import annotations
 
-import functools
 import warnings
 
 import numpy as np
@@ -52,6 +67,10 @@ class ServingModel:
         # StableHLO blobs are traced at one batch shape; checkpoint-backed
         # models compile any bucket (None = unconstrained)
         self.fixed_batch = fixed_batch
+        # input sharding (jax.sharding.Sharding) the engine device_puts
+        # staged batches with; None = runtime default device.  Set by
+        # for_device()/for_mesh() views.
+        self.placement = None
         # which checkpoint step the weights came from (None = random
         # init) and whether restore fell back past a corrupt newer step
         # — set by the registry loaders, surfaced in describe()
@@ -61,12 +80,25 @@ class ServingModel:
     def compile_bucket(self, batch: int):
         raise NotImplementedError
 
+    def placement_desc(self) -> str | None:
+        """Human-readable placement for stats/health (None = default)."""
+        import jax
+
+        if self.placement is None:
+            return None
+        devs = sorted(d.id for d in self.placement.device_set)
+        if len(devs) == 1:
+            return str(next(iter(self.placement.device_set)))
+        return (f"sharded over {len(devs)} devices "
+                f"{devs} ({jax.devices()[0].platform})")
+
     def describe(self) -> dict:
         return {"name": self.name, "task": self.task,
                 "input_shape": list(self.input_shape),
                 "num_classes": self.num_classes,
                 "fixed_batch": self.fixed_batch,
                 "donates_inputs": self.donates_inputs,
+                "placement": self.placement_desc(),
                 "restored_step": self.restored_step,
                 "restore_fallback": self.restore_fallback}
 
@@ -87,18 +119,71 @@ class CheckpointServingModel(ServingModel):
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
         self._variables = variables
+        # variable sharding paired with ``placement`` (replicated on a
+        # mesh, pinned on a single device); None = wherever restore left
+        # them
+        self._var_sharding = None
+
+    def for_device(self, device) -> "CheckpointServingModel":
+        """Per-device replica view: SAME host restore, its OWN device
+        copy of the variables (one ``device_put`` per device, here, at
+        replica-set build — never per batch) and bucket programs pinned
+        to ``device`` (serve/replicas.py builds one view per local
+        device)."""
+        import copy
+
+        import jax
+        from jax.sharding import SingleDeviceSharding
+
+        view = copy.copy(self)
+        sharding = SingleDeviceSharding(device)
+        view.placement = sharding
+        view._var_sharding = sharding
+        view._variables = jax.device_put(self._variables, sharding)
+        return view
+
+    def for_mesh(self, mesh) -> "CheckpointServingModel":
+        """Data-sharded big-batch view (``--shard-batches``): variables
+        replicated over ``mesh``, bucket programs compiled with the
+        batch dim split across the ``data`` axis — one logical padded
+        mega-batch spans every chip.  Buckets must be divisible by the
+        data-axis size (compile_bucket enforces it)."""
+        import copy
+
+        from deep_vision_tpu.parallel.mesh import (
+            batch_sharding,
+            replicate,
+            replicated_sharding,
+        )
+
+        view = copy.copy(self)
+        view.placement = batch_sharding(mesh, ndim=1 + len(self.input_shape))
+        view._var_sharding = replicated_sharding(mesh)
+        view._variables = replicate(self._variables, mesh)
+        view._mesh = mesh
+        return view
 
     def compile_bucket(self, batch: int):
         import jax
         import jax.numpy as jnp
 
+        if getattr(self, "_mesh", None) is not None:
+            n = self._mesh.shape["data"]
+            if batch % n != 0:
+                raise ValueError(
+                    f"sharded serving of '{self.name}': bucket {batch} "
+                    f"not divisible by the {n}-device data axis — use "
+                    f"buckets that are multiples of {n} "
+                    f"(engine.sharded_buckets)")
+
         def apply(variables, x):
             return self._model.apply(variables, x, train=False)
 
         x_spec = jax.ShapeDtypeStruct((batch, *self.input_shape),
-                                      jnp.float32)
+                                      jnp.float32, sharding=self.placement)
         v_spec = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype),
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                           sharding=self._var_sharding),
             self._variables)
         # AOT lower+compile: the engine's bucket dict is the jit cache,
         # so a served shape can never hit a surprise trace mid-request.
@@ -115,11 +200,14 @@ class CheckpointServingModel(ServingModel):
                 v_spec, x_spec).compile()
         variables = self._variables
 
+        placement = self.placement
+
         def call(x):
             # keep donation meaningful for direct numpy callers too:
-            # transfer first, hand the committed device buffer over
+            # transfer first, hand the committed device buffer over —
+            # honoring the view's placement (replica device / mesh)
             if not isinstance(x, jax.Array):
-                x = jax.device_put(np.asarray(x, np.float32))
+                x = jax.device_put(np.asarray(x, np.float32), placement)
             with warnings.catch_warnings():
                 warnings.filterwarnings(
                     "ignore",
@@ -141,14 +229,31 @@ class ExportedServingModel(ServingModel):
         self.cfg = cfg
         self._call = call
         self._variables = variables
+        #: every batch size the blob was exported with (today one trace
+        #: per blob; kept a list so multi-bucket exports slot in) — the
+        #: error surface for unavailable buckets, instead of the XLA
+        #: shape-mismatch noise the raw call would raise
+        self.bucket_sizes = [int(fixed_batch)]
+
+    def _unavailable(self, batch: int) -> ValueError:
+        return ValueError(
+            f"StableHLO blob for '{self.name}' was exported with bucket "
+            f"sizes {self.bucket_sizes}; batch {batch} unavailable — "
+            f"re-export with --batch {batch} or serve from the checkpoint")
 
     def compile_bucket(self, batch: int):
-        if batch != self.fixed_batch:
-            raise ValueError(
-                f"StableHLO blob for '{self.name}' was exported at batch "
-                f"{self.fixed_batch}; bucket {batch} unavailable — "
-                f"re-export or serve from the checkpoint")
-        return functools.partial(self._call, self._variables)
+        if batch not in self.bucket_sizes:
+            raise self._unavailable(batch)
+        call, variables = self._call, self._variables
+
+        def run(x):
+            # check HERE, not inside XLA: the deserialized call's shape
+            # error names avals, not what the operator can act on
+            if x.shape[0] not in self.bucket_sizes:
+                raise self._unavailable(x.shape[0])
+            return call(variables, x)
+
+        return run
 
 
 class ModelRegistry:

@@ -1,0 +1,541 @@
+"""Engine replication: one admission queue, N devices, one process.
+
+PRs 1–3 built a batcher/pipeline/fault-plane stack that drives exactly
+one device, leaving the other 7/8 of a pod slice idle under inference
+load.  ``ReplicatedEngine`` scales that stack across every local device
+the way Clipper-style replica scheduling does (NSDI'17) — without
+changing the per-device execution path at all:
+
+  one queue      ``submit`` feeds a single admission-controlled queue
+                 (the shed estimate divides its exec term by the number
+                 of routable replicas, admission.py);
+  one batcher    a shared router thread forms cohorts exactly like the
+                 single-engine batcher (first request + drain window)
+                 — batch formation semantics are identical at any
+                 replica count;
+  N replicas     one ``BatchingEngine`` per device in external-batcher
+                 mode: its OWN device copy of the params (``device_put``
+                 once per device via ``registry.for_device``, at build
+                 — never per batch), its OWN per-bucket AOT compiles
+                 pinned to its device, its OWN staging pool, pipeline
+                 window, drainer, and watchdog (PR 3 supervision is
+                 per-replica);
+  routing        each formed cohort goes to the replica with the least
+                 outstanding work — (in-flight + forming batches) × the
+                 bucket's exec EWMA — with a round-robin tie-break so
+                 an idle fleet still spreads (and warms every replica's
+                 pipeline) instead of piling onto replica 0.
+
+Failure semantics (docs/SERVING.md "Multi-device serving"):
+
+  * a replica's watchdog fast-fails its stuck window as before, but in
+    replica mode the still-pending requests are first OFFERED to a
+    healthy replica (``rescue`` hook) and bisect-retried there — the
+    caller sees a served result, not a TimeoutError;
+  * a replica that goes DEAD (restart budget exhausted, consecutive
+    failures) is masked out of routing and out of the admission
+    divisor; the supervisor EVACUATES its in-flight cohorts onto a
+    healthy replica, so killing a replica mid-load loses zero admitted
+    requests (poison quarantines excepted);
+  * ``/v1/healthz`` reports per-replica state and answers 503 only when
+    NO replica can serve (all DEAD, or the router's restart budget is
+    spent) — a degraded replica drains, it doesn't take the fleet down.
+
+The big-batch path is separate: ``--shard-batches`` builds ONE engine
+over ``registry.for_mesh`` so a single padded mega-batch spans the data
+axis of every chip (``engine.sharded_buckets`` keeps buckets divisible
+by the mesh).  Replication parallelizes many small batches; sharding
+parallelizes one large one.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from deep_vision_tpu.core.metrics import LatencyHistogram
+from deep_vision_tpu.serve.admission import AdmissionController, Shed
+from deep_vision_tpu.serve.engine import BatchingEngine, _Request
+from deep_vision_tpu.serve.faults import FaultPlane, KillThread
+from deep_vision_tpu.serve.health import DEAD, OK, EngineHealth
+
+
+def local_devices(limit: int | None = None) -> list:
+    """The local device set serving replicates over (``--serve-devices``
+    caps it; asking for more than exist is an operator error, not a
+    silent truncation)."""
+    import jax
+
+    devs = jax.local_devices()
+    if limit is not None:
+        n = int(limit)
+        if n < 1:
+            raise ValueError(f"--serve-devices {n}: need at least 1")
+        if n > len(devs):
+            raise ValueError(
+                f"--serve-devices {n}: only {len(devs)} local "
+                f"device(s) present ({devs[0].platform})")
+        devs = devs[:n]
+    return devs
+
+
+class ReplicatedEngine:
+    """N per-device ``BatchingEngine`` replicas behind one queue.
+
+    Drop-in for a single engine everywhere the serving stack touches
+    one: ``start/stop/submit/infer/warmup/stats/health_report`` and the
+    ``faults``/``admission`` attributes match ``BatchingEngine``.
+    Extra engine knobs (exec timeouts, retry budgets, state thresholds)
+    pass through to every replica via ``**engine_kwargs``.
+    """
+
+    def __init__(self, model, *, devices: list | None = None,
+                 max_batch: int = 32, max_wait_ms: float = 5.0,
+                 buckets: list[int] | None = None,
+                 admission: AdmissionController | None = None,
+                 pipeline_depth: int = 2,
+                 faults: FaultPlane | None = None,
+                 watchdog_interval_s: float = 0.05,
+                 restart_budget: int = 3,
+                 **engine_kwargs):
+        self.devices = list(devices) if devices is not None \
+            else local_devices()
+        if len(self.devices) > 1 and not hasattr(model, "for_device"):
+            raise ValueError(
+                f"model '{model.name}' ({type(model).__name__}) has no "
+                f"per-device view (for_device) — StableHLO blobs serve "
+                f"single-device; replicate from the checkpoint path")
+        self.model = model
+        self.max_wait_s = max_wait_ms / 1e3
+        self.admission = admission or AdmissionController(
+            max_wait_ms=max_wait_ms)
+        self.faults = faults or FaultPlane.from_env()
+        self.watchdog_interval_s = watchdog_interval_s
+        self.restart_budget = restart_budget
+        # the ROUTER's own health (each replica owns its machine); its
+        # heartbeats/restarts feed the aggregate health_report
+        self.health = EngineHealth()
+        self.replicas: list[BatchingEngine] = []
+        for i, dev in enumerate(self.devices):
+            view = model.for_device(dev) if hasattr(model, "for_device") \
+                else model
+            self.replicas.append(BatchingEngine(
+                view, max_batch=max_batch, max_wait_ms=max_wait_ms,
+                buckets=buckets, admission=self.admission,
+                pipeline_depth=pipeline_depth, faults=self.faults,
+                watchdog_interval_s=watchdog_interval_s,
+                restart_budget=restart_budget,
+                external_batcher=True,
+                rescue=(lambda pending, err, _i=i:
+                        self._rescue_from(_i, pending, err)),
+                **engine_kwargs))
+        self.buckets = self.replicas[0].buckets
+        self.max_batch = self.replicas[0].max_batch
+        self.pipeline_depth = self.replicas[0].pipeline_depth
+        # DEAD replicas drop out of the shed estimate as they drop out
+        # of routing
+        self.admission.set_free_replicas(self._free_replicas)
+        self._queue: queue.Queue[_Request] = queue.Queue()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._accepting = False
+        self._forming = 0
+        self._thread: threading.Thread | None = None
+        self._supervisor: threading.Thread | None = None
+        self._rr = 0  # round-robin tie-break cursor
+        self._evacuated = [False] * len(self.replicas)
+        self.submitted = 0
+        self.shed_shutdown = 0
+        self.routed_batches = [0] * len(self.replicas)
+        self.rescued_requests = 0
+        self.evacuations = 0
+        self.shed_all_dead = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ReplicatedEngine":
+        if not self._accepting:
+            self._stop.clear()
+            self.health.revive()
+            self._evacuated = [False] * len(self.replicas)
+            for rep in self.replicas:
+                rep.start()
+            self._thread = threading.Thread(
+                target=self._route_loop,
+                name=f"router-{self.model.name}", daemon=True)
+            self._thread.start()
+            self._supervisor = threading.Thread(
+                target=self._supervise_loop,
+                name=f"supervisor-{self.model.name}", daemon=True)
+            self._supervisor.start()
+            self._accepting = True
+        return self
+
+    def stop(self, timeout: float = 5.0,
+             drain_deadline: float | None = None):
+        """Same contract as ``BatchingEngine.stop``: submits fail fast
+        immediately; with ``drain_deadline`` admitted work finishes
+        across ALL replicas first."""
+        was_running = self._accepting
+        self._accepting = False
+        if drain_deadline is not None and was_running:
+            t_end = time.monotonic() + drain_deadline
+            while time.monotonic() < t_end:
+                if self._queue.qsize() == 0 and self._forming == 0 \
+                        and self.total_inflight() == 0:
+                    break
+                time.sleep(0.005)
+        self._stop.set()
+        self.faults.cancel.set()
+        if self._supervisor is not None:
+            self._supervisor.join(timeout)
+            self._supervisor = None
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        for rep in self.replicas:
+            rep.stop(timeout)
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.set_result(Shed("shutdown", "engine stopped"))
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    def warmup(self, buckets: list[int] | None = None):
+        for rep in self.replicas:
+            rep.warmup(buckets)
+
+    # -- request path ------------------------------------------------------
+
+    def total_inflight(self) -> int:
+        return sum(r._inflight + r._forming for r in self.replicas)
+
+    def submit(self, image, deadline_ms: float | None = None) -> Future:
+        fut: Future = Future()
+        if not self._accepting:
+            with self._lock:
+                self.submitted += 1
+                self.shed_shutdown += 1
+            fut.set_result(Shed(
+                "shutdown", "engine is not accepting requests "
+                            "(stopped or not started)"))
+            return fut
+        now = time.monotonic()
+        deadline = now + deadline_ms / 1e3 if deadline_ms is not None \
+            else None
+        with self._lock:
+            self.submitted += 1
+        depth = self._queue.qsize()
+        shed = self.admission.admit(
+            depth, deadline, now,
+            bucket=self.replicas[0]._bucket_for(
+                min(depth + 1, self.max_batch)),
+            inflight=self.total_inflight())
+        if shed is not None:
+            fut.set_result(shed)
+            return fut
+        poison = self.faults.mark_poison() if self.faults.enabled else False
+        self._queue.put(_Request(np.asarray(image, np.float32), deadline,
+                                 now, fut, poison))
+        return fut
+
+    def infer(self, image, deadline_ms: float | None = None,
+              timeout: float | None = 30.0):
+        return self.submit(image, deadline_ms).result(timeout)
+
+    # -- shared batcher + router -------------------------------------------
+
+    def _route_loop(self):
+        """Identical cohort formation to the single-engine batcher
+        (engine._loop), then a routing decision instead of a local
+        dispatch.  Dying here is survivable: the supervisor restarts
+        the router within ``restart_budget``."""
+        try:
+            while not self._stop.is_set():
+                self.health.beat("batcher")
+                if self.faults.enabled:
+                    self.faults.inject("batcher", stop=self._stop)
+                try:
+                    first = self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    continue
+                self._forming = 1
+                try:
+                    batch = [first]
+                    drain_until = time.monotonic() + self.max_wait_s
+                    while len(batch) < self.max_batch:
+                        remaining = drain_until - time.monotonic()
+                        if remaining <= 0:
+                            break
+                        try:
+                            batch.append(
+                                self._queue.get(timeout=remaining))
+                        except queue.Empty:
+                            break
+                    self._route(batch)
+                finally:
+                    self._forming = 0
+        except KillThread:
+            return  # injected death: the supervisor restarts the router
+
+    def _route(self, batch: list[_Request]):
+        bucket = self.replicas[0]._bucket_for(len(batch))
+        i = self._pick(bucket)
+        if i is None:
+            with self._lock:
+                self.shed_all_dead += len(batch)
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_result(
+                        Shed("shutdown", "all replicas are DEAD"))
+            return
+        with self._lock:
+            self.routed_batches[i] += 1
+        # blocking while replica i's in-flight window is full IS the
+        # router's backpressure (least-outstanding-work makes a full
+        # window unlikely unless every replica is saturated)
+        self.replicas[i].dispatch_cohort(batch)
+        self.health.record_success()
+
+    def _pick(self, bucket: int) -> int | None:
+        """Least outstanding work = (in-flight + forming batches) × the
+        bucket's exec EWMA, over non-DEAD replicas.  Scores tie whenever
+        the fleet is idle (everything × EWMA = 0), so scanning starts at
+        a rotating offset and strict less-than keeps the first-seen
+        minimum — ties round-robin instead of piling onto replica 0.
+        None = nothing routable."""
+        ewma = self.admission.bucket_ewma_s(bucket) or 1.0
+        n = len(self.replicas)
+        start = self._rr % n
+        self._rr += 1
+        best = best_score = None
+        for k in range(n):
+            i = (start + k) % n
+            rep = self.replicas[i]
+            if rep.health.state == DEAD:
+                continue
+            score = (rep._inflight + rep._forming) * ewma
+            if best_score is None or score < best_score:
+                best, best_score = i, score
+        return best
+
+    def _free_replicas(self) -> int:
+        return sum(1 for r in self.replicas if r.health.state != DEAD)
+
+    # -- failure handling (rescue + evacuation) ----------------------------
+
+    def _rescue_from(self, source: int, pending: list[_Request],
+                     err: Exception) -> bool:
+        """Re-home a failed cohort from ``source`` onto the least-loaded
+        healthy replica and bisect-retry it there (innocents served,
+        poison quarantined — same isolation as a local batch failure).
+        False = nobody else can take it; the caller fails the futures."""
+        target = None
+        best_score = None
+        for i, rep in enumerate(self.replicas):
+            if i == source or rep.health.state == DEAD:
+                continue
+            score = rep._inflight + rep._forming
+            if best_score is None or score < best_score:
+                target, best_score = i, score
+        if target is None:
+            return False
+        with self._lock:
+            self.rescued_requests += len(pending)
+        # straight to isolation: the failure is SOURCE's, not the
+        # target's — going through target._cohort_failed would ding the
+        # healthy replica's state machine for its neighbor's crime
+        rep = self.replicas[target]
+        rep._isolate(pending, err, [rep.retry_budget])
+        return True
+
+    def _supervise_loop(self):
+        while not self._stop.is_set():
+            time.sleep(self.watchdog_interval_s)
+            if self._stop.is_set():
+                return
+            try:
+                self._supervise_tick()
+            except Exception:  # noqa: BLE001 — the supervisor never dies
+                pass
+
+    def _supervise_tick(self):
+        t = self._thread
+        if t is not None and not t.is_alive():
+            self._restart_router()
+        for i, rep in enumerate(self.replicas):
+            if rep.health.state == DEAD and not self._evacuated[i]:
+                self._evacuated[i] = True
+                self._evacuate(i)
+            elif rep.health.state != DEAD and self._evacuated[i]:
+                self._evacuated[i] = False  # operator revived it
+
+    def _restart_router(self):
+        if self._stop.is_set():
+            return
+        self.health.record_failure()
+        if self.health.watchdog_restarts >= self.restart_budget:
+            self.health.force_dead(
+                f"router died and the restart budget "
+                f"({self.restart_budget}) is exhausted")
+            return
+        self.health.record_restart()
+        self._thread = threading.Thread(
+            target=self._route_loop,
+            name=f"router-{self.model.name}", daemon=True)
+        self._thread.start()
+
+    def _evacuate(self, i: int):
+        """A replica went DEAD with cohorts in flight: cancel its
+        window records (a late drain on a zombie thread is discarded)
+        and re-home every still-pending request on a healthy replica.
+        Admitted work survives replica death; only an all-DEAD fleet
+        fails futures."""
+        rep = self.replicas[i]
+        with rep._lock:
+            recs = [r for r in rep._inflight_recs if not r.cancelled]
+            for r in recs:
+                r.cancelled = True
+        for r in recs:
+            if r.cancel is not None:
+                r.cancel.set()  # release any injected hang
+        with self._lock:
+            self.evacuations += 1
+        pending = [q for r in recs for q in r.requests
+                   if not q.future.done()]
+        if not pending:
+            return
+        err = RuntimeError(
+            f"replica {i} is DEAD ({rep.health.dead_reason}); "
+            f"cohort re-routed")
+        if not self._rescue_from(i, pending, err):
+            for q in pending:
+                if not q.future.done():
+                    q.future.set_exception(err)
+
+    # -- observability -----------------------------------------------------
+
+    def health_report(self) -> dict:
+        now = time.monotonic()
+        rep = self.health.report(now)
+        router_state = rep["state"]
+        t = self._thread
+        rep["batcher_alive"] = bool(t is not None and t.is_alive())
+        rep["drainer_alive"] = None  # replicas own their drainers
+        rep["accepting"] = self._accepting
+        rep["inflight"] = self.total_inflight()
+        replicas = {str(i): r.health_report()
+                    for i, r in enumerate(self.replicas)}
+        rep["replicas"] = replicas
+        states = [r["state"] for r in replicas.values()]
+        if router_state == DEAD or all(s == DEAD for s in states):
+            state = DEAD
+        elif router_state == OK and all(s == OK for s in states):
+            state = OK
+        else:
+            state = "degraded"
+        rep["state"] = state
+        # the fleet serves while ANY replica is routable: healthz 503s
+        # only when all replicas are DEAD (or the router is beyond its
+        # restart budget) — a degraded replica drains, it doesn't take
+        # the fleet down
+        rep["can_serve"] = state != DEAD
+        # fleet-wide failure accounting (same keys as a single engine's
+        # report, so bench.py / dashboards read either shape)
+        rep["batch_failures"] = sum(r.batch_failures
+                                    for r in self.replicas)
+        rep["retry_executions"] = sum(r.retry_executions
+                                      for r in self.replicas)
+        rep["quarantined"] = sum(r.quarantined for r in self.replicas)
+        rep["exec_timeouts"] = sum(r.exec_timeouts for r in self.replicas)
+        rep["watchdog_restarts"] += sum(r.health.watchdog_restarts
+                                        for r in self.replicas)
+        rep["shed_shutdown"] = self.shed_shutdown
+        ages = [a for r in replicas.values()
+                if (a := r.get("last_batch_age_s")) is not None]
+        rep["last_batch_age_s"] = min(ages) if ages else None
+        if self.faults.enabled:
+            rep["faults"] = self.faults.stats()
+        return rep
+
+    def stats(self) -> dict:
+        merged = LatencyHistogram()
+        per = []
+        img_per_sec = 0.0
+        for i, rep in enumerate(self.replicas):
+            merged.merge(rep.latency.state_dict())
+            ips = rep.throughput.images_per_sec
+            img_per_sec += ips
+            with self._lock:
+                routed = self.routed_batches[i]
+            per.append({
+                "replica": i,
+                "device": rep.model.placement_desc()
+                if hasattr(rep.model, "placement_desc") else None,
+                "state": rep.health.state,
+                "routed_batches": routed,
+                "batches": rep.batches,
+                "served": rep.served,
+                "quarantined": rep.quarantined,
+                "img_per_sec": round(ips, 2),
+                "inflight": rep._inflight,
+                "max_inflight": rep.max_inflight,
+                "compiles": rep.compiles})
+        with self._lock:
+            out = {"model": self.model.name,
+                   "submitted": self.submitted,
+                   "served": sum(r.served for r in self.replicas),
+                   "batches": sum(r.batches for r in self.replicas),
+                   "compiles": sum(r.compiles for r in self.replicas),
+                   "padded_images": sum(r.padded_images
+                                        for r in self.replicas),
+                   "quarantined": sum(r.quarantined
+                                      for r in self.replicas),
+                   "queue_depth": self._queue.qsize(),
+                   "buckets": list(self.buckets),
+                   "max_wait_ms": self.max_wait_s * 1e3,
+                   "routing": {
+                       "policy": "least_outstanding_work",
+                       "replicas": len(self.replicas),
+                       "free_replicas": self._free_replicas(),
+                       "rescued_requests": self.rescued_requests,
+                       "evacuations": self.evacuations,
+                       "shed_all_dead": self.shed_all_dead}}
+        out["replicas"] = per
+        pooled: dict = {}
+        for r in self.replicas:
+            for b, nbuf in r.staging.stats()["pooled"].items():
+                pooled[b] = pooled.get(b, 0) + nbuf
+        out["pipeline"] = {
+            "depth": self.pipeline_depth,
+            "inflight": self.total_inflight(),
+            "max_inflight": max(r.max_inflight for r in self.replicas),
+            "bulk_transfers": sum(r.bulk_transfers
+                                  for r in self.replicas),
+            "bulk_transfer_bytes": sum(r.bulk_transfer_bytes
+                                       for r in self.replicas),
+            # the single-engine host proxy doesn't compose across
+            # replicas (their windows overlap in wall time)
+            "device_idle_frac": None,
+            "staging": {
+                "allocated": sum(r.staging.allocated
+                                 for r in self.replicas),
+                "reused": sum(r.staging.reused for r in self.replicas),
+                "pooled": pooled}}
+        out["latency"] = merged.percentiles()
+        out["img_per_sec"] = round(img_per_sec, 2)
+        out["admission"] = self.admission.stats()
+        out["health"] = self.health_report()
+        return out

@@ -31,3 +31,12 @@ def mesh1():
     from deep_vision_tpu.parallel import make_mesh
 
     return make_mesh({"data": 1}, devices=jax.devices()[:1])
+
+
+@pytest.fixture(scope="session")
+def host_devices():
+    """The 8 forced host devices multi-device serving tests replicate
+    and shard over (tests/test_replicas.py)."""
+    devs = jax.local_devices()
+    assert len(devs) >= 8, f"expected 8 forced host devices, got {devs}"
+    return devs

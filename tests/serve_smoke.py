@@ -2,12 +2,16 @@
 against a LeNet/MNIST workdir fixture, issue one /v1/classify request,
 assert a 200 — once on the synchronous path (pipeline_depth=1), once on
 the pipelined executor (depth=2, the production default; asserting the
-scatter did exactly one bulk D2H per batch), and once with an injected
+scatter did exactly one bulk D2H per batch), once with an injected
 transient compute failure (the request must still answer 200 through
-bisect-retry and deep health must settle back to OK).  Exercises exactly
-the `python -m deep_vision_tpu.cli.serve` path (cli.serve.build_server),
-just without serve_forever in the foreground — run directly, not under
-pytest."""
+bisect-retry and deep health must settle back to OK), and finally the
+multi-device pass in a fresh subprocess with 2 forced host devices
+(`make serve-multi` runs just that pass): a 2-replica engine at depth 2
+with the same injected fault — requests spread over both replicas,
+routing/health surface per-replica state, still 200s throughout.
+Exercises exactly the `python -m deep_vision_tpu.cli.serve` path
+(cli.serve.build_server), just without serve_forever in the foreground —
+run directly, not under pytest."""
 
 import argparse
 import json
@@ -23,7 +27,8 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def smoke_one(pipeline_depth: int, faults: str = "") -> None:
+def smoke_one(pipeline_depth: int, faults: str = "",
+              serve_devices: int = 1, requests: int = 1) -> None:
     from deep_vision_tpu.cli.serve import build_server
 
     with tempfile.TemporaryDirectory() as workdir:
@@ -33,7 +38,8 @@ def smoke_one(pipeline_depth: int, faults: str = "") -> None:
             model="lenet5", workdir=workdir, stablehlo=None,
             host="127.0.0.1", port=0, max_batch=4, max_wait_ms=2.0,
             buckets=None, max_queue=64, warmup=False, verbose=False,
-            pipeline_depth=pipeline_depth, faults=faults, fault_seed=0)
+            pipeline_depth=pipeline_depth, faults=faults, fault_seed=0,
+            serve_devices=serve_devices, shard_batches=False)
         engine, server = build_server(args)
         server.start_background()
         base = f"http://{server.host}:{server.port}"
@@ -44,15 +50,19 @@ def smoke_one(pipeline_depth: int, faults: str = "") -> None:
                 assert r.status == 200 and health["status"] == "ok", health
                 rep = health["engines"]["lenet5"]
                 assert rep["batcher_alive"] and rep["accepting"], rep
+                if serve_devices > 1:
+                    assert len(rep["replicas"]) == serve_devices, rep
+                    assert rep["can_serve"], rep
             body = json.dumps(
                 {"pixels": np.zeros((32, 32, 1)).tolist()}).encode()
-            req = urllib.request.Request(
-                base + "/v1/classify", data=body,
-                headers={"Content-Type": "application/json"})
-            with urllib.request.urlopen(req, timeout=60) as r:
-                assert r.status == 200, f"expected 200, got {r.status}"
-                top = json.loads(r.read())["top"]
-                assert len(top) == 5, top
+            for _ in range(requests):
+                req = urllib.request.Request(
+                    base + "/v1/classify", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    assert r.status == 200, f"expected 200, got {r.status}"
+                    top = json.loads(r.read())["top"]
+                    assert len(top) == 5, top
             with urllib.request.urlopen(base + "/v1/stats",
                                         timeout=60) as r:
                 stats = json.loads(r.read())["lenet5"]
@@ -68,24 +78,61 @@ def smoke_one(pipeline_depth: int, faults: str = "") -> None:
                 assert health["batch_failures"] >= 1, health
                 assert health["retry_executions"] >= 1, health
                 assert health["faults"]["injected"], health
+            extra = ""
+            if serve_devices > 1:
+                routed = [r["routed_batches"] for r in stats["replicas"]]
+                # round-robin tie-break: sequential singles must spread
+                assert all(n >= 1 for n in routed), stats["replicas"]
+                assert stats["routing"]["replicas"] == serve_devices
+                assert stats["admission"]["free_replicas"] \
+                    == serve_devices, stats["admission"]
+                extra = f", {serve_devices} replicas routed {routed}"
             print(f"serve-smoke PASS (pipeline_depth={pipeline_depth}"
                   + (f", faults='{faults}'" if faults else "") + "): "
                   f"200 from port {server.port}, top-1 class "
                   f"{top[0]['class']}, {pipe['bulk_transfers']} bulk "
                   f"transfer(s) for {stats['batches']} batch(es), "
-                  f"health {health['state']}")
+                  f"health {health['state']}{extra}")
         finally:
             server.shutdown()
             engine.stop(drain_deadline=5.0)
 
 
 def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--multi", action="store_true",
+                   help="run only the multi-device pass (needs "
+                        "XLA_FLAGS=--xla_force_host_platform_device_"
+                        "count=2 before jax initializes; make "
+                        "serve-multi sets it)")
+    opts = p.parse_args()
+    if opts.multi:
+        # 2 fake host devices, depth 2, fault-injected: the replica
+        # wiring end to end.  The platform pin must land before the jax
+        # backend initializes (env JAX_PLATFORMS alone can be overridden
+        # by site config, so pin at the config level too).
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=2")
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        smoke_one(2, faults="compute:exception:times=1",
+                  serve_devices=2, requests=6)
+        return 0
     for depth in (1, 2):
         smoke_one(depth)
     # fault-injected pass: one transient compute failure — the request
     # must still answer 200 (bisect-retry), health must settle back OK
     smoke_one(2, faults="compute:exception:times=1")
-    return 0
+    # multi-device pass: a fresh subprocess, because the forced host
+    # device count must be set before this process's jax backend exists
+    import subprocess
+
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=2")
+    proc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--multi"], env=env)
+    return proc.returncode
 
 
 if __name__ == "__main__":

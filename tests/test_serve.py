@@ -203,6 +203,33 @@ def test_exported_blob_serving(lenet_serving, tmp_path):
     np.testing.assert_allclose(np.stack(rows), ref, atol=1e-5)
 
 
+def test_exported_blob_unavailable_bucket_names_sizes(lenet_serving,
+                                                      tmp_path):
+    """Asking a StableHLO blob for a bucket it wasn't exported with
+    raises an error naming the exported sizes and the fix — not XLA
+    shape-mismatch noise."""
+    from deep_vision_tpu.core.export import export_forward
+
+    reg, sm = lenet_serving
+    path = str(tmp_path / "lenet_b4.stablehlo")
+    export_forward(sm._model, sm._variables, (4, 32, 32, 1), path)
+    sm2 = reg.load_exported("lenet5", path, str(tmp_path / "no_ckpt"),
+                            name="lenet5_hlo_b4")
+    assert sm2.bucket_sizes == [4]
+    with pytest.raises(ValueError) as ei:
+        sm2.compile_bucket(8)
+    msg = str(ei.value)
+    assert "exported with bucket sizes [4]" in msg
+    assert "batch 8" in msg and "re-export" in msg
+    # the compiled callable guards runtime shapes with the same message
+    run = sm2.compile_bucket(4)
+    with pytest.raises(ValueError, match=r"bucket sizes \[4\]"):
+        run(np.zeros((2, 32, 32, 1), np.float32))
+    # an engine configured with conflicting buckets refuses at build
+    with pytest.raises(ValueError, match=r"bucket sizes \[4\]"):
+        BatchingEngine(sm2, buckets=[2, 4])
+
+
 def test_pipelined_bit_identical_to_sync(lenet_serving):
     """The same request stream through pipeline_depth=2 and the
     synchronous depth=1 path yields bit-identical rows."""
