@@ -52,6 +52,13 @@ bench-serve-sync:
 bench-serve-scaling:
 	$(PY) bench.py --serve --serve-devices 8
 
+# wire-format comparison: {float32, uint8} wire x {float32, bfloat16}
+# compute — p50/p95/p99, img/s, and H2D bytes/batch per cell
+# (docs/PERF.md "Wire format & inference dtype"); the uint8 wire must
+# show exactly 4x fewer H2D bytes than float32
+bench-serve-wire:
+	$(PY) bench.py --serve --serve-wire
+
 bench:
 	$(PY) bench.py
 
@@ -81,4 +88,5 @@ list:
 	$(PY) -m deep_vision_tpu.cli.train --list -m x
 
 .PHONY: test test-all bench bench-serve bench-serve-sync \
-	bench-serve-scaling serve-smoke serve-multi serve-chaos list
+	bench-serve-scaling bench-serve-wire serve-smoke serve-multi \
+	serve-chaos list

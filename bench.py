@@ -516,7 +516,9 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                 duration_s: float = 2.0, max_batch: int = 8,
                 max_wait_ms: float = 2.0, pipeline_depth: int = 2,
                 faults: str = "", fault_seed: int = 0,
-                serve_devices: int = 1) -> dict:
+                serve_devices: int = 1,
+                wire_dtype: str = "float32",
+                infer_dtype: str = "float32") -> dict:
     """Closed-loop load generator against the dynamic-batching engine
     (``deep_vision_tpu/serve``): C client threads each submit one image,
     wait for the answer, repeat — so C is the offered load (concurrency),
@@ -542,6 +544,13 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
     routing counters; ``bench.py --serve --serve-devices N`` sweeps
     replica counts 1, 2, 4, ... N and emits the device-scaling table
     (docs/PERF.md).
+
+    ``wire_dtype``/``infer_dtype`` select the serving wire format and
+    on-device compute dtype (docs/SERVING.md); the JSON records both
+    plus the ``h2d`` block (transfers, MiB, per-bucket bytes) so
+    BENCH_* trajectories track transfer volume alongside latency —
+    ``bench.py --serve --serve-wire`` runs the full 4-cell comparison
+    (``bench_serve_wire``).
     """
     import sys
     import tempfile
@@ -561,8 +570,15 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
         # random-init fallback: serving latency is weight-agnostic
         model, state = load_state(cfg, td,
                                   log=lambda m: print(m, file=sys.stderr))
-    sm = CheckpointServingModel(model_name, cfg, model, state)
-    img = np.random.RandomState(0).randn(*sm.input_shape).astype(np.float32)
+    sm = CheckpointServingModel(model_name, cfg, model, state,
+                                wire_dtype=wire_dtype,
+                                infer_dtype=infer_dtype)
+    if sm.wire_dtype == np.uint8:
+        img = np.random.RandomState(0).randint(
+            0, 256, size=sm.input_shape, dtype=np.uint8)
+    else:
+        img = np.random.RandomState(0).randn(
+            *sm.input_shape).astype(np.float32)
     if serve_devices > 1:
         from deep_vision_tpu.serve.replicas import (ReplicatedEngine,
                                                     local_devices)
@@ -628,8 +644,16 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
             "model": model_name, "max_batch": max_batch,
             "max_wait_ms": max_wait_ms, "buckets": stats["buckets"],
             "pipeline_depth": pipeline_depth,
+            "wire_dtype": stats["wire_dtype"],
+            "infer_dtype": stats["infer_dtype"],
             "faults": faults or None,
             "loads": points,
+            "h2d": {
+                "transfers": pipe["h2d_transfers"],
+                "mib": round(pipe["h2d_bytes"] / 2**20, 3),
+                "bytes_per_batch": round(
+                    pipe["h2d_bytes"] / max(1, pipe["h2d_transfers"])),
+                "bytes_by_bucket": pipe["h2d_bytes_by_bucket"]},
             "health": {
                 "state": health["state"],
                 "batch_failures": health["batch_failures"],
@@ -693,6 +717,37 @@ def bench_serve_scaling(serve_devices: int, **kwargs) -> dict:
     for row in table:
         row["speedup_vs_1"] = round(row["img_per_sec"] / base, 2)
     last["scaling"] = table
+    return last
+
+
+def bench_serve_wire(**kwargs) -> dict:
+    """Wire-format comparison sweep (``make bench-serve-wire``): the
+    serve bench across all four wire × compute cells — f32/uint8 wire ×
+    f32/bf16 device compute — so the uint8 wire's 4× H2D-byte cut and
+    bf16's latency effect are measured side by side (docs/PERF.md
+    "Serving wire format").  Emits the full detail of the last cell
+    (uint8 + bf16, the production configuration) plus ``wire_sweep``:
+    p50/p95/p99, img/s, and H2D bytes/batch per cell."""
+    table, last = [], None
+    for wire in ("float32", "uint8"):
+        for infer in ("float32", "bfloat16"):
+            last = bench_serve(wire_dtype=wire, infer_dtype=infer,
+                               **kwargs)
+            top = last["loads"][-1]
+            table.append({
+                "wire_dtype": wire, "infer_dtype": infer,
+                "img_per_sec": top["img_per_sec"],
+                "p50_ms": top["p50_ms"], "p95_ms": top["p95_ms"],
+                "p99_ms": top["p99_ms"], "errors": top["errors"],
+                "h2d_mib": last["h2d"]["mib"],
+                "h2d_bytes_per_batch": last["h2d"]["bytes_per_batch"]})
+    f32w = [r for r in table if r["wire_dtype"] == "float32"]
+    u8w = [r for r in table if r["wire_dtype"] == "uint8"]
+    if f32w and u8w and u8w[0]["h2d_bytes_per_batch"]:
+        last["h2d_bytes_ratio_f32_over_u8"] = round(
+            f32w[0]["h2d_bytes_per_batch"]
+            / u8w[0]["h2d_bytes_per_batch"], 2)
+    last["wire_sweep"] = table
     return last
 
 
@@ -1070,6 +1125,19 @@ def main():
                    help="in-flight batch window (--serve): 1 = the "
                         "synchronous comparison path, 2 = overlap batch "
                         "formation/H2D with device compute")
+    p.add_argument("--serve-wire", action="store_true",
+                   help="wire-format comparison sweep (--serve): f32 vs "
+                        "uint8 wire x f32 vs bf16 compute, one JSON "
+                        "with per-cell latency/throughput/H2D bytes "
+                        "(make bench-serve-wire)")
+    p.add_argument("--wire-dtype", choices=("float32", "uint8"),
+                   default="float32",
+                   help="serving wire format for a single --serve run "
+                        "(uint8 = raw pixels, on-device normalization)")
+    p.add_argument("--infer-dtype", choices=("float32", "bfloat16"),
+                   default="float32",
+                   help="on-device compute dtype for a single --serve "
+                        "run (outputs stay float32)")
     p.add_argument("--serve-devices", type=int, default=1,
                    help="device-scaling sweep (--serve): bench replica "
                         "counts 1, 2, 4, ... N and emit the scaling "
@@ -1118,11 +1186,16 @@ def main():
             duration_s=args.serve_duration, max_batch=args.batch or 8,
             pipeline_depth=args.serve_pipeline_depth,
             faults=args.faults, fault_seed=args.fault_seed)
-        if args.serve_devices > 1:
-            print(json.dumps(bench_serve_scaling(args.serve_devices,
-                                                 **serve_kwargs)))
+        if args.serve_wire:
+            print(json.dumps(bench_serve_wire(**serve_kwargs)))
+        elif args.serve_devices > 1:
+            print(json.dumps(bench_serve_scaling(
+                args.serve_devices, wire_dtype=args.wire_dtype,
+                infer_dtype=args.infer_dtype, **serve_kwargs)))
         else:
-            print(json.dumps(bench_serve(**serve_kwargs)))
+            print(json.dumps(bench_serve(wire_dtype=args.wire_dtype,
+                                         infer_dtype=args.infer_dtype,
+                                         **serve_kwargs)))
         return
     if args.infer:
         print(json.dumps(bench_infer(args.infer, steps=args.steps,

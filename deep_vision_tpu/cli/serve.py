@@ -11,6 +11,13 @@
     python -m deep_vision_tpu.cli.serve -m yolov3_voc --workdir runs/y \\
         --max-batch 16 --max-wait-ms 8 --max-queue 512 --warmup
 
+    # wire + compute dtype: clients ship raw uint8 pixels by default
+    # (normalization runs on device); bf16 halves the compute footprint
+    python -m deep_vision_tpu.cli.serve -m resnet50 --workdir runs/r50 \\
+        --infer-dtype bfloat16
+    python -m deep_vision_tpu.cli.serve -m resnet50 --workdir runs/r50 \\
+        --wire-dtype float32   # the pre-uint8 host-normalized contract
+
     # chaos: boot with a deterministic fault spec (docs/SERVING.md)
     python -m deep_vision_tpu.cli.serve -m lenet5 --workdir runs/l \\
         --faults 'compute:exception:times=1' --fault-seed 0
@@ -50,11 +57,27 @@ def build_server(args):
     from deep_vision_tpu.serve.replicas import ReplicatedEngine, local_devices
 
     registry = ModelRegistry()
+    # uint8 is the production serving wire (4× smaller H2D payloads,
+    # normalization fused into the bucket programs); the registry's
+    # programmatic default stays float32 so direct callers keep the old
+    # host-normalized contract (docs/SERVING.md "Wire format")
+    wire_dtype = getattr(args, "wire_dtype", "uint8") or "uint8"
+    infer_dtype = getattr(args, "infer_dtype", "float32") or "float32"
     if args.stablehlo:
+        if infer_dtype != "float32":
+            raise ValueError(
+                "--stablehlo serves the blob's exported float32 "
+                "signature; --infer-dtype bfloat16 needs the checkpoint "
+                "path (re-serve without --stablehlo)")
+        # blobs were traced at float32 with host-side normalization —
+        # the wire knob doesn't apply (describe() shows the real wire)
+        wire_dtype = "float32"
         sm = registry.load_exported(args.model, args.stablehlo,
                                     args.workdir)
     else:
-        sm = registry.load_checkpoint(args.model, args.workdir)
+        sm = registry.load_checkpoint(args.model, args.workdir,
+                                      wire_dtype=wire_dtype,
+                                      infer_dtype=infer_dtype)
     buckets = [int(b) for b in args.buckets.split(",")] if args.buckets \
         else None
     fault_spec = getattr(args, "faults", None)
@@ -136,6 +159,20 @@ def main(argv=None):
                    help="dispatched-but-undrained batch window: 1 = "
                         "synchronous, 2 = overlap batch N+1 formation/"
                         "H2D with batch N compute (docs/SERVING.md)")
+    p.add_argument("--wire-dtype", choices=("uint8", "float32"),
+                   default="uint8",
+                   help="client wire format: uint8 = raw 0-255 pixels, "
+                        "normalization runs on device inside the bucket "
+                        "programs (4x smaller H2D; the default); "
+                        "float32 = host-preprocessed floats (the "
+                        "pre-uint8 contract).  StableHLO blobs always "
+                        "serve their exported float32 signature")
+    p.add_argument("--infer-dtype", choices=("float32", "bfloat16"),
+                   default="float32",
+                   help="on-device compute dtype: bfloat16 casts params "
+                        "once at load and runs bucket programs in bf16 "
+                        "with float32 outputs (docs/SERVING.md bf16 "
+                        "caveats); checkpoint path only")
     p.add_argument("--serve-devices", type=int, default=1,
                    help="replicate the engine over this many local "
                         "devices behind one queue (0 = all; default 1 "
@@ -190,11 +227,13 @@ def main(argv=None):
 
     enable_compile_cache()
     engine, server = build_server(args)
+    sm = engine.model
     print(f"[serve] {args.model} listening on "
           f"http://{server.host}:{server.port} "
           f"(buckets={engine.buckets}, max_wait={args.max_wait_ms}ms, "
           f"max_queue={args.max_queue}, "
-          f"pipeline_depth={engine.pipeline_depth})")
+          f"pipeline_depth={engine.pipeline_depth}, "
+          f"wire={sm.wire_dtype}, infer={sm.infer_dtype})")
     if hasattr(engine, "replicas"):
         print(f"[serve] {len(engine.replicas)} replicas: "
               + ", ".join(r.model.placement_desc() or "default"

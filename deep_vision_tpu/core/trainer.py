@@ -414,19 +414,37 @@ class Trainer:
             if evaluator is not None and extra is not None:
                 if jax.process_count() > 1:
                     # extras are batch-sharded over `data`, which spans
-                    # processes — gather every host's shard so each rank's
-                    # accumulator sees the GLOBAL val set (and all ranks
-                    # therefore report identical mAP)
+                    # processes — gather every host's shard (the gather
+                    # is collective: every rank must call it) but feed
+                    # the host-side accumulator on process 0 ONLY; the
+                    # other ranks get the scalar metrics broadcast below
+                    # instead of redoing the whole mAP sweep per rank
                     from jax.experimental import multihost_utils
                     extra = multihost_utils.process_allgather(extra,
                                                               tiled=True)
+                    if jax.process_index() != 0:
+                        continue
                 else:
                     extra = jax.device_get(extra)
                 evaluator.add_batch(extra)
         count = max(totals.pop("count", 1.0), 1.0)
         out = {k: v / count for k, v in totals.items()}
         if evaluator is not None:
-            out.update(evaluator.compute())
+            ev = evaluator.compute()
+            if jax.process_count() > 1:
+                # non-zero ranks hold an EMPTY accumulator: compute()
+                # still yields the metric KEYS (zero-valued), which is
+                # all they need to receive rank 0's values in a fixed
+                # key order — every rank reports identical metrics while
+                # only one ran the host-side mAP sweep
+                import numpy as np
+                from jax.experimental import multihost_utils
+                keys = sorted(k for k, v in ev.items()
+                              if isinstance(v, (int, float)))
+                vals = multihost_utils.broadcast_one_to_all(
+                    np.asarray([float(ev[k]) for k in keys], np.float32))
+                ev = {k: float(v) for k, v in zip(keys, np.asarray(vals))}
+            out.update(ev)
         return out
 
     def train_epoch(self, state: TrainState, train_data: Iterable,

@@ -17,9 +17,63 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from deep_vision_tpu.data.mnist import MEAN as MNIST_MEAN
+from deep_vision_tpu.data.mnist import STD as MNIST_STD
 from deep_vision_tpu.data.transforms import IMAGENET_MEAN, IMAGENET_STD
 
 _GRAY = jnp.asarray([0.299, 0.587, 0.114])
+
+#: normalization families the serving wire supports (docs/SERVING.md
+#: "Wire format & inference dtype"); "unit" is plain [0,1] scaling
+SERVE_KINDS = ("imagenet", "mnist", "unit")
+
+
+def serve_preprocess_kind(task: str, channels: int) -> str:
+    """Which normalization family a model's uint8 serving wire needs —
+    derived from config metadata so the device prologue matches the
+    host path that trained the model: classification RGB models were
+    trained on ImageNet-standardized inputs (data/transforms.py),
+    grayscale classification on MNIST stats (data/mnist.py), and the
+    detection/pose/GAN tasks on plain [0,1] images."""
+    if task == "classification":
+        return "mnist" if channels == 1 else "imagenet"
+    return "unit"
+
+
+def serve_normalize(x, kind: str):
+    """uint8 wire batch → normalized float32, IDENTICAL math to the host
+    preprocess for ``kind`` (scale first, then standardize — same op
+    order as data/transforms.normalize and data/mnist.preprocess, so
+    uint8-wire outputs stay allclose to the float32 wire)."""
+    if kind not in SERVE_KINDS:
+        raise ValueError(f"unknown serve preprocess kind '{kind}' "
+                         f"(have {SERVE_KINDS})")
+    x = x.astype(jnp.float32) / 255.0
+    if kind == "imagenet":
+        return (x - jnp.asarray(IMAGENET_MEAN)) / jnp.asarray(IMAGENET_STD)
+    if kind == "mnist":
+        return (x - MNIST_MEAN) / MNIST_STD
+    return x  # "unit": [0,1] inputs (YOLO/CenterNet/hourglass/GANs)
+
+
+def make_serve_preprocess(kind: str, wire_dtype, compute_dtype=jnp.float32):
+    """Traced prologue for serving bucket programs (serve/registry.py).
+
+    An integer ``wire_dtype`` means the client shipped raw 0–255 pixels
+    and the server owns normalization: cast + scale + standardize run on
+    device, fused by XLA into the first conv's HBM read (the H2D carried
+    4× fewer bytes).  A float wire passes through untouched — those
+    clients already normalized on the host (the pre-uint8 contract).
+    Either way the batch lands in ``compute_dtype`` (bfloat16 for
+    ``--infer-dtype bfloat16``, else float32)."""
+    wire_is_int = jnp.issubdtype(jnp.dtype(wire_dtype), jnp.integer)
+
+    def fn(x):
+        if wire_is_int:
+            x = serve_normalize(x, kind)
+        return x.astype(compute_dtype)
+
+    return fn
 
 
 def jitter_normalize(images, rng, train: bool,

@@ -140,10 +140,15 @@ class StagingPool:
     returned after the drainer's bulk fetch — so steady state holds at
     most ``pipeline_depth + 1`` buffers per active bucket, reused
     forever.  ``allocated``/``reused`` make the reuse testable.
+
+    Buffers carry the model's WIRE dtype: a uint8 wire stages (and
+    H2D-transfers) 4× fewer bytes per padded batch than the float32
+    wire (docs/SERVING.md "Wire format & inference dtype").
     """
 
-    def __init__(self, input_shape: tuple):
+    def __init__(self, input_shape: tuple, dtype=np.float32):
         self._input_shape = tuple(input_shape)
+        self.dtype = np.dtype(dtype)
         self._free: dict[int, list[np.ndarray]] = {}
         self._lock = threading.Lock()
         self.allocated = 0
@@ -156,7 +161,7 @@ class StagingPool:
                 self.reused += 1
                 return free.pop()
             self.allocated += 1
-        return np.zeros((bucket, *self._input_shape), np.float32)
+        return np.zeros((bucket, *self._input_shape), self.dtype)
 
     def release(self, bucket: int, buf: np.ndarray):
         with self._lock:
@@ -165,6 +170,7 @@ class StagingPool:
     def stats(self) -> dict:
         with self._lock:
             return {"allocated": self.allocated, "reused": self.reused,
+                    "dtype": str(self.dtype),
                     "pooled": {b: len(v) for b, v in self._free.items()}}
 
 
@@ -230,7 +236,12 @@ class BatchingEngine:
             max_wait_ms=max_wait_ms)
         self.latency = LatencyHistogram()
         self.throughput = ThroughputMeter(warmup_steps=1)
-        self.staging = StagingPool(model.input_shape)
+        # the model's wire format IS the staging/H2D dtype: submit casts
+        # to it, pooled buffers allocate in it, the bulk device_put
+        # ships it (uint8 wire = 4× fewer staged bytes than float32)
+        self.wire_dtype = np.dtype(getattr(model, "wire_dtype",
+                                           np.float32))
+        self.staging = StagingPool(model.input_shape, self.wire_dtype)
         self.faults = faults or FaultPlane.from_env()
         self.health = EngineHealth(degraded_after=degraded_after,
                                    dead_after=dead_after)
@@ -275,6 +286,12 @@ class BatchingEngine:
         self.padded_images = 0
         self.bulk_transfers = 0
         self.bulk_transfer_bytes = 0
+        # H2D accounting: bytes of staged wire-format batches shipped to
+        # the device (the observable 4× win of the uint8 wire) — counted
+        # at both the pipelined dispatch and the synchronous retry path
+        self.h2d_transfers = 0
+        self.h2d_bytes = 0
+        self.h2d_bytes_by_bucket: dict[int, int] = {}
         # fault-tolerance accounting
         self.batch_failures = 0
         self.retry_executions = 0
@@ -363,7 +380,7 @@ class BatchingEngine:
 
         for b in (buckets or self.buckets):
             jax.block_until_ready(self._compiled(b)(np.zeros(
-                (b, *self.model.input_shape), np.float32)))
+                (b, *self.model.input_shape), self.wire_dtype)))
 
     # -- request path ------------------------------------------------------
 
@@ -394,8 +411,10 @@ class BatchingEngine:
             fut.set_result(shed)
             return fut
         poison = self.faults.mark_poison() if self.faults.enabled else False
-        self._queue.put(_Request(np.asarray(image, np.float32), deadline,
-                                 now, fut, poison))
+        # the request rides the WIRE dtype end to end: uint8 clients hand
+        # raw pixels straight through to the staged batch (no float copy)
+        self._queue.put(_Request(np.asarray(image, self.wire_dtype),
+                                 deadline, now, fut, poison))
         return fut
 
     def infer(self, image, deadline_ms: float | None = None,
@@ -540,6 +559,10 @@ class BatchingEngine:
         rec = _Inflight(live, bucket, out, buf, t0,
                         threading.Event() if self.faults.enabled else None)
         with self._lock:
+            self.h2d_transfers += 1
+            self.h2d_bytes += buf.nbytes
+            self.h2d_bytes_by_bucket[bucket] = \
+                self.h2d_bytes_by_bucket.get(bucket, 0) + buf.nbytes
             if self._inflight == 0 and self._last_done is not None:
                 self._idle_s += t0 - self._last_done
             if self._first_dispatch is None:
@@ -599,8 +622,12 @@ class BatchingEngine:
         # + transfer per request per leaf
         host = jax.device_get(rec.out)
         if mode == "nan":
+            # corrupt only FLOAT leaves: integer outputs (class ids,
+            # valid masks) can't hold NaN and _check_outputs skips them
             host = jax.tree_util.tree_map(
-                lambda a: np.full_like(np.asarray(a), np.nan), host)
+                lambda a: np.full_like(np.asarray(a), np.nan)
+                if np.asarray(a).dtype.kind == "f" else np.asarray(a),
+                host)
         if self._validate:
             self._check_outputs(host)
         if rec.cancelled:
@@ -727,6 +754,11 @@ class BatchingEngine:
                 if self.faults.cohort_poisoned(requests):
                     raise InjectedFault(
                         f"poisoned request in retry cohort of {n}")
+            with self._lock:
+                self.h2d_transfers += 1
+                self.h2d_bytes += buf.nbytes
+                self.h2d_bytes_by_bucket[bucket] = \
+                    self.h2d_bytes_by_bucket.get(bucket, 0) + buf.nbytes
             host = jax.device_get(fn(self._put(buf)))
             if self._validate:
                 self._check_outputs(host)
@@ -882,12 +914,19 @@ class BatchingEngine:
                    "buckets": list(self.buckets),
                    "compiled_buckets": sorted(self._executables),
                    "max_wait_ms": self.max_wait_s * 1e3,
+                   "wire_dtype": str(self.wire_dtype),
+                   "infer_dtype": getattr(self.model, "infer_dtype",
+                                          "float32"),
                    "pipeline": {
                        "depth": self.pipeline_depth,
                        "inflight": self._inflight,
                        "max_inflight": self.max_inflight,
                        "bulk_transfers": self.bulk_transfers,
                        "bulk_transfer_bytes": self.bulk_transfer_bytes,
+                       "h2d_transfers": self.h2d_transfers,
+                       "h2d_bytes": self.h2d_bytes,
+                       "h2d_bytes_by_bucket": dict(
+                           self.h2d_bytes_by_bucket),
                        # host proxy: fraction of the first-dispatch →
                        # last-drain span with an empty in-flight window
                        "device_idle_frac": (

@@ -18,10 +18,14 @@ Routes (JSON in, JSON out):
                         "model"?, "deadline_ms"?, "top_k"?}
     POST /v1/detect    same inputs + "score_threshold"?; YOLO models
 
-Image payloads: ``pixels`` is a preprocessed (H, W, C) float array (the
-machine-to-machine path, and what the tests/smoke use); ``image_b64`` is
-a base64-encoded image file decoded + preprocessed server-side exactly
-like ``cli.infer`` (requires PIL).  Shed requests answer 429 with the
+Image payloads: ``pixels`` is an (H, W, C) array in the model's WIRE
+dtype — raw 0–255 integers on the uint8 wire (the ``cli.serve``
+default; the server normalizes on device), a host-preprocessed float
+array on the float32 wire (the machine-to-machine back-compat path).
+Non-finite float payloads reject 400 at decode.  ``image_b64`` is a
+base64-encoded image file decoded + resized server-side in integer
+space; the float32 wire additionally normalizes exactly like
+``cli.infer`` (requires PIL).  Shed requests answer 429 with the
 shed reason (queue-full sheds add a ``Retry-After`` header) so clients
 can retry against another replica; quarantined (poison) requests answer
 500 with the isolation detail.  Bodies over ``max_body_bytes`` (default
@@ -49,17 +53,35 @@ class ServeError(Exception):
 
 
 def _decode_pixels(body: dict, model):
-    """Body → one (H, W, C) float32 image in the model's input layout."""
+    """Body → one (H, W, C) image in the model's WIRE dtype + layout.
+
+    ``pixels`` lists decode STRAIGHT to the wire dtype (no float64
+    detour copy: json gives Python scalars, one ``np.asarray`` lands
+    them in uint8 or float32).  ``image_b64`` decodes + resizes in
+    integer space; on a uint8 wire the pixels ship raw (the bucket
+    program normalizes on device), on a float32 wire the host applies
+    the model family's normalization exactly like ``cli.infer``.
+    """
     import numpy as np
 
+    wire = np.dtype(getattr(model, "wire_dtype", np.float32))
     if "pixels" in body:
-        x = np.asarray(body["pixels"], np.float32)
+        try:
+            x = np.asarray(body["pixels"], wire)
+        except (ValueError, TypeError, OverflowError) as e:
+            # ragged lists, non-numeric entries, or NaN/Inf → integer
+            raise ServeError(400, f"bad pixels payload: {e}") from e
         if x.ndim == 2 and model.input_shape[-1] == 1:
             x = x[..., None]
         if x.shape != model.input_shape:
             raise ServeError(
                 400, f"pixels shape {list(x.shape)} != model input "
                      f"{list(model.input_shape)}")
+        if wire.kind == "f" and not np.isfinite(x).all():
+            # NaN/Inf would propagate through the whole padded batch's
+            # outputs — reject at the door, not in the batcher
+            raise ServeError(
+                400, "pixels contain non-finite values (NaN/Inf)")
         return x
     if "image_b64" in body:
         try:
@@ -71,23 +93,35 @@ def _decode_pixels(body: dict, model):
         size = model.input_shape[0]
         img = Image.open(io.BytesIO(raw))
         if model.input_shape[-1] == 1:
-            # grayscale models (LeNet): MNIST-style preprocessing
+            # grayscale models (LeNet): MNIST-style geometry — resize to
+            # size-4 and pad 2px each side, all in uint8
+            arr = np.asarray(img.convert("L").resize((size - 4, size - 4)))
+            u8 = np.pad(arr, 2)[:size, :size, None]
+            if wire.kind == "u":
+                return u8  # device prologue scales + standardizes
             from deep_vision_tpu.data.mnist import preprocess
 
-            arr = np.asarray(img.convert("L").resize((size - 4, size - 4)))
             return preprocess(arr[None])[0][:size, :size]
         arr = np.asarray(img.convert("RGB"))
         if model.task == "classification":
             from deep_vision_tpu.data.transforms import (
                 eval_transform,
+                eval_transform_u8,
                 imagenet_resize_for,
             )
 
+            if wire.kind == "u":
+                # same rescale→center-crop geometry, kept uint8
+                return np.ascontiguousarray(eval_transform_u8(
+                    arr, size, imagenet_resize_for(size)))
             return eval_transform(arr, size, imagenet_resize_for(size))
         # detection/pose: [0,1] inputs, not imagenet-normalized
         from deep_vision_tpu.data.detection import resize_square
 
-        return resize_square(arr, size).astype(np.float32) / 255.0
+        u8 = resize_square(arr, size)
+        if wire.kind == "u":
+            return np.asarray(u8, np.uint8)
+        return u8.astype(np.float32) / 255.0
     raise ServeError(400, "body needs 'pixels' or 'image_b64'")
 
 

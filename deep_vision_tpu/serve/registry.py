@@ -49,6 +49,15 @@ import warnings
 
 import numpy as np
 
+#: supported serving wire formats: what dtype the client ships and the
+#: engine stages/H2D-transfers.  uint8 carries raw 0–255 pixels (4×
+#: fewer bytes than float32) and moves normalization into the bucket
+#: program's traced prologue (ops/preprocess.make_serve_preprocess);
+#: float32 is the original host-normalized contract.
+WIRE_DTYPES = ("float32", "uint8")
+#: supported on-device compute dtypes (outputs are always float32)
+INFER_DTYPES = ("float32", "bfloat16")
+
 
 class ServingModel:
     """One deployable model: metadata + per-bucket compiled forwards."""
@@ -58,12 +67,25 @@ class ServingModel:
 
     def __init__(self, name: str, *, task: str, input_shape: tuple,
                  num_classes: int, config_name: str | None = None,
-                 fixed_batch: int | None = None):
+                 fixed_batch: int | None = None,
+                 wire_dtype: str = "float32",
+                 infer_dtype: str = "float32"):
+        if str(wire_dtype) not in WIRE_DTYPES:
+            raise ValueError(f"wire_dtype '{wire_dtype}' unsupported "
+                             f"(have {WIRE_DTYPES})")
+        if str(infer_dtype) not in INFER_DTYPES:
+            raise ValueError(f"infer_dtype '{infer_dtype}' unsupported "
+                             f"(have {INFER_DTYPES})")
         self.name = name
         self.task = task
         self.input_shape = tuple(input_shape)  # (H, W, C), batch excluded
         self.num_classes = num_classes
         self.config_name = config_name or name
+        # what the engine stages + transfers (np dtype: the StagingPool
+        # buffers and the bulk H2D device_put carry exactly this)
+        self.wire_dtype = np.dtype(str(wire_dtype))
+        # what the bucket programs compute in (outputs stay float32)
+        self.infer_dtype = str(infer_dtype)
         # StableHLO blobs are traced at one batch shape; checkpoint-backed
         # models compile any bucket (None = unconstrained)
         self.fixed_batch = fixed_batch
@@ -98,6 +120,8 @@ class ServingModel:
                 "num_classes": self.num_classes,
                 "fixed_batch": self.fixed_batch,
                 "donates_inputs": self.donates_inputs,
+                "wire_dtype": str(self.wire_dtype),
+                "infer_dtype": self.infer_dtype,
                 "placement": self.placement_desc(),
                 "restored_step": self.restored_step,
                 "restore_fallback": self.restore_fallback}
@@ -108,12 +132,36 @@ class CheckpointServingModel(ServingModel):
 
     donates_inputs = True
 
-    def __init__(self, name: str, cfg, model, state):
+    def __init__(self, name: str, cfg, model, state,
+                 wire_dtype: str = "float32",
+                 infer_dtype: str = "float32"):
         super().__init__(
             name, task=cfg.task,
             input_shape=(cfg.image_size, cfg.image_size, cfg.channels),
-            num_classes=cfg.num_classes, config_name=cfg.name)
+            num_classes=cfg.num_classes, config_name=cfg.name,
+            wire_dtype=wire_dtype, infer_dtype=infer_dtype)
         self.cfg = cfg
+        # which device-side normalization a uint8 wire needs — derived
+        # from the config so it matches the host path the model trained
+        # against (a float32 wire skips it: the client normalized)
+        from deep_vision_tpu.ops.preprocess import serve_preprocess_kind
+
+        self.preprocess_kind = serve_preprocess_kind(cfg.task, cfg.channels)
+        if self.infer_dtype == "bfloat16":
+            import jax
+            import jax.numpy as jnp
+
+            # every zoo model threads its ``dtype`` attr through the
+            # compute graph (x.astype(self.dtype) before the first conv)
+            # — clone with bf16 so activations run in bf16, and cast the
+            # float variable leaves ONCE here at load (half the param
+            # HBM and per-device replica copies too)
+            if hasattr(model, "dtype"):
+                model = model.clone(dtype=jnp.bfloat16)
+            state = state.replace(params=jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.bfloat16)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                state.params))
         self._model = model
         variables = {"params": state.params}
         if state.batch_stats:
@@ -176,11 +224,26 @@ class CheckpointServingModel(ServingModel):
                     f"buckets that are multiples of {n} "
                     f"(engine.sharded_buckets)")
 
+        from deep_vision_tpu.ops.preprocess import make_serve_preprocess
+
+        wire = jnp.dtype(str(self.wire_dtype))
+        compute = jnp.bfloat16 if self.infer_dtype == "bfloat16" \
+            else jnp.float32
+        # traced prologue: a uint8 wire batch is cast + scaled +
+        # normalized ON DEVICE (XLA fuses it into the first conv's HBM
+        # read — the H2D carried 4× fewer bytes); a float32 wire passes
+        # through (the client normalized).  Outputs always leave the
+        # program as float32, whatever the compute dtype.
+        pre = make_serve_preprocess(self.preprocess_kind, wire, compute)
+
         def apply(variables, x):
-            return self._model.apply(variables, x, train=False)
+            out = self._model.apply(variables, pre(x), train=False)
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(jnp.float32)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, out)
 
         x_spec = jax.ShapeDtypeStruct((batch, *self.input_shape),
-                                      jnp.float32, sharding=self.placement)
+                                      wire, sharding=self.placement)
         v_spec = jax.tree_util.tree_map(
             lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
                                            sharding=self._var_sharding),
@@ -201,13 +264,14 @@ class CheckpointServingModel(ServingModel):
         variables = self._variables
 
         placement = self.placement
+        wire_np = self.wire_dtype
 
         def call(x):
             # keep donation meaningful for direct numpy callers too:
             # transfer first, hand the committed device buffer over —
             # honoring the view's placement (replica device / mesh)
             if not isinstance(x, jax.Array):
-                x = jax.device_put(np.asarray(x, np.float32), placement)
+                x = jax.device_put(np.asarray(x, wire_np), placement)
             with warnings.catch_warnings():
                 warnings.filterwarnings(
                     "ignore",
@@ -218,7 +282,13 @@ class CheckpointServingModel(ServingModel):
 
 
 class ExportedServingModel(ServingModel):
-    """StableHLO-blob-backed (core/export): fixed batch, no Python model."""
+    """StableHLO-blob-backed (core/export): fixed batch, no Python model.
+
+    Blobs serve exactly their exported signature — traced at float32
+    with host-side normalization — so the wire/infer dtype knobs don't
+    apply here (``wire_dtype``/``infer_dtype`` stay "float32";
+    ``cli.serve`` forces the same when ``--stablehlo`` is given).
+    """
 
     def __init__(self, name: str, cfg, call, variables, fixed_batch: int):
         super().__init__(
@@ -265,14 +335,25 @@ class ModelRegistry:
         return model
 
     def load_checkpoint(self, config_name: str, workdir: str,
-                        name: str | None = None) -> ServingModel:
+                        name: str | None = None,
+                        wire_dtype: str = "float32",
+                        infer_dtype: str = "float32") -> ServingModel:
+        """``wire_dtype``: what clients ship and the engine H2D-transfers
+        — "uint8" (raw 0–255 pixels, normalization fused into the bucket
+        programs; the ``cli.serve`` default) or "float32" (the original
+        host-normalized contract; the programmatic default, so existing
+        direct callers are untouched).  ``infer_dtype``: "bfloat16" casts
+        params once here and runs bucket programs in bf16 compute with
+        float32 outputs."""
         from deep_vision_tpu.core.config import get_config
         from deep_vision_tpu.core.restore import load_state
 
         cfg = get_config(config_name)
         info: dict = {}
         model, state = load_state(cfg, workdir, tag="serve", info=info)
-        sm = CheckpointServingModel(name or config_name, cfg, model, state)
+        sm = CheckpointServingModel(name or config_name, cfg, model, state,
+                                    wire_dtype=wire_dtype,
+                                    infer_dtype=infer_dtype)
         sm.restored_step = info.get("step")
         sm.restore_fallback = bool(info.get("fallback"))
         return self.add(sm)

@@ -136,6 +136,8 @@ class ReplicatedEngine:
         self.buckets = self.replicas[0].buckets
         self.max_batch = self.replicas[0].max_batch
         self.pipeline_depth = self.replicas[0].pipeline_depth
+        # every replica view shares the source model's wire format
+        self.wire_dtype = self.replicas[0].wire_dtype
         # DEAD replicas drop out of the shed estimate as they drop out
         # of routing
         self.admission.set_free_replicas(self._free_replicas)
@@ -247,8 +249,8 @@ class ReplicatedEngine:
             fut.set_result(shed)
             return fut
         poison = self.faults.mark_poison() if self.faults.enabled else False
-        self._queue.put(_Request(np.asarray(image, np.float32), deadline,
-                                 now, fut, poison))
+        self._queue.put(_Request(np.asarray(image, self.wire_dtype),
+                                 deadline, now, fut, poison))
         return fut
 
     def infer(self, image, deadline_ms: float | None = None,
@@ -506,6 +508,9 @@ class ReplicatedEngine:
                    "queue_depth": self._queue.qsize(),
                    "buckets": list(self.buckets),
                    "max_wait_ms": self.max_wait_s * 1e3,
+                   "wire_dtype": str(self.wire_dtype),
+                   "infer_dtype": getattr(self.model, "infer_dtype",
+                                          "float32"),
                    "routing": {
                        "policy": "least_outstanding_work",
                        "replicas": len(self.replicas),
@@ -515,9 +520,13 @@ class ReplicatedEngine:
                        "shed_all_dead": self.shed_all_dead}}
         out["replicas"] = per
         pooled: dict = {}
+        h2d_by_bucket: dict = {}
         for r in self.replicas:
             for b, nbuf in r.staging.stats()["pooled"].items():
                 pooled[b] = pooled.get(b, 0) + nbuf
+            with r._lock:
+                for b, nb in r.h2d_bytes_by_bucket.items():
+                    h2d_by_bucket[b] = h2d_by_bucket.get(b, 0) + nb
         out["pipeline"] = {
             "depth": self.pipeline_depth,
             "inflight": self.total_inflight(),
@@ -526,6 +535,9 @@ class ReplicatedEngine:
                                   for r in self.replicas),
             "bulk_transfer_bytes": sum(r.bulk_transfer_bytes
                                        for r in self.replicas),
+            "h2d_transfers": sum(r.h2d_transfers for r in self.replicas),
+            "h2d_bytes": sum(r.h2d_bytes for r in self.replicas),
+            "h2d_bytes_by_bucket": h2d_by_bucket,
             # the single-engine host proxy doesn't compose across
             # replicas (their windows overlap in wall time)
             "device_idle_frac": None,
@@ -533,6 +545,7 @@ class ReplicatedEngine:
                 "allocated": sum(r.staging.allocated
                                  for r in self.replicas),
                 "reused": sum(r.staging.reused for r in self.replicas),
+                "dtype": str(self.wire_dtype),
                 "pooled": pooled}}
         out["latency"] = merged.percentiles()
         out["img_per_sec"] = round(img_per_sec, 2)

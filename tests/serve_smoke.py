@@ -4,11 +4,13 @@ assert a 200 — once on the synchronous path (pipeline_depth=1), once on
 the pipelined executor (depth=2, the production default; asserting the
 scatter did exactly one bulk D2H per batch), once with an injected
 transient compute failure (the request must still answer 200 through
-bisect-retry and deep health must settle back to OK), and finally the
-multi-device pass in a fresh subprocess with 2 forced host devices
-(`make serve-multi` runs just that pass): a 2-replica engine at depth 2
-with the same injected fault — requests spread over both replicas,
-routing/health surface per-replica state, still 200s throughout.
+bisect-retry and deep health must settle back to OK), once with the
+full production wire (uint8 images + bfloat16 compute) through the same
+fault, and finally the multi-device pass in a fresh subprocess with 2
+forced host devices (`make serve-multi` runs just that pass): a
+2-replica engine at depth 2, uint8 wire + bf16 compute, with the same
+injected fault — requests spread over both replicas, routing/health
+surface per-replica state, still 200s throughout.
 Exercises exactly the `python -m deep_vision_tpu.cli.serve` path
 (cli.serve.build_server), just without serve_forever in the foreground —
 run directly, not under pytest."""
@@ -28,7 +30,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def smoke_one(pipeline_depth: int, faults: str = "",
-              serve_devices: int = 1, requests: int = 1) -> None:
+              serve_devices: int = 1, requests: int = 1,
+              wire_dtype: str = "uint8",
+              infer_dtype: str = "float32") -> None:
     from deep_vision_tpu.cli.serve import build_server
 
     with tempfile.TemporaryDirectory() as workdir:
@@ -39,7 +43,8 @@ def smoke_one(pipeline_depth: int, faults: str = "",
             host="127.0.0.1", port=0, max_batch=4, max_wait_ms=2.0,
             buckets=None, max_queue=64, warmup=False, verbose=False,
             pipeline_depth=pipeline_depth, faults=faults, fault_seed=0,
-            serve_devices=serve_devices, shard_batches=False)
+            serve_devices=serve_devices, shard_batches=False,
+            wire_dtype=wire_dtype, infer_dtype=infer_dtype)
         engine, server = build_server(args)
         server.start_background()
         base = f"http://{server.host}:{server.port}"
@@ -53,8 +58,14 @@ def smoke_one(pipeline_depth: int, faults: str = "",
                 if serve_devices > 1:
                     assert len(rep["replicas"]) == serve_devices, rep
                     assert rep["can_serve"], rep
-            body = json.dumps(
-                {"pixels": np.zeros((32, 32, 1)).tolist()}).encode()
+            # raw [0, 255] pixels on the uint8 wire (ints on the wire);
+            # host-normalized floats on the legacy float32 wire
+            if wire_dtype == "uint8":
+                pixels = np.random.default_rng(0).integers(
+                    0, 256, (32, 32, 1)).tolist()
+            else:
+                pixels = np.zeros((32, 32, 1)).tolist()
+            body = json.dumps({"pixels": pixels}).encode()
             for _ in range(requests):
                 req = urllib.request.Request(
                     base + "/v1/classify", data=body,
@@ -70,6 +81,14 @@ def smoke_one(pipeline_depth: int, faults: str = "",
             assert pipe["depth"] == pipeline_depth, pipe
             # the scatter contract: ONE bulk D2H per executed batch
             assert pipe["bulk_transfers"] == stats["batches"] >= 1, pipe
+            # the wire contract: images staged/transferred in the wire
+            # dtype, computed in the infer dtype, H2D bytes accounted
+            assert stats["wire_dtype"] == wire_dtype, stats["wire_dtype"]
+            assert stats["infer_dtype"] == infer_dtype, stats["infer_dtype"]
+            assert pipe["staging"]["dtype"] == wire_dtype, pipe["staging"]
+            assert pipe["h2d_transfers"] >= stats["batches"], pipe
+            px_bytes = 32 * 32 * (1 if wire_dtype == "uint8" else 4)
+            assert pipe["h2d_bytes"] >= pipe["h2d_transfers"] * px_bytes, pipe
             health = stats["health"]
             assert health["state"] == "ok", health
             if faults:
@@ -87,11 +106,13 @@ def smoke_one(pipeline_depth: int, faults: str = "",
                 assert stats["admission"]["free_replicas"] \
                     == serve_devices, stats["admission"]
                 extra = f", {serve_devices} replicas routed {routed}"
-            print(f"serve-smoke PASS (pipeline_depth={pipeline_depth}"
+            print(f"serve-smoke PASS (pipeline_depth={pipeline_depth}, "
+                  f"wire={wire_dtype}, infer={infer_dtype}"
                   + (f", faults='{faults}'" if faults else "") + "): "
                   f"200 from port {server.port}, top-1 class "
                   f"{top[0]['class']}, {pipe['bulk_transfers']} bulk "
                   f"transfer(s) for {stats['batches']} batch(es), "
+                  f"{pipe['h2d_bytes']} H2D byte(s), "
                   f"health {health['state']}{extra}")
         finally:
             server.shutdown()
@@ -116,14 +137,23 @@ def main():
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        # the production wire: uint8 images, bf16 matmuls, f32 outputs —
+        # replicated over both devices with an injected fault
         smoke_one(2, faults="compute:exception:times=1",
-                  serve_devices=2, requests=6)
+                  serve_devices=2, requests=6,
+                  wire_dtype="uint8", infer_dtype="bfloat16")
         return 0
-    for depth in (1, 2):
-        smoke_one(depth)
+    # legacy float32 wire still serves (back-compat path)
+    smoke_one(1, wire_dtype="float32")
+    # production default: uint8 wire, device-side preprocessing
+    smoke_one(2)
     # fault-injected pass: one transient compute failure — the request
     # must still answer 200 (bisect-retry), health must settle back OK
     smoke_one(2, faults="compute:exception:times=1")
+    # uint8 wire + bfloat16 compute together, through the same fault —
+    # the retry path must re-stage the uint8 cohort and still answer 200
+    smoke_one(2, faults="compute:exception:times=1",
+              wire_dtype="uint8", infer_dtype="bfloat16")
     # multi-device pass: a fresh subprocess, because the forced host
     # device count must be set before this process's jax backend exists
     import subprocess
