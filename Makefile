@@ -19,9 +19,22 @@ test-all:
 # issue one request, assert a 200 — once synchronous (pipeline_depth=1),
 # once pipelined (depth=2), once fault-injected, and once replicated over
 # 2 fake host devices (the cli.serve wiring, end to end; one bulk D2H
-# per batch throughout)
+# per batch throughout); then the gateway smoke (cross-host failover)
 serve-smoke:
 	$(PY) tests/serve_smoke.py
+	$(PY) tests/gateway_smoke.py
+
+# the cross-host failover contract end to end: 2 backend serve
+# SUBPROCESSES behind the in-process gateway, fault-injected load
+# through the gateway, a real SIGKILL of one backend mid-run (zero
+# client-visible errors, breaker opens), then /v1/drain on the survivor
+# (healthz 503 draining -> gateway healthz 503)
+gateway-smoke:
+	$(PY) tests/gateway_smoke.py
+
+# the gateway unit/chaos suite alone (stub + real in-process backends)
+gateway-test:
+	$(PY) -m pytest tests/test_gateway.py -q -m gateway
 
 # just the multi-device pass: 2 forced host devices, a 2-replica engine
 # at depth 2 with a fault-injected cohort (serve/replicas.py routing,
@@ -59,6 +72,13 @@ bench-serve-scaling:
 bench-serve-wire:
 	$(PY) bench.py --serve --serve-wire
 
+# gateway failover bench: backends behind serve/gateway.py, one
+# hard-killed a third into the top load point — reports errors after
+# the kill (contract: 0), breaker-open latency, and the worst client
+# latency in the 1 s post-kill window (docs/PERF.md)
+bench-gateway:
+	$(PY) bench.py --gateway
+
 bench:
 	$(PY) bench.py
 
@@ -88,5 +108,5 @@ list:
 	$(PY) -m deep_vision_tpu.cli.train --list -m x
 
 .PHONY: test test-all bench bench-serve bench-serve-sync \
-	bench-serve-scaling bench-serve-wire serve-smoke serve-multi \
-	serve-chaos list
+	bench-serve-scaling bench-serve-wire bench-gateway serve-smoke \
+	serve-multi serve-chaos gateway-smoke gateway-test list

@@ -24,6 +24,7 @@ from __future__ import annotations
 import argparse
 import functools
 import json
+import random
 import time
 
 import jax
@@ -599,15 +600,30 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
         for clients in loads:
             latencies: list = []
             errors = [0]
+            retries = [0]
             lock = threading.Lock()
             stop_at = time.perf_counter() + duration_s
 
-            def client():
-                local, local_err = [], 0
+            def client(seed):
+                # a well-behaved closed-loop client: a queue-full shed
+                # carries a Retry-After hint, so honor it with jittered
+                # backoff (bounded) instead of polluting the error
+                # column — only sheds that exhaust the retry budget, or
+                # carry no hint (deadline/shutdown), count as errors
+                rng = random.Random(seed)
+                local, local_err, local_retry = [], 0, 0
                 while time.perf_counter() < stop_at:
                     t0 = time.perf_counter()
+                    r = None
                     try:
-                        r = engine.infer(img, timeout=60)
+                        for _ in range(3):  # 1 attempt + 2 retries
+                            r = engine.infer(img, timeout=60)
+                            if not (isinstance(r, Shed)
+                                    and r.retry_after_s):
+                                break
+                            local_retry += 1
+                            time.sleep(min(r.retry_after_s, 0.25)
+                                       * (0.5 + rng.random()))
                         if isinstance(r, (Shed, Quarantined)):
                             local_err += 1
                             continue
@@ -618,9 +634,10 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                 with lock:
                     latencies.extend(local)
                     errors[0] += local_err
+                    retries[0] += local_retry
 
-            threads = [threading.Thread(target=client)
-                       for _ in range(clients)]
+            threads = [threading.Thread(target=client, args=(k,))
+                       for k in range(clients)]
             t_start = time.perf_counter()
             for t in threads:
                 t.start()
@@ -630,7 +647,7 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
             lat_ms = np.asarray(latencies) * 1e3
             points.append({
                 "clients": clients, "requests": len(latencies),
-                "errors": errors[0],
+                "errors": errors[0], "retries": retries[0],
                 "img_per_sec": round(len(latencies) / elapsed, 1),
                 "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
                 "p95_ms": round(float(np.percentile(lat_ms, 95)), 2),
@@ -749,6 +766,177 @@ def bench_serve_wire(**kwargs) -> dict:
             / u8w[0]["h2d_bytes_per_batch"], 2)
     last["wire_sweep"] = table
     return last
+
+
+def bench_gateway(model_name: str = "lenet5", loads: tuple = (1, 8),
+                  duration_s: float = 2.0, max_batch: int = 8,
+                  max_wait_ms: float = 2.0, pipeline_depth: int = 2,
+                  backends: int = 2, **_ignored) -> dict:
+    """Gateway failover bench (``bench.py --gateway``): N in-process
+    backend serve stacks (engine + HTTP front-end each) behind one
+    ``serve/gateway.py`` front tier, closed-loop HTTP clients through
+    the gateway — then, a third of the way into the TOP load point,
+    backend 0 is hard-killed (sockets die mid-flight, the SIGKILL
+    shape) while the load keeps running.
+
+    The JSON's ``failover`` block is the methodology output
+    (docs/PERF.md "Gateway failover latency"): client-visible errors
+    after the kill (the contract says 0 — every admitted request fails
+    over), how long until the breaker stopped routing to the corpse,
+    and the worst client latency inside the 1 s post-kill window (the
+    failover tax: connect-fail detection + jittered backoff + the
+    retry on the survivor).  Load points carry ``errors`` and
+    ``retries`` like the ``--serve`` bench, plus the gateway's own
+    counters (retries, failovers, breaker transitions, hedges)."""
+    import sys
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    from deep_vision_tpu.core.config import get_config
+    from deep_vision_tpu.core.restore import load_state
+    from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.gateway import Gateway, GatewayServer
+    from deep_vision_tpu.serve.http import ServeServer
+    from deep_vision_tpu.serve.registry import (CheckpointServingModel,
+                                                ModelRegistry)
+
+    cfg = get_config(model_name)
+    with tempfile.TemporaryDirectory() as td:
+        model, state = load_state(cfg, td,
+                                  log=lambda m: print(m, file=sys.stderr))
+    sm = CheckpointServingModel(model_name, cfg, model, state)
+    registry = ModelRegistry()
+    registry.add(sm)
+    img = np.random.RandomState(0).randn(
+        *sm.input_shape).astype(np.float32)
+    body = json.dumps({"pixels": img.tolist()}).encode()
+    engines = [BatchingEngine(sm, max_batch=max_batch,
+                              max_wait_ms=max_wait_ms,
+                              pipeline_depth=pipeline_depth).start()
+               for _ in range(backends)]
+    for eng in engines:
+        eng.warmup()
+    servers = [ServeServer(registry, {sm.name: eng},
+                           port=0).start_background()
+               for eng in engines]
+    gw = Gateway([f"127.0.0.1:{s.port}" for s in servers],
+                 probe_interval_s=0.05, retry_budget=3,
+                 breaker_threshold=2, breaker_cooldown_s=30.0).start()
+    gsrv = GatewayServer(gw, port=0).start_background()
+    url = f"http://127.0.0.1:{gsrv.port}/v1/classify"
+    points = []
+    failover: dict = {}
+    try:
+        for li, clients in enumerate(loads):
+            kill_point = li == len(loads) - 1  # chaos at the top load
+            latencies: list = []
+            errors = [0]
+            retries = [0]
+            lock = threading.Lock()
+            t_base = time.perf_counter()
+            stop_at = t_base + duration_s
+            t_kill = [None]
+
+            def client(seed):
+                rng = random.Random(seed)
+                local, local_err, local_retry = [], 0, 0
+                while time.perf_counter() < stop_at:
+                    t0 = time.perf_counter()
+                    try:
+                        for _ in range(3):
+                            req = urllib.request.Request(
+                                url, data=body,
+                                headers={"Content-Type":
+                                         "application/json"})
+                            try:
+                                with urllib.request.urlopen(
+                                        req, timeout=60) as r:
+                                    r.read()
+                                break
+                            except urllib.error.HTTPError as e:
+                                if e.code != 429:
+                                    raise
+                                local_retry += 1
+                                ra = float(e.headers.get(
+                                    "Retry-After") or 1)
+                                time.sleep(min(ra, 0.25)
+                                           * (0.5 + rng.random()))
+                        else:
+                            local_err += 1
+                            continue
+                    except Exception:  # noqa: BLE001 — failover misses
+                        local_err += 1
+                        continue
+                    local.append((t0 - t_base,
+                                  time.perf_counter() - t0))
+                with lock:
+                    latencies.extend(local)
+                    errors[0] += local_err
+                    retries[0] += local_retry
+
+            def killer():
+                time.sleep(duration_s / 3)
+                t_kill[0] = time.perf_counter() - t_base
+                servers[0].httpd.shutdown()
+                servers[0].httpd.server_close()
+                engines[0].stop(timeout=1)
+                # breaker-open latency: poll until routing excludes it
+                t0 = time.perf_counter()
+                while gw.backends[0].routable() \
+                        and time.perf_counter() - t0 < 5:
+                    time.sleep(0.002)
+                failover["breaker_open_ms"] = round(
+                    (time.perf_counter() - t0) * 1e3, 1)
+
+            threads = [threading.Thread(target=client, args=(k,))
+                       for k in range(clients)]
+            if kill_point:
+                threads.append(threading.Thread(target=killer))
+            t_start = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t_start
+            lat_ms = np.asarray([x[1] for x in latencies]) * 1e3
+            points.append({
+                "clients": clients, "requests": len(latencies),
+                "errors": errors[0], "retries": retries[0],
+                "img_per_sec": round(len(latencies) / elapsed, 1),
+                "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+                "p95_ms": round(float(np.percentile(lat_ms, 95)), 2),
+                "p99_ms": round(float(np.percentile(lat_ms, 99)), 2)})
+            if kill_point and t_kill[0] is not None:
+                after = [x for x in latencies if x[0] >= t_kill[0]]
+                window = [x[1] * 1e3 for x in after
+                          if x[0] < t_kill[0] + 1.0]
+                failover.update({
+                    "kill_at_s": round(t_kill[0], 3),
+                    "requests_after_kill": len(after),
+                    "errors_after_kill": errors[0],
+                    "max_ms_in_1s_window": round(max(window), 2)
+                    if window else None})
+        counters = gw.counters()
+        reports = {b.name: b.report() for b in gw.backends}
+    finally:
+        gsrv.shutdown()
+        gw.stop()
+        for srv in servers[1:]:
+            srv.shutdown()
+        for eng in engines[1:]:
+            eng.stop()
+    return {"metric": f"gateway_{model_name}_img_per_sec",
+            "value": points[-1]["img_per_sec"], "unit": "img/s",
+            "model": model_name, "backends": backends,
+            "max_batch": max_batch, "max_wait_ms": max_wait_ms,
+            "pipeline_depth": pipeline_depth,
+            "loads": points, "failover": failover,
+            "gateway": counters, "backend_reports": reports,
+            "device_kind": jax.devices()[0].device_kind}
 
 
 def bench_all() -> list[dict]:
@@ -1138,6 +1326,14 @@ def main():
                    default="float32",
                    help="on-device compute dtype for a single --serve "
                         "run (outputs stay float32)")
+    p.add_argument("--gateway", action="store_true",
+                   help="gateway failover bench: backend serve stacks "
+                        "behind serve/gateway.py, HTTP clients through "
+                        "the gateway, one backend hard-killed mid-way "
+                        "through the top load point; reports failover "
+                        "latency + breaker-open time (docs/PERF.md)")
+    p.add_argument("--gateway-backends", type=int, default=2,
+                   help="backend count for --gateway")
     p.add_argument("--serve-devices", type=int, default=1,
                    help="device-scaling sweep (--serve): bench replica "
                         "counts 1, 2, 4, ... N and emit the scaling "
@@ -1178,6 +1374,14 @@ def main():
     if args.live_gan:
         print(json.dumps(bench_cyclegan_live(steps=args.steps or 20,
                                              batch=args.batch or 1)))
+        return
+    if args.gateway:
+        print(json.dumps(bench_gateway(
+            model_name=args.serve_model,
+            loads=tuple(int(c) for c in args.serve_loads.split(",")),
+            duration_s=args.serve_duration, max_batch=args.batch or 8,
+            pipeline_depth=args.serve_pipeline_depth,
+            backends=args.gateway_backends)))
         return
     if args.serve:
         serve_kwargs = dict(

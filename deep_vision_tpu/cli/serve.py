@@ -125,10 +125,13 @@ def build_server(args):
     if args.warmup:
         print(f"[serve] warming {engine.buckets} ...")
         engine.warmup()
+    socket_timeout_s = getattr(args, "socket_timeout_s", 30.0)
     server = ServeServer(
         registry, {sm.name: engine}, host=args.host, port=args.port,
         verbose=args.verbose,
-        max_body_bytes=int(getattr(args, "max_body_mb", 32) * 2**20))
+        max_body_bytes=int(getattr(args, "max_body_mb", 32) * 2**20),
+        socket_timeout_s=socket_timeout_s if socket_timeout_s > 0
+        else None)
     return engine, server
 
 
@@ -221,6 +224,11 @@ def main(argv=None):
                         "finish admitted work up to this many seconds")
     p.add_argument("--max-body-mb", type=float, default=32.0,
                    help="reject request bodies over this size with 413")
+    p.add_argument("--socket-timeout-s", type=float, default=30.0,
+                   help="per-connection socket timeout: a stalled "
+                        "client (slow-loris) is closed / answered 408 "
+                        "instead of pinning a handler thread; 0 "
+                        "disables")
     args = p.parse_args(argv)
 
     from deep_vision_tpu.core.compile_cache import enable_compile_cache

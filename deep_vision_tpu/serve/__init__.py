@@ -21,10 +21,20 @@ stack's restore path.
                   sharded big-batch path pairs registry.for_mesh with
                   engine.sharded_buckets (--shard-batches)
     http.py       stdlib HTTP front-end (/v1/classify, /v1/detect,
-                  deep /v1/healthz with 503-on-degraded, ...)
+                  deep /v1/healthz with 503-on-degraded, /v1/drain
+                  zero-downtime shutdown, per-connection socket
+                  timeouts)
+    gateway.py    cross-host front tier: proxies /v1/classify|detect
+                  over a table of backend serve processes with active
+                  healthz probing, per-backend circuit breakers,
+                  least-outstanding-work routing, bounded retries with
+                  failover (a SIGKILL'd backend loses zero admitted
+                  requests), and optional tail hedging
 
-Entry point: ``python -m deep_vision_tpu.cli.serve``; load generator:
-``python bench.py --serve``; architecture notes: docs/SERVING.md.
+Entry points: ``python -m deep_vision_tpu.cli.serve`` (one backend),
+``python -m deep_vision_tpu.cli.gateway`` (front tier); load generator:
+``python bench.py --serve`` / ``--gateway``; architecture notes:
+docs/SERVING.md.
 """
 
 from deep_vision_tpu.serve.admission import AdmissionController, Shed
@@ -34,10 +44,12 @@ from deep_vision_tpu.serve.faults import (
     InjectedFault,
     Quarantined,
 )
+from deep_vision_tpu.serve.gateway import Gateway, GatewayServer
 from deep_vision_tpu.serve.health import EngineHealth
 from deep_vision_tpu.serve.registry import ModelRegistry, ServingModel
 from deep_vision_tpu.serve.replicas import ReplicatedEngine
 
 __all__ = ["AdmissionController", "BatchingEngine", "EngineHealth",
-           "FaultPlane", "InjectedFault", "ModelRegistry", "Quarantined",
-           "ReplicatedEngine", "ServingModel", "Shed", "StagingPool"]
+           "FaultPlane", "Gateway", "GatewayServer", "InjectedFault",
+           "ModelRegistry", "Quarantined", "ReplicatedEngine",
+           "ServingModel", "Shed", "StagingPool"]

@@ -1,0 +1,729 @@
+"""Cross-host serving gateway: health-routed failover across backends.
+
+``--serve-devices`` scales one process across its local chips; the next
+scale axis is *processes and hosts*.  The gateway is a thin HTTP front
+tier that proxies ``/v1/classify`` / ``/v1/detect`` across a table of
+backend serve processes (each a full PR 1–5 stack: batcher, pipeline,
+fault plane, deep health) so N backends look like one endpoint that
+survives any single backend dying:
+
+  state machine   per-backend OK → DEGRADED → DEAD, driven by BOTH
+                  active ``/v1/healthz`` probes (a prober thread, every
+                  ``probe_interval_s``) and passive request outcomes —
+                  connect errors, timeouts, and 5xx count as failures;
+                  any 2xx/4xx response or a 200 probe resets to OK.  A
+                  503 probe means *alive but can't serve* (draining, or
+                  the backend's own health machine flipped): the
+                  backend leaves routing with NO breaker penalty and
+                  rejoins on the next 200 probe.
+  routing         least outstanding work over routable backends —
+                  outstanding requests × the backend's latency EWMA,
+                  scanned from a rotating offset with strict less-than
+                  (ties round-robin), mirroring the in-process replica
+                  router (serve/replicas.py).
+  circuit breaker per backend: CLOSED → OPEN after ``breaker_threshold``
+                  consecutive failures (probe or request) → HALF_OPEN
+                  once ``breaker_cooldown_s`` elapses, admitting one
+                  trial (the next probe or one live request); success
+                  closes, failure re-opens with a fresh cooldown.  An
+                  OPEN breaker takes the backend out of routing within
+                  one probe interval of it dying — no traffic required.
+  retries         inference requests are idempotent, so a connect
+                  error / timeout / 5xx is retried with jittered
+                  exponential backoff, bounded by ``retry_budget``,
+                  FAILING OVER to a different backend when one is
+                  routable — killing one of two backends mid-load loses
+                  zero admitted requests from the client's view.
+  429s            a shed (429) is failed over once to a less-loaded
+                  backend when one exists; otherwise it propagates to
+                  the client unchanged, ``Retry-After`` header included,
+                  so client backoff semantics survive the extra hop.
+  tail hedging    optional: if the primary hasn't answered after a
+                  p99-based delay (``hedge_after_ms``, or the gateway's
+                  own measured p99 once it has history), the request is
+                  duplicated to a second backend — first answer wins,
+                  the loser's response is discarded.
+
+``GET /v1/stats`` aggregates every backend's own stats under the
+gateway's counters (retries, failovers, hedges, breaker transitions);
+``GET /v1/healthz`` answers 200 while ANY backend is routable.  Entry
+point: ``python -m deep_vision_tpu.cli.gateway``; chaos suite:
+``tests/test_gateway.py`` (marker ``gateway``); end-to-end smoke with a
+real SIGKILL mid-load: ``make gateway-smoke``.  Zero new dependencies:
+stdlib ``http.client`` out, ``http.server`` in.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from http.client import HTTPConnection, HTTPException
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from deep_vision_tpu.core.metrics import LatencyHistogram
+from deep_vision_tpu.serve.health import DEAD, DEGRADED, OK
+
+CLOSED = "closed"
+OPEN = "open"
+HALF_OPEN = "half_open"
+
+# retry-able HTTP verdicts vs. final ones: anything below 500 except a
+# 429 means the backend is alive and answered THIS request definitively
+_PROXY_HEADERS = ("Content-Type", "Retry-After")
+
+
+class Backend:
+    """One backend serve process: address + breaker + health + load.
+
+    All mutation goes through ``record_*``/``begin``/``done_*`` under
+    one lock; the router reads ``routable()`` and the outstanding/EWMA
+    score.  The breaker is the ROUTING gate; the OK/DEGRADED/DEAD state
+    is the observability verdict — both are driven by the same
+    consecutive-failure count so they can't disagree about a dead
+    backend.
+    """
+
+    def __init__(self, url: str, *, breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 1.0,
+                 degraded_after: int = 1, dead_after: int = 5,
+                 ewma_alpha: float = 0.2):
+        addr = url.removeprefix("http://").rstrip("/")
+        host, _, port = addr.rpartition(":")
+        if not host or not port.isdigit():
+            raise ValueError(f"backend '{url}': expected host:port")
+        self.host, self.port = host, int(port)
+        self.name = f"{self.host}:{self.port}"
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.breaker_cooldown_s = breaker_cooldown_s
+        self.degraded_after = max(1, int(degraded_after))
+        self.dead_after = max(self.degraded_after, int(dead_after))
+        self._alpha = ewma_alpha
+        self._lock = threading.Lock()
+        self.state = OK
+        self.breaker = CLOSED
+        self.opened_at: float | None = None
+        self._trial_inflight = False
+        # a 503 healthz: alive but can't serve (reason from its body)
+        self.unavailable: str | None = None
+        self.outstanding = 0
+        self.ewma_s: float | None = None
+        self.consecutive_failures = 0
+        self.failures = 0
+        self.successes = 0
+        self.sheds = 0
+        self.probes = 0
+        self.breaker_opens = 0
+        self.breaker_closes = 0
+        self.half_open_trials = 0
+        self.last_probe_at: float | None = None
+        self.last_error: str | None = None
+
+    # -- routing gate ------------------------------------------------------
+
+    def routable(self, now: float | None = None) -> bool:
+        """May the router send this backend a request right now?  OPEN →
+        HALF_OPEN happens here (time-based), so the first caller after
+        the cooldown sees the trial slot."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self.unavailable is not None:
+                return False
+            if self.breaker == CLOSED:
+                return True
+            if self.breaker == OPEN:
+                if now - (self.opened_at or now) < self.breaker_cooldown_s:
+                    return False
+                self.breaker = HALF_OPEN
+                self._trial_inflight = False
+            return not self._trial_inflight
+
+    def begin(self):
+        """A request was routed here (claims the half-open trial slot)."""
+        with self._lock:
+            self.outstanding += 1
+            if self.breaker == HALF_OPEN and not self._trial_inflight:
+                self._trial_inflight = True
+                self.half_open_trials += 1
+
+    # -- outcome recording -------------------------------------------------
+
+    def _failure_locked(self, err: str, now: float):
+        self.consecutive_failures += 1
+        self.failures += 1
+        self.last_error = err
+        if self.breaker == HALF_OPEN:
+            # the trial failed: re-open with a fresh cooldown
+            self.breaker = OPEN
+            self.opened_at = now
+            self.breaker_opens += 1
+        elif self.breaker == CLOSED and \
+                self.consecutive_failures >= self.breaker_threshold:
+            self.breaker = OPEN
+            self.opened_at = now
+            self.breaker_opens += 1
+        if self.consecutive_failures >= self.dead_after:
+            self.state = DEAD
+        elif self.consecutive_failures >= self.degraded_after:
+            self.state = DEGRADED
+
+    def _success_locked(self):
+        self.consecutive_failures = 0
+        if self.breaker != CLOSED:
+            self.breaker = CLOSED
+            self.breaker_closes += 1
+        self._trial_inflight = False
+        self.state = OK
+
+    def done_success(self, elapsed_s: float):
+        with self._lock:
+            self.outstanding -= 1
+            self.successes += 1
+            self.ewma_s = elapsed_s if self.ewma_s is None else \
+                self.ewma_s + self._alpha * (elapsed_s - self.ewma_s)
+            self._success_locked()
+
+    def done_shed(self):
+        """A 429: the backend is healthy, just out of capacity — resets
+        the breaker, but sheds don't feed the service-latency EWMA."""
+        with self._lock:
+            self.outstanding -= 1
+            self.sheds += 1
+            self._success_locked()
+
+    def done_failure(self, err: str, now: float | None = None):
+        with self._lock:
+            self.outstanding -= 1
+            self._trial_inflight = False
+            self._failure_locked(err, time.monotonic()
+                                 if now is None else now)
+
+    def probe_ok(self, now: float):
+        with self._lock:
+            self.probes += 1
+            self.last_probe_at = now
+            self.unavailable = None
+            self.consecutive_failures = 0
+            if self.breaker == CLOSED:
+                self.state = OK
+            elif now - (self.opened_at or now) >= self.breaker_cooldown_s:
+                # the probe IS the half-open trial: close on success
+                self.half_open_trials += 1
+                self._success_locked()
+
+    def probe_unavailable(self, reason: str, now: float):
+        """healthz answered 503: out of routing, no breaker penalty."""
+        with self._lock:
+            self.probes += 1
+            self.last_probe_at = now
+            self.unavailable = reason
+
+    def probe_failure(self, err: str, now: float):
+        with self._lock:
+            self.probes += 1
+            self.last_probe_at = now
+            self._failure_locked(err, now)
+
+    # -- observability -----------------------------------------------------
+
+    def score(self) -> float:
+        """Least-outstanding-work routing score (lower = preferred)."""
+        return self.outstanding * (self.ewma_s or 1.0)
+
+    def report(self, now: float | None = None) -> dict:
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            return {
+                "url": f"http://{self.name}",
+                "state": self.state,
+                "breaker": self.breaker,
+                "unavailable": self.unavailable,
+                "outstanding": self.outstanding,
+                "ewma_ms": round(self.ewma_s * 1e3, 3)
+                if self.ewma_s is not None else None,
+                "consecutive_failures": self.consecutive_failures,
+                "failures": self.failures,
+                "successes": self.successes,
+                "sheds": self.sheds,
+                "probes": self.probes,
+                "breaker_opens": self.breaker_opens,
+                "breaker_closes": self.breaker_closes,
+                "half_open_trials": self.half_open_trials,
+                "last_probe_age_s": round(now - self.last_probe_at, 4)
+                if self.last_probe_at is not None else None,
+                "last_error": self.last_error}
+
+
+class _Outcome:
+    """One attempt's verdict: ``ok`` (2xx / non-429 4xx — final),
+    ``shed`` (429), or ``fail`` (connect error / timeout / 5xx)."""
+
+    __slots__ = ("kind", "status", "headers", "payload", "backend",
+                 "error", "hedge_backend")
+
+    def __init__(self, kind, status, headers, payload, backend,
+                 error=None):
+        self.kind = kind
+        self.status = status
+        self.headers = headers
+        self.payload = payload
+        self.backend = backend
+        self.error = error
+        self.hedge_backend = None  # a hedge that ALSO failed
+
+
+class Gateway:
+    """Health-routed failover proxy over N backend serve processes."""
+
+    def __init__(self, backends: list[str], *,
+                 probe_interval_s: float = 0.25,
+                 probe_timeout_s: float = 1.0,
+                 request_timeout_s: float = 30.0,
+                 retry_budget: int = 3,
+                 backoff_ms: float = 10.0,
+                 backoff_max_ms: float = 250.0,
+                 breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 1.0,
+                 degraded_after: int = 1, dead_after: int = 5,
+                 hedge: bool = False,
+                 hedge_after_ms: float | None = None,
+                 hedge_min_history: int = 32):
+        if not backends:
+            raise ValueError("gateway needs at least one backend")
+        self.backends = [Backend(u, breaker_threshold=breaker_threshold,
+                                 breaker_cooldown_s=breaker_cooldown_s,
+                                 degraded_after=degraded_after,
+                                 dead_after=dead_after)
+                         for u in backends]
+        names = [b.name for b in self.backends]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate backends in {names}")
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self.retry_budget = max(0, int(retry_budget))
+        self.backoff_ms = backoff_ms
+        self.backoff_max_ms = backoff_max_ms
+        self.hedge = hedge
+        self.hedge_after_ms = hedge_after_ms
+        self.hedge_min_history = hedge_min_history
+        self.latency = LatencyHistogram()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._prober: threading.Thread | None = None
+        self._pool: ThreadPoolExecutor | None = None
+        self._rr = 0  # rotating scan offset: idle ties round-robin
+        self.proxied = 0
+        self.retries = 0
+        self.failovers = 0
+        self.hedges = 0
+        self.hedge_wins = 0
+        self.exhausted = 0
+        self.no_backend = 0
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "Gateway":
+        if self._prober is None:
+            self._stop.clear()
+            self._probe_all()  # know the fleet before the first request
+            self._prober = threading.Thread(target=self._probe_loop,
+                                            name="gateway-prober",
+                                            daemon=True)
+            self._prober.start()
+        return self
+
+    def stop(self, timeout: float = 5.0):
+        self._stop.set()
+        if self._prober is not None:
+            self._prober.join(timeout)
+            self._prober = None
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+    # -- probing (active health) -------------------------------------------
+
+    def _probe_loop(self):
+        while not self._stop.wait(self.probe_interval_s):
+            self._probe_all()
+
+    def _probe_all(self):
+        for b in self.backends:
+            if self._stop.is_set():
+                return
+            now = time.monotonic()
+            try:
+                status, _, payload = self._call(
+                    b, "GET", "/v1/healthz", None, self.probe_timeout_s)
+            except (OSError, HTTPException) as e:
+                b.probe_failure(f"probe: {type(e).__name__}: {e}", now)
+                continue
+            if status == 200:
+                b.probe_ok(now)
+            else:
+                reason = "unavailable"
+                try:
+                    reason = json.loads(payload).get("status", reason)
+                except (ValueError, AttributeError):
+                    pass
+                b.probe_unavailable(reason, now)
+
+    # -- request path ------------------------------------------------------
+
+    def forward(self, path: str, body: bytes
+                ) -> tuple[int, dict, bytes]:
+        """Proxy one inference request: route, retry, fail over, hedge.
+        Returns ``(status, headers, payload)`` for the client."""
+        t0 = time.monotonic()
+        with self._lock:
+            self.proxied += 1
+        tried: list[Backend] = []
+        last_shed: _Outcome | None = None
+        last_fail: _Outcome | None = None
+        prev: Backend | None = None
+        for attempt in range(1 + self.retry_budget):
+            b = self._pick(tried)
+            if b is None and tried:
+                # every routable backend failed this request once —
+                # clear the exclusions so the backoff'd retry may
+                # revisit (a transient blip shouldn't 502 the client)
+                tried = []
+                b = self._pick(tried)
+            if b is None:
+                break
+            if attempt > 0:
+                with self._lock:
+                    self.retries += 1
+                    if prev is not None and b is not prev:
+                        self.failovers += 1
+                if last_shed is None or b is prev:
+                    # backoff applies to failures and same-backend
+                    # retries; failing a 429 over to a DIFFERENT
+                    # backend goes immediately
+                    self._backoff(attempt)
+            prev = b
+            out = self._attempt(b, path, body, allow_hedge=attempt == 0)
+            if out.kind == "ok":
+                with self._lock:  # histogram increments aren't atomic
+                    self.latency.record(time.monotonic() - t0)
+                return out.status, self._client_headers(out), out.payload
+            tried.append(out.backend)
+            if out.hedge_backend is not None:
+                tried.append(out.hedge_backend)
+            if out.kind == "shed":
+                last_shed = out
+                if self._pick(tried) is None:
+                    break  # nobody with headroom: propagate the 429
+            else:
+                last_fail = out
+        with self._lock:
+            if last_shed is None and last_fail is None:
+                self.no_backend += 1
+            else:
+                self.exhausted += 1
+        if last_shed is not None:
+            # propagate the shed verbatim, Retry-After included
+            return (last_shed.status, self._client_headers(last_shed),
+                    last_shed.payload)
+        if last_fail is not None:
+            detail = last_fail.error or f"HTTP {last_fail.status}"
+            return 502, {"Content-Type": "application/json"}, json.dumps(
+                {"error": f"all backends failed after "
+                          f"{1 + self.retry_budget} attempt(s): "
+                          f"{detail}"}).encode()
+        return 503, {"Content-Type": "application/json",
+                     "Retry-After": max(1, math.ceil(
+                         self.probe_interval_s))}, json.dumps(
+            {"error": "no routable backend (all DEAD, draining, or "
+                      "breaker-open)"}).encode()
+
+    @staticmethod
+    def _client_headers(out: _Outcome) -> dict:
+        return {k: out.headers[k] for k in _PROXY_HEADERS
+                if k in out.headers}
+
+    def _pick(self, exclude: list) -> Backend | None:
+        """Least outstanding work (outstanding × latency EWMA) over
+        routable backends, scanning from a rotating offset with strict
+        less-than — an idle fleet round-robins instead of piling onto
+        backend 0 (same policy as serve/replicas.py)."""
+        now = time.monotonic()
+        n = len(self.backends)
+        with self._lock:
+            start = self._rr % n
+            self._rr += 1
+        best = best_score = None
+        for k in range(n):
+            b = self.backends[(start + k) % n]
+            if b in exclude or not b.routable(now):
+                continue
+            score = b.score()
+            if best_score is None or score < best_score:
+                best, best_score = b, score
+        return best
+
+    def _backoff(self, attempt: int):
+        base = min(self.backoff_max_ms,
+                   self.backoff_ms * (2 ** (attempt - 1)))
+        # full jitter in [0.5, 1.5)×base: retries from a burst of
+        # failovers must not re-converge on the survivor in lockstep
+        time.sleep(base * (0.5 + random.random()) / 1e3)
+
+    # -- single attempt + hedging ------------------------------------------
+
+    def _attempt(self, b: Backend, path: str, body: bytes,
+                 allow_hedge: bool) -> _Outcome:
+        delay_s = self._hedge_delay_s() if allow_hedge else None
+        if delay_s is None:
+            return self._single(b, path, body)
+        pool = self._hedge_pool()
+        primary = pool.submit(self._single, b, path, body)
+        done, _ = wait([primary], timeout=delay_s)
+        if done:
+            return primary.result()
+        b2 = self._pick([b])
+        if b2 is None:
+            return primary.result()  # nobody to hedge to: just wait
+        with self._lock:
+            self.hedges += 1
+        hedge = pool.submit(self._single, b2, path, body)
+        pending = {primary, hedge}
+        while pending:
+            done, pending = wait(pending, return_when=FIRST_COMPLETED)
+            for f in done:
+                out = f.result()
+                if out.kind == "ok":
+                    # first answer wins; the loser keeps running in the
+                    # pool and its (counted) result is discarded
+                    if f is hedge:
+                        with self._lock:
+                            self.hedge_wins += 1
+                    return out
+        out = primary.result()
+        if out.kind == "ok":  # pending-set raced: prefer any success
+            return out
+        out.hedge_backend = hedge.result().backend
+        return out
+
+    def _hedge_delay_s(self) -> float | None:
+        if not self.hedge or len(self.backends) < 2:
+            return None
+        if self.hedge_after_ms is not None:
+            return self.hedge_after_ms / 1e3
+        # p99-based: hedge only the tail, and only once the gateway has
+        # enough of its own history to know where the tail is
+        p = self.latency.percentiles()
+        if p["count"] < self.hedge_min_history:
+            return None
+        return p["p99_ms"] / 1e3
+
+    def _hedge_pool(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._pool is None:
+                self._pool = ThreadPoolExecutor(
+                    max_workers=2 * len(self.backends) + 2,
+                    thread_name_prefix="gateway-hedge")
+            return self._pool
+
+    def _single(self, b: Backend, path: str, body: bytes) -> _Outcome:
+        b.begin()
+        t0 = time.monotonic()
+        try:
+            status, headers, payload = self._call(
+                b, "POST", path, body, self.request_timeout_s)
+        except (OSError, HTTPException) as e:
+            err = f"{b.name}: {type(e).__name__}: {e}"
+            b.done_failure(err)
+            return _Outcome("fail", 0, {}, b"", b, error=err)
+        if status >= 500:
+            b.done_failure(f"{b.name}: HTTP {status}")
+            return _Outcome("fail", status, headers, payload, b,
+                            error=f"{b.name}: HTTP {status}")
+        if status == 429:
+            b.done_shed()
+            return _Outcome("shed", status, headers, payload, b)
+        b.done_success(time.monotonic() - t0)
+        return _Outcome("ok", status, headers, payload, b)
+
+    @staticmethod
+    def _call(b: Backend, method: str, path: str, body: bytes | None,
+              timeout: float) -> tuple[int, dict, bytes]:
+        """One HTTP exchange with a backend.  A fresh connection per
+        call: the failure modes we must detect (SIGKILL'd process, TCP
+        reset) surface as plain connect/read errors, never as a stale
+        keep-alive edge case."""
+        conn = HTTPConnection(b.host, b.port, timeout=timeout)
+        try:
+            headers = {"Content-Type": "application/json"} if body \
+                else {}
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            return resp.status, dict(resp.getheaders()), resp.read()
+        finally:
+            conn.close()
+
+    # -- observability -----------------------------------------------------
+
+    def routable_backends(self, now: float | None = None) -> list[str]:
+        now = time.monotonic() if now is None else now
+        return [b.name for b in self.backends if b.routable(now)]
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {"proxied": self.proxied, "retries": self.retries,
+                    "failovers": self.failovers, "hedges": self.hedges,
+                    "hedge_wins": self.hedge_wins,
+                    "exhausted": self.exhausted,
+                    "no_backend": self.no_backend,
+                    "breaker_opens": sum(b.breaker_opens
+                                         for b in self.backends),
+                    "breaker_closes": sum(b.breaker_closes
+                                          for b in self.backends)}
+
+    def healthz(self) -> tuple[bool, dict]:
+        now = time.monotonic()
+        routable = self.routable_backends(now)
+        ok = bool(routable)
+        return ok, {"status": "ok" if ok else "unhealthy",
+                    "routable": routable,
+                    "backends": {b.name: b.report(now)
+                                 for b in self.backends},
+                    "gateway": self.counters()}
+
+    def stats(self, include_backend_stats: bool = True) -> dict:
+        now = time.monotonic()
+        out = {"gateway": {**self.counters(),
+                           "latency": self.latency.percentiles(),
+                           "backends": {b.name: b.report(now)
+                                        for b in self.backends}}}
+        if include_backend_stats:
+            agg: dict = {}
+            for b in self.backends:
+                try:
+                    status, _, payload = self._call(
+                        b, "GET", "/v1/stats", None,
+                        self.probe_timeout_s)
+                    agg[b.name] = json.loads(payload) if status == 200 \
+                        else {"error": f"HTTP {status}"}
+                except (OSError, HTTPException, ValueError) as e:
+                    agg[b.name] = {"error": f"{type(e).__name__}: {e}"}
+            out["backends"] = agg
+        return out
+
+
+class _GatewayHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def setup(self):
+        # per-connection socket timeout (StreamRequestHandler applies
+        # self.timeout): a stalled client can't pin a handler thread
+        self.timeout = self.server.socket_timeout_s  # type: ignore
+        super().setup()
+
+    def log_message(self, fmt, *args):
+        if self.server.verbose:  # type: ignore[attr-defined]
+            super().log_message(fmt, *args)
+
+    def _reply(self, status: int, payload: dict,
+               headers: dict | None = None):
+        blob = json.dumps(payload).encode()
+        self._reply_raw(status, blob, headers)
+
+    def _reply_raw(self, status: int, blob: bytes,
+                   headers: dict | None = None):
+        self.send_response(status)
+        headers = dict(headers or {})
+        headers.setdefault("Content-Type", "application/json")
+        for k, v in headers.items():
+            self.send_header(k, str(v))
+        self.send_header("Content-Length", str(len(blob)))
+        self.end_headers()
+        self.wfile.write(blob)
+
+    def do_GET(self):
+        gw: Gateway = self.server.gateway  # type: ignore[attr-defined]
+        if self.path == "/v1/healthz":
+            ok, payload = gw.healthz()
+            self._reply(200 if ok else 503, payload)
+        elif self.path == "/v1/stats":
+            self._reply(200, gw.stats())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):
+        gw: Gateway = self.server.gateway  # type: ignore[attr-defined]
+        try:
+            if self.path not in ("/v1/classify", "/v1/detect"):
+                self._reply(404, {"error": f"no route {self.path}"})
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            if length <= 0:
+                self._reply(400, {"error": "empty body"})
+                return
+            cap = self.server.max_body_bytes  # type: ignore
+            if length > cap:
+                self.close_connection = True
+                self._reply(413, {"error": f"body of {length} bytes "
+                                           f"exceeds the {cap}-byte cap"})
+                return
+            body = self.rfile.read(length)
+            status, headers, payload = gw.forward(self.path, body)
+            self._reply_raw(status, payload, headers)
+        except TimeoutError:
+            # client stalled mid-body: answer 408 and drop the
+            # connection instead of pinning this thread
+            self.close_connection = True
+            self._reply(408, {"error": "timed out reading request body"})
+        except Exception as e:  # noqa: BLE001 — surface, don't kill worker
+            self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+class GatewayServer:
+    """ThreadingHTTPServer front for a ``Gateway`` (mirrors
+    ``serve.http.ServeServer``)."""
+
+    def __init__(self, gateway: Gateway, host: str = "127.0.0.1",
+                 port: int = 0, verbose: bool = False,
+                 max_body_bytes: int = 32 * 2**20,
+                 socket_timeout_s: float | None = 30.0):
+        self.gateway = gateway
+        self.httpd = ThreadingHTTPServer((host, port), _GatewayHandler)
+        self.httpd.gateway = gateway
+        self.httpd.verbose = verbose
+        self.httpd.max_body_bytes = max_body_bytes
+        self.httpd.socket_timeout_s = socket_timeout_s
+        self._thread: threading.Thread | None = None
+
+    @property
+    def host(self) -> str:
+        return self.httpd.server_address[0]
+
+    @property
+    def port(self) -> int:
+        return self.httpd.server_address[1]
+
+    def serve_forever(self):
+        self.httpd.serve_forever()
+
+    def start_background(self) -> "GatewayServer":
+        self._thread = threading.Thread(target=self.serve_forever,
+                                        name="gateway-http", daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None

@@ -17,6 +17,13 @@ Routes (JSON in, JSON out):
     POST /v1/classify  {"pixels": [[...]] | "image_b64": "...",
                         "model"?, "deadline_ms"?, "top_k"?}
     POST /v1/detect    same inputs + "score_threshold"?; YOLO models
+    POST /v1/drain     zero-downtime shutdown hook: healthz flips to
+                       503 ``draining`` IMMEDIATELY (so a gateway or
+                       load balancer stops routing here), new requests
+                       shed 429, and every engine finishes its admitted
+                       in-flight work via ``stop(drain_deadline=)``
+                       (body: {"drain_deadline_s"?: float, default 10})
+                       before the 200 reply — no admitted request fails
 
 Image payloads: ``pixels`` is an (H, W, C) array in the model's WIRE
 dtype — raw 0–255 integers on the uint8 wire (the ``cli.serve``
@@ -30,6 +37,11 @@ shed reason (queue-full sheds add a ``Retry-After`` header) so clients
 can retry against another replica; quarantined (poison) requests answer
 500 with the isolation detail.  Bodies over ``max_body_bytes`` (default
 32 MiB) are rejected 413 before any buffer is allocated.
+
+Each connection carries a socket timeout (``socket_timeout_s``, default
+30 s): a client that opens a socket and never sends a request line gets
+the connection closed, and one that stalls mid-body gets 408 — either
+way a slow-loris can't pin a handler thread forever.
 """
 
 from __future__ import annotations
@@ -130,6 +142,14 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- plumbing ----------------------------------------------------------
 
+    def setup(self):
+        # StreamRequestHandler applies self.timeout to the connection
+        # socket; a timeout on the request line makes the stdlib
+        # handle_one_request close the connection, a timeout mid-body
+        # raises TimeoutError in do_POST (answered 408 below)
+        self.timeout = getattr(self.server, "socket_timeout_s", None)
+        super().setup()
+
     def log_message(self, fmt, *args):  # route access logs off stderr spam
         if self.server.verbose:  # type: ignore[attr-defined]
             super().log_message(fmt, *args)
@@ -196,6 +216,12 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         if self.path == "/v1/healthz":
             engines = self.server.engines
+            if getattr(self.server, "draining", False):
+                # draining outranks engine health: traffic must move
+                # away BEFORE the engines finish their in-flight work
+                self._reply(503, {"status": "draining",
+                                  "models": self.server.registry.names()})
+                return
             reports = {name: eng.health_report()
                        for name, eng in engines.items()}
             # each engine decides its own serve-ability: a single
@@ -215,6 +241,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         try:
+            if self.path == "/v1/drain":
+                self._reply(200, self._drain())
+                return
             body = self._body()
             if self.path == "/v1/classify":
                 self._reply(200, self._classify(body))
@@ -224,8 +253,33 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(404, {"error": f"no route {self.path}"})
         except ServeError as e:
             self._reply(e.status, {"error": str(e)}, headers=e.headers)
+        except TimeoutError:
+            # client stalled mid-body: answer 408 and drop the
+            # connection instead of pinning this handler thread
+            self.close_connection = True
+            self._reply(408, {"error": "timed out reading request body"})
         except Exception as e:  # noqa: BLE001 — surface, don't kill worker
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+
+    def _drain(self) -> dict:
+        """Flip healthz to draining, then finish admitted work.
+
+        The flag flips BEFORE any engine stops so probes see 503 while
+        in-flight requests are still completing; draining twice is a
+        no-op reply.  An empty body is fine — the route predates the
+        body parse precisely so `curl -XPOST .../v1/drain` works."""
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self._body() if length > 0 else {}
+        deadline = float(body.get("drain_deadline_s", 10.0))
+        srv = self.server
+        with srv.drain_lock:  # type: ignore[attr-defined]
+            already = getattr(srv, "draining", False)
+            srv.draining = True
+            if not already:
+                for eng in srv.engines.values():
+                    eng.stop(drain_deadline=deadline)
+        return {"status": "draining", "already_draining": already,
+                "drain_deadline_s": deadline}
 
     def _classify(self, body: dict) -> dict:
         import numpy as np
@@ -271,12 +325,16 @@ class ServeServer:
 
     def __init__(self, registry, engines: dict, host: str = "127.0.0.1",
                  port: int = 0, verbose: bool = False,
-                 max_body_bytes: int = DEFAULT_MAX_BODY_BYTES):
+                 max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
+                 socket_timeout_s: float | None = 30.0):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.registry = registry
         self.httpd.engines = engines
         self.httpd.verbose = verbose
         self.httpd.max_body_bytes = max_body_bytes
+        self.httpd.socket_timeout_s = socket_timeout_s
+        self.httpd.draining = False
+        self.httpd.drain_lock = threading.Lock()
         self._thread: threading.Thread | None = None
 
     @property

@@ -1,0 +1,201 @@
+"""`make gateway-smoke`: the cross-host failover contract, end to end
+with REAL process boundaries.  Spawns two `python -m
+deep_vision_tpu.cli.serve` backend subprocesses (LeNet workdir fixture,
+fault injection active on backend 0 so the smoke also crosses the
+bisect-retry path), boots the gateway in-process on a random port via
+the production wiring (cli.gateway.build_gateway), then:
+
+  1. runs a closed-loop client burst through the gateway — all 200s;
+  2. SIGKILLs backend 1 (a real `kill -9`: sockets die mid-flight) while
+     the client loop keeps running — still all 200s, zero lost
+     requests, and the gateway's breaker must stop routing to the
+     corpse within a few probe intervals;
+  3. POSTs /v1/drain to the surviving backend and asserts its healthz
+     flips to 503 draining and the gateway's healthz goes 503 (no
+     routable backend) — the zero-downtime-restart signal chain.
+
+Run directly, not under pytest (subprocesses + real signals)."""
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+# plain script (not pytest): make the repo root importable when invoked
+# as `python tests/gateway_smoke.py` from the checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _wait_healthy(port: int, proc, timeout_s: float = 120.0) -> None:
+    deadline = time.monotonic() + timeout_s
+    url = f"http://127.0.0.1:{port}/v1/healthz"
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"backend on port {port} exited rc={proc.returncode} "
+                f"before becoming healthy")
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                if r.status == 200:
+                    return
+        except (urllib.error.URLError, ConnectionError, OSError):
+            pass
+        time.sleep(0.1)
+    raise AssertionError(f"backend on port {port} never became healthy")
+
+
+def main():
+    argparse.ArgumentParser().parse_args()  # no options; --help works
+    from deep_vision_tpu.cli.gateway import build_gateway
+
+    pixels = np.random.default_rng(0).integers(
+        0, 256, (32, 32, 1)).tolist()
+    body = json.dumps({"pixels": pixels}).encode()
+    procs = []
+    with tempfile.TemporaryDirectory() as workdir:
+        # two real backend PROCESSES on OS-assigned-free ports: ports are
+        # picked by binding port 0 briefly — a race is theoretically
+        # possible but these are loopback smoke runs
+        import socket
+
+        ports = []
+        holds = []
+        for _ in range(2):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            holds.append(s)
+        for s in holds:
+            s.close()
+        for i, port in enumerate(ports):
+            cmd = [sys.executable, "-m", "deep_vision_tpu.cli.serve",
+                   "-m", "lenet5", "--workdir", workdir,
+                   "--port", str(port), "--max-batch", "4",
+                   "--max-wait-ms", "2"]
+            if i == 0:
+                # transient compute fault on the survivor: the smoke
+                # crosses gateway failover AND bisect-retry recovery
+                cmd += ["--faults", "compute:exception:times=1"]
+            procs.append(subprocess.Popen(
+                cmd, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                stdout=subprocess.DEVNULL))
+        try:
+            for port, proc in zip(ports, procs):
+                _wait_healthy(port, proc)
+            gw, server = build_gateway(argparse.Namespace(
+                backend=[f"127.0.0.1:{p}" for p in ports],
+                host="127.0.0.1", port=0, probe_interval_ms=50.0,
+                retry_budget=3, breaker_threshold=2,
+                breaker_cooldown_s=30.0))
+            base = f"http://127.0.0.1:{server.port}"
+            server.start_background()
+            try:
+                ok = [0]
+                errors = []
+                lock = threading.Lock()
+                stop = threading.Event()
+
+                def client():
+                    while not stop.is_set():
+                        req = urllib.request.Request(
+                            base + "/v1/classify", data=body,
+                            headers={"Content-Type": "application/json"})
+                        try:
+                            with urllib.request.urlopen(
+                                    req, timeout=60) as r:
+                                assert r.status == 200
+                                assert len(json.loads(
+                                    r.read())["top"]) == 5
+                            with lock:
+                                ok[0] += 1
+                        except Exception as e:  # noqa: BLE001
+                            with lock:
+                                errors.append(repr(e))
+
+                threads = [threading.Thread(target=client)
+                           for _ in range(3)]
+                for t in threads:
+                    t.start()
+                time.sleep(1.0)        # warm load over both backends
+                procs[1].send_signal(signal.SIGKILL)  # the chaos moment
+                procs[1].wait(30)
+                time.sleep(2.0)        # load keeps running over the kill
+                stop.set()
+                for t in threads:
+                    t.join(60)
+                assert errors == [], \
+                    f"client-visible errors after SIGKILL: {errors[:5]}"
+                assert ok[0] > 20, f"only {ok[0]} requests completed"
+                deadline = time.monotonic() + 5
+                while gw.backends[1].routable() \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                assert not gw.backends[1].routable(), \
+                    "gateway still routing to the SIGKILL'd backend"
+                dead = gw.backends[1].report()
+                assert dead["breaker"] == "open", dead
+                c = gw.counters()
+                assert c["breaker_opens"] >= 1, c
+                print(f"gateway-smoke PASS (kill): {ok[0]} requests, 0 "
+                      f"errors across SIGKILL of backend :{ports[1]}; "
+                      f"gateway retries={c['retries']} "
+                      f"failovers={c['failovers']} "
+                      f"breaker_opens={c['breaker_opens']}")
+
+                # zero-downtime drain on the survivor: healthz flips to
+                # 503 draining, and with no routable backend left the
+                # GATEWAY healthz goes 503 too
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{ports[0]}/v1/drain", data=b"")
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    assert json.loads(r.read())["status"] == "draining"
+                try:
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{ports[0]}/v1/healthz",
+                        timeout=5)
+                    raise AssertionError("drained backend healthz != 503")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503, e.code
+                    assert json.loads(e.read())["status"] == "draining"
+                deadline = time.monotonic() + 5
+                while gw.backends[0].routable() \
+                        and time.monotonic() < deadline:
+                    time.sleep(0.02)
+                assert gw.backends[0].report()["unavailable"] \
+                    == "draining"
+                try:
+                    urllib.request.urlopen(base + "/v1/healthz",
+                                           timeout=5)
+                    raise AssertionError("gateway healthz != 503 with "
+                                         "no routable backend")
+                except urllib.error.HTTPError as e:
+                    assert e.code == 503, e.code
+                print(f"gateway-smoke PASS (drain): backend :{ports[0]} "
+                      f"draining -> gateway healthz 503, breaker still "
+                      f"closed (drain is not failure)")
+            finally:
+                server.shutdown()
+                gw.stop()
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.terminate()
+            for proc in procs:
+                try:
+                    proc.wait(30)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

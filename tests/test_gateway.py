@@ -1,0 +1,565 @@
+"""Gateway chaos contract: killing one of two backends mid-load loses
+ZERO admitted requests, the breaker stops routing to a dead backend
+within one probe interval, half-open recovers a returned backend, a
+hedged request's first answer wins, and 429 Retry-After survives the
+extra hop.
+
+Most tests run against scriptable STUB backends (a ThreadingHTTPServer
+whose healthz status, answer mode, and delay are test-controlled) so
+routing/breaker/retry behavior is deterministic and fast; one
+integration test drives two REAL serve stacks (LeNet engines) and
+SIGKILLs one mid-load."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.serve.gateway import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    Backend,
+    Gateway,
+    GatewayServer,
+)
+
+pytestmark = pytest.mark.gateway
+
+
+class StubBackend:
+    """A scriptable backend: mode/healthz/delay flipped mid-test."""
+
+    def __init__(self, tag: str):
+        self.tag = tag
+        self.mode = "ok"            # ok | fail | shed
+        self.delay_s = 0.0
+        self.healthz_status = 200
+        self.retry_after = 2
+        self.requests = 0
+        self._lock = threading.Lock()
+        stub = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, status, payload, headers=None):
+                blob = json.dumps(payload).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                for k, v in (headers or {}).items():
+                    self.send_header(k, str(v))
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
+
+            def do_GET(self):
+                if self.path == "/v1/healthz":
+                    s = stub.healthz_status
+                    self._reply(s, {"status": "ok" if s == 200
+                                    else "draining"})
+                else:
+                    self._reply(200, {"stub": stub.tag,
+                                      "served": stub.requests})
+
+            def do_POST(self):
+                with stub._lock:
+                    stub.requests += 1
+                if stub.delay_s:
+                    time.sleep(stub.delay_s)
+                self.rfile.read(
+                    int(self.headers.get("Content-Length") or 0))
+                if stub.mode == "fail":
+                    self._reply(500, {"error": "injected"})
+                elif stub.mode == "shed":
+                    self._reply(429, {"error": "shed: queue_full"},
+                                {"Retry-After": stub.retry_after})
+                else:
+                    self._reply(200, {"stub": stub.tag})
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_address[1]
+        self.url = f"127.0.0.1:{self.port}"
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    def kill(self):
+        """SIGKILL-alike: stop answering, free the port."""
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        self._thread.join(5)
+
+
+def _post(base, payload=None, timeout=10):
+    req = urllib.request.Request(
+        base + "/v1/classify",
+        data=json.dumps(payload or {"x": 1}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+def test_backend_url_parsing():
+    b = Backend("http://127.0.0.1:8001/")
+    assert (b.host, b.port) == ("127.0.0.1", 8001)
+    assert Backend("localhost:9000").name == "localhost:9000"
+    with pytest.raises(ValueError):
+        Backend("no-port")
+    with pytest.raises(ValueError):
+        Gateway(["127.0.0.1:1", "127.0.0.1:1"])
+    with pytest.raises(ValueError):
+        Gateway([])
+
+
+def test_routing_spreads_and_stats_aggregate():
+    """An idle fleet round-robins; /v1/stats carries gateway counters
+    plus every backend's own stats blob."""
+    stubs = [StubBackend("a"), StubBackend("b")]
+    gw = Gateway([s.url for s in stubs], probe_interval_s=60).start()
+    srv = GatewayServer(gw, port=0).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        for _ in range(8):
+            status, _, payload = _post(base)
+            assert status == 200 and payload["stub"] in ("a", "b")
+        assert stubs[0].requests >= 2 and stubs[1].requests >= 2
+        with urllib.request.urlopen(base + "/v1/stats") as r:
+            stats = json.loads(r.read())
+        assert stats["gateway"]["proxied"] == 8
+        assert stats["gateway"]["retries"] == 0
+        for s in stubs:
+            assert stats["gateway"]["backends"][s.url]["state"] == "ok"
+            assert stats["backends"][s.url]["stub"] == s.tag
+        with urllib.request.urlopen(base + "/v1/healthz") as r:
+            assert r.status == 200
+            assert set(json.loads(r.read())["routable"]) == \
+                {s.url for s in stubs}
+    finally:
+        srv.shutdown()
+        gw.stop()
+        for s in stubs:
+            s.kill()
+
+
+def test_kill_one_backend_loses_zero_requests():
+    """THE acceptance chaos test (stub edition): under concurrent load,
+    killing one of two backends produces zero client-visible errors —
+    every request fails over — and the breaker opens on the dead one."""
+    stubs = [StubBackend("a"), StubBackend("b")]
+    # probes effectively off: failure detection must work passively too
+    gw = Gateway([s.url for s in stubs], probe_interval_s=60,
+                 request_timeout_s=5, retry_budget=3,
+                 breaker_threshold=2, breaker_cooldown_s=30).start()
+    srv = GatewayServer(gw, port=0).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    errors, oks = [], []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                status, _, payload = _post(base)
+                with lock:
+                    oks.append(payload["stub"])
+            except Exception as e:  # noqa: BLE001 — any client error fails
+                with lock:
+                    errors.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        stubs[0].kill()  # mid-load
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(10)
+        assert errors == []          # zero lost requests, no 5xx seen
+        assert len(oks) > 20
+        assert "b" in oks[-5:]       # traffic converged on the survivor
+        dead = gw.backends[0]
+        assert dead.breaker == OPEN
+        assert dead.state in ("degraded", "dead")
+        c = gw.counters()
+        assert c["failovers"] >= 1 and c["retries"] >= 1
+        assert c["breaker_opens"] >= 1
+    finally:
+        stop.set()
+        srv.shutdown()
+        gw.stop()
+        stubs[1].kill()
+
+
+def test_probe_opens_breaker_without_traffic():
+    """Active probing alone takes a dead backend out of routing within
+    one probe interval — no request needs to eat the failure."""
+    stubs = [StubBackend("a"), StubBackend("b")]
+    gw = Gateway([s.url for s in stubs], probe_interval_s=0.05,
+                 probe_timeout_s=0.5, breaker_threshold=2,
+                 breaker_cooldown_s=30).start()
+    try:
+        stubs[0].kill()
+        deadline = time.monotonic() + 5
+        while gw.backends[0].routable() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not gw.backends[0].routable()
+        assert gw.backends[0].breaker == OPEN
+        assert gw.routable_backends() == [stubs[1].url]
+        # and a request now goes straight to the survivor, no retry
+        out = gw.forward("/v1/classify", b'{"x":1}')
+        assert out[0] == 200 and json.loads(out[2])["stub"] == "b"
+        assert gw.counters()["retries"] == 0
+    finally:
+        gw.stop()
+        stubs[1].kill()
+
+
+def test_breaker_half_open_recovers():
+    """CLOSED → OPEN on consecutive failures → HALF_OPEN after the
+    cooldown admits ONE trial → success closes the breaker."""
+    stub = StubBackend("a")
+    stub.mode = "fail"
+    gw = Gateway([stub.url], probe_interval_s=60, retry_budget=1,
+                 breaker_threshold=2, breaker_cooldown_s=0.2,
+                 backoff_ms=1).start()
+    try:
+        status, _, _ = gw.forward("/v1/classify", b'{"x":1}')
+        assert status == 502          # both attempts failed
+        b = gw.backends[0]
+        assert b.breaker == OPEN and b.breaker_opens == 1
+        # while OPEN and inside the cooldown: no routable backend → 503
+        status, headers, _ = gw.forward("/v1/classify", b'{"x":1}')
+        assert status == 503 and "Retry-After" in headers
+        assert stub.requests == 2     # the dead window sent it nothing
+        # cooldown elapses; backend is healthy again: trial closes it
+        stub.mode = "ok"
+        time.sleep(0.25)
+        assert b.routable() and b.breaker == HALF_OPEN
+        status, _, _ = gw.forward("/v1/classify", b'{"x":1}')
+        assert status == 200
+        assert b.breaker == CLOSED and b.breaker_closes == 1
+        assert b.half_open_trials == 1 and b.state == "ok"
+    finally:
+        gw.stop()
+        stub.kill()
+
+
+def test_breaker_reopens_on_failed_trial():
+    stub = StubBackend("a")
+    stub.mode = "fail"
+    gw = Gateway([stub.url], probe_interval_s=60, retry_budget=0,
+                 breaker_threshold=1, breaker_cooldown_s=0.1).start()
+    try:
+        assert gw.forward("/v1/classify", b'{"x":1}')[0] == 502
+        b = gw.backends[0]
+        assert b.breaker == OPEN
+        time.sleep(0.15)              # cooldown → trial admitted
+        assert gw.forward("/v1/classify", b'{"x":1}')[0] == 502
+        assert b.breaker == OPEN      # failed trial re-opened
+        assert b.breaker_opens == 2
+    finally:
+        gw.stop()
+        stub.kill()
+
+
+def test_429_propagates_with_retry_after():
+    """When EVERY backend sheds, the 429 (and its Retry-After) reaches
+    the client; with one shedding and one healthy, traffic fails over."""
+    stubs = [StubBackend("a"), StubBackend("b")]
+    for s in stubs:
+        s.mode = "shed"
+    gw = Gateway([s.url for s in stubs], probe_interval_s=60,
+                 retry_budget=3).start()
+    srv = GatewayServer(gw, port=0).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(base)
+        assert exc.value.code == 429
+        assert exc.value.headers["Retry-After"] == "2"
+        # a shed isn't a failure: breakers stay closed, state stays ok
+        assert all(b.breaker == CLOSED and b.state == "ok"
+                   for b in gw.backends)
+        # one backend recovers: the shed fails over and succeeds
+        stubs[1].mode = "ok"
+        status, _, payload = _post(base)
+        assert status == 200 and payload["stub"] == "b"
+        assert gw.counters()["failovers"] >= 1
+    finally:
+        srv.shutdown()
+        gw.stop()
+        for s in stubs:
+            s.kill()
+
+
+def test_unavailable_healthz_leaves_routing_without_penalty():
+    """A 503 healthz (draining) removes the backend from routing with
+    NO breaker damage, and a 200 probe restores it."""
+    stubs = [StubBackend("a"), StubBackend("b")]
+    gw = Gateway([s.url for s in stubs], probe_interval_s=0.05).start()
+    try:
+        stubs[0].healthz_status = 503
+        deadline = time.monotonic() + 5
+        while gw.backends[0].routable() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        b = gw.backends[0]
+        assert not b.routable()
+        assert b.unavailable == "draining"
+        assert b.breaker == CLOSED and b.consecutive_failures == 0
+        stubs[0].healthz_status = 200
+        deadline = time.monotonic() + 5
+        while not b.routable() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert b.routable()
+    finally:
+        gw.stop()
+        for s in stubs:
+            s.kill()
+
+
+def test_hedged_request_first_answer_wins():
+    """Primary stalls past the hedge delay → the duplicate on the other
+    backend answers first and wins; the loser is discarded, not failed."""
+    slow, fast = StubBackend("slow"), StubBackend("fast")
+    slow.delay_s = 1.0
+    gw = Gateway([slow.url, fast.url], probe_interval_s=60,
+                 hedge=True, hedge_after_ms=50).start()
+    try:
+        # pin the primary pick to the slow backend (rr offset 0)
+        gw._rr = 0
+        t0 = time.monotonic()
+        status, _, payload = gw.forward("/v1/classify", b'{"x":1}')
+        elapsed = time.monotonic() - t0
+        assert status == 200 and json.loads(payload)["stub"] == "fast"
+        assert elapsed < 0.9          # did not wait out the slow one
+        c = gw.counters()
+        assert c["hedges"] == 1 and c["hedge_wins"] == 1
+        assert c["retries"] == 0      # hedging is not a retry
+    finally:
+        gw.stop()
+        slow.kill()
+        fast.kill()
+
+
+def test_hedge_waits_for_p99_history():
+    """Without an explicit delay, hedging stays off until the gateway
+    has enough of its own latency history to know its p99."""
+    stub = StubBackend("a")
+    other = StubBackend("b")
+    gw = Gateway([stub.url, other.url], probe_interval_s=60,
+                 hedge=True, hedge_min_history=4).start()
+    try:
+        assert gw._hedge_delay_s() is None
+        for _ in range(4):
+            assert gw.forward("/v1/classify", b'{"x":1}')[0] == 200
+        assert gw._hedge_delay_s() is not None
+    finally:
+        gw.stop()
+        stub.kill()
+        other.kill()
+
+
+def test_no_routable_backend_is_503_not_hang():
+    stub = StubBackend("a")
+    gw = Gateway([stub.url], probe_interval_s=0.05,
+                 breaker_threshold=1, breaker_cooldown_s=30).start()
+    srv = GatewayServer(gw, port=0).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        stub.kill()
+        deadline = time.monotonic() + 5
+        while gw.backends[0].routable() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(base)
+        assert exc.value.code == 503
+        assert "Retry-After" in exc.value.headers
+        with urllib.request.urlopen(base + "/v1/healthz") as r:
+            pytest.fail(f"healthz should be 503, got {r.status}")
+    except urllib.error.HTTPError as e:
+        assert e.code == 503
+    finally:
+        srv.shutdown()
+        gw.stop()
+
+
+def test_gateway_rejects_bad_requests_locally():
+    """Malformed client input never consumes a backend attempt."""
+    stub = StubBackend("a")
+    gw = Gateway([stub.url], probe_interval_s=60).start()
+    srv = GatewayServer(gw, port=0).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        req = urllib.request.Request(base + "/v1/nope", data=b"{}")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 404
+        req = urllib.request.Request(base + "/v1/classify", data=b"")
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 400
+        assert stub.requests == 0
+    finally:
+        srv.shutdown()
+        gw.stop()
+        stub.kill()
+
+
+# -- integration: real serve stacks behind the gateway ---------------------
+
+
+@pytest.fixture(scope="module")
+def lenet_serving(tmp_path_factory):
+    from deep_vision_tpu.serve.registry import ModelRegistry
+
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint(
+        "lenet5", str(tmp_path_factory.mktemp("lenet_gw_workdir")))
+    return reg, sm
+
+
+def test_real_backends_survive_kill(lenet_serving):
+    """Two REAL LeNet serve stacks behind the gateway; hard-killing one
+    mid-load loses zero admitted requests from the client's view."""
+    from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = lenet_serving
+    engines = [BatchingEngine(sm, buckets=[1, 4], max_wait_ms=2).start()
+               for _ in range(2)]
+    servers = [ServeServer(reg, {sm.name: eng}, port=0).start_background()
+               for eng in engines]
+    gw = Gateway([f"127.0.0.1:{s.port}" for s in servers],
+                 probe_interval_s=0.05, request_timeout_s=30,
+                 retry_budget=3, breaker_threshold=2,
+                 breaker_cooldown_s=30).start()
+    gsrv = GatewayServer(gw, port=0).start_background()
+    base = f"http://127.0.0.1:{gsrv.port}"
+    body = {"pixels": np.zeros((32, 32, 1)).tolist()}
+    errors, oks = [], []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                status, _, payload = _post(base, body, timeout=30)
+                with lock:
+                    oks.append(status)
+                assert len(payload["top"]) == 5
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.5)
+        # hard-kill backend 0 mid-load: sockets die like a SIGKILL
+        servers[0].httpd.shutdown()
+        servers[0].httpd.server_close()
+        engines[0].stop(timeout=1)
+        time.sleep(1.0)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert errors == []
+        assert len(oks) > 10 and all(s == 200 for s in oks)
+        assert not gw.backends[0].routable()
+        assert gw.backends[1].routable()
+    finally:
+        stop.set()
+        gsrv.shutdown()
+        gw.stop()
+        for srv in servers[1:]:
+            srv.shutdown()
+        for eng in engines[1:]:
+            eng.stop()
+
+
+def test_drain_under_load_fails_no_admitted_request(lenet_serving):
+    """POST /v1/drain mid-load: healthz flips to 503 immediately, the
+    gateway routes away, and every admitted request still answers."""
+    from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = lenet_serving
+    engines = [BatchingEngine(sm, buckets=[1, 4], max_wait_ms=2).start()
+               for _ in range(2)]
+    servers = [ServeServer(reg, {sm.name: eng}, port=0).start_background()
+               for eng in engines]
+    gw = Gateway([f"127.0.0.1:{s.port}" for s in servers],
+                 probe_interval_s=0.05, retry_budget=3).start()
+    gsrv = GatewayServer(gw, port=0).start_background()
+    base = f"http://127.0.0.1:{gsrv.port}"
+    body = {"pixels": np.zeros((32, 32, 1)).tolist()}
+    errors, oks = [], []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            try:
+                status, _, _ = _post(base, body, timeout=30)
+                with lock:
+                    oks.append(status)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(repr(e))
+
+    threads = [threading.Thread(target=client) for _ in range(3)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        # drain backend 0 while the load is running
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{servers[0].port}/v1/drain",
+            data=json.dumps({"drain_deadline_s": 5}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            assert json.loads(r.read())["status"] == "draining"
+        # its healthz answers 503 draining from now on
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{servers[0].port}/v1/healthz",
+                timeout=5)
+        assert exc.value.code == 503
+        assert json.loads(exc.value.read())["status"] == "draining"
+        # gateway sees it unavailable within a probe interval or two
+        deadline = time.monotonic() + 5
+        while gw.backends[0].routable() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert gw.backends[0].unavailable == "draining"
+        assert gw.backends[0].breaker == CLOSED  # drain is not failure
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        assert errors == []           # zero admitted requests failed
+        assert len(oks) > 10 and all(s == 200 for s in oks)
+        # draining again is an idempotent no-op
+        with urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{servers[0].port}/v1/drain",
+                data=b""), timeout=30) as r:
+            assert json.loads(r.read())["already_draining"] is True
+    finally:
+        stop.set()
+        gsrv.shutdown()
+        gw.stop()
+        for srv in servers:
+            srv.shutdown()
+        for eng in engines:
+            eng.stop()

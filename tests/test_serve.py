@@ -8,6 +8,7 @@ Uses LeNet at random init (the restore path's no-checkpoint fallback):
 serving correctness is about request plumbing, not learned weights."""
 
 import json
+import socket
 import threading
 import urllib.error
 import urllib.request
@@ -174,6 +175,59 @@ def test_http_roundtrip(lenet_serving):
             assert stats["served"] >= 1
             assert stats["latency"]["count"] >= 1
             assert stats["admission"]["shed_deadline"] >= 1
+    finally:
+        srv.shutdown()
+        eng.stop()
+
+
+def test_slow_loris_cannot_pin_handler(lenet_serving):
+    """A client that opens a socket and never sends a request line is
+    disconnected after the per-connection timeout instead of holding a
+    handler thread forever; a healthy client still gets served after."""
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = lenet_serving
+    eng = BatchingEngine(sm, buckets=[4], max_wait_ms=2).start()
+    srv = ServeServer(reg, {sm.name: eng}, port=0,
+                      socket_timeout_s=0.3).start_background()
+    try:
+        loris = socket.create_connection(("127.0.0.1", srv.port))
+        loris.settimeout(5)
+        # send NOTHING: the server must close the connection on its own
+        assert loris.recv(1) == b""  # EOF = server hung up
+        loris.close()
+        # the handler thread is free again: normal traffic unaffected
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/healthz", timeout=5) as r:
+            assert r.status == 200
+    finally:
+        srv.shutdown()
+        eng.stop()
+
+
+def test_stalled_body_answers_408(lenet_serving):
+    """Headers arrive but the body stalls: the server answers 408 and
+    closes, instead of blocking in rfile.read until the client gives up."""
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = lenet_serving
+    eng = BatchingEngine(sm, buckets=[4], max_wait_ms=2).start()
+    srv = ServeServer(reg, {sm.name: eng}, port=0,
+                      socket_timeout_s=0.3).start_background()
+    try:
+        conn = socket.create_connection(("127.0.0.1", srv.port))
+        conn.settimeout(5)
+        conn.sendall(b"POST /v1/classify HTTP/1.1\r\n"
+                     b"Host: x\r\nContent-Type: application/json\r\n"
+                     b"Content-Length: 1000\r\n\r\n{\"pix")  # ...stall
+        reply = b""
+        while b"\r\n\r\n" not in reply:
+            chunk = conn.recv(4096)
+            if not chunk:
+                break
+            reply += chunk
+        assert b"408" in reply.split(b"\r\n", 1)[0]
+        conn.close()
     finally:
         srv.shutdown()
         eng.stop()
