@@ -20,9 +20,23 @@ test-all:
 # once pipelined (depth=2), once fault-injected, and once replicated over
 # 2 fake host devices (the cli.serve wiring, end to end; one bulk D2H
 # per batch throughout); then the gateway smoke (cross-host failover)
+# and the observability smoke (/metrics, spans, id propagation)
 serve-smoke:
 	$(PY) tests/serve_smoke.py
 	$(PY) tests/gateway_smoke.py
+	$(PY) tests/obs_smoke.py
+
+# the observability surface alone: Prometheus /metrics on backend and
+# gateway (every line parsed, counters monotonic between scrapes), a
+# ?debug=1 span accounting for its full measured latency, the client's
+# X-DVT-Request-Id crossing a real gateway hop into the backend's trace
+# ring (docs/OBSERVABILITY.md)
+obs-smoke:
+	$(PY) tests/obs_smoke.py
+
+# the observability unit/integration suite alone
+obs-test:
+	$(PY) -m pytest tests/test_obs.py -q -m obs
 
 # the cross-host failover contract end to end: 2 backend serve
 # SUBPROCESSES behind the in-process gateway, fault-injected load
@@ -109,4 +123,5 @@ list:
 
 .PHONY: test test-all bench bench-serve bench-serve-sync \
 	bench-serve-scaling bench-serve-wire bench-gateway serve-smoke \
-	serve-multi serve-chaos gateway-smoke gateway-test list
+	serve-multi serve-chaos gateway-smoke gateway-test obs-smoke \
+	obs-test list

@@ -32,21 +32,14 @@ import jax.numpy as jnp
 
 BASELINE_IMG_PER_SEC_PER_CHIP = 100.0
 
-# peak dense bf16 TFLOP/s per chip by device kind (public spec sheets)
-PEAK_BF16_TFLOPS = {
-    "TPU v4": 275.0,
-    "TPU v5 lite": 197.0,   # v5e
-    "TPU v5": 459.0,        # v5p
-    "TPU v6 lite": 918.0,   # Trillium
-}
-
-
-def _peak_tflops() -> float:
-    kind = jax.devices()[0].device_kind
-    for k, v in PEAK_BF16_TFLOPS.items():
-        if kind.startswith(k):
-            return v
-    return 197.0  # conservative default
+# the peak-TFLOP/s spec table moved to obs/mfu.py — one source of truth
+# for the training MFU here and the serving MFU gauge (/metrics);
+# PEAK_BF16_TFLOPS stays importable from bench for existing callers
+from deep_vision_tpu.obs.mfu import (  # noqa: E402
+    PEAK_BF16_TFLOPS,
+    compiled_flops as _compiled_flops,
+    peak_tflops as _peak_tflops,
+)
 
 
 def bench_train_step(batch: int = 256, size: int = 224, steps: int = 20,
@@ -241,13 +234,9 @@ def _hbm_gib(compiled) -> float | None:
 
 def _cost_flops(compiled) -> float | None:
     """FLOPs of one executable per XLA's cost analysis (honest MFU
-    numerator — no hand-derived constants)."""
-    try:
-        cost = compiled.cost_analysis()
-        ca = cost[0] if isinstance(cost, (list, tuple)) else cost
-        return float(ca.get("flops", 0.0)) or None
-    except Exception:
-        return None
+    numerator — no hand-derived constants); shared with the serving
+    registry via obs/mfu.py."""
+    return _compiled_flops(compiled)
 
 
 def _finish(out: dict, compiled, dt: float, n_steps: int, batch_size: int,
@@ -519,7 +508,8 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                 faults: str = "", fault_seed: int = 0,
                 serve_devices: int = 1,
                 wire_dtype: str = "float32",
-                infer_dtype: str = "float32") -> dict:
+                infer_dtype: str = "float32",
+                trace: bool = True) -> dict:
     """Closed-loop load generator against the dynamic-batching engine
     (``deep_vision_tpu/serve``): C client threads each submit one image,
     wait for the answer, repeat — so C is the offered load (concurrency),
@@ -552,6 +542,12 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
     BENCH_* trajectories track transfer volume alongside latency —
     ``bench.py --serve --serve-wire`` runs the full 4-cell comparison
     (``bench_serve_wire``).
+
+    ``trace`` toggles per-request span collection (obs/trace.py): the
+    JSON gains ``serving_mfu``/``mfu`` (analytic-FLOPs utilization,
+    docs/OBSERVABILITY.md) and ``stages`` (mean per-stage milliseconds
+    across traced requests); ``bench.py --serve --serve-obs`` runs
+    trace-off then trace-on and reports the overhead deltas.
     """
     import sys
     import tempfile
@@ -561,6 +557,7 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
 
     from deep_vision_tpu.core.config import get_config
     from deep_vision_tpu.core.restore import load_state
+    from deep_vision_tpu.obs.trace import Tracer
     from deep_vision_tpu.serve.engine import BatchingEngine
     from deep_vision_tpu.serve.admission import Shed
     from deep_vision_tpu.serve.faults import FaultPlane, Quarantined
@@ -580,6 +577,7 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
     else:
         img = np.random.RandomState(0).randn(
             *sm.input_shape).astype(np.float32)
+    tracer = Tracer(enabled=trace)
     if serve_devices > 1:
         from deep_vision_tpu.serve.replicas import (ReplicatedEngine,
                                                     local_devices)
@@ -588,12 +586,12 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
             sm, devices=local_devices(serve_devices),
             max_batch=max_batch, max_wait_ms=max_wait_ms,
             pipeline_depth=pipeline_depth,
-            faults=FaultPlane(faults, fault_seed))
+            faults=FaultPlane(faults, fault_seed), tracer=tracer)
     else:
         engine_ctx = BatchingEngine(
             sm, max_batch=max_batch, max_wait_ms=max_wait_ms,
             pipeline_depth=pipeline_depth,
-            faults=FaultPlane(faults, fault_seed))
+            faults=FaultPlane(faults, fault_seed), tracer=tracer)
     points = []
     with engine_ctx as engine:
         engine.warmup()  # compiles excluded from every load point
@@ -694,6 +692,18 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                 "exec_ewma_ms_by_bucket":
                     stats["admission"]["exec_ewma_ms_by_bucket"]},
             "device_kind": jax.devices()[0].device_kind}
+    mfu = stats.get("mfu") or {}
+    out["serving_mfu"] = mfu.get("serving_mfu")
+    out["mfu"] = {k: mfu.get(k) for k in
+                  ("serving_mfu", "flops_source", "flops_total",
+                   "compute_s", "unknown_flops_batches",
+                   "peak_flops_per_s")}
+    tr = stats.get("trace") or {}
+    out["trace_enabled"] = trace
+    if tr.get("enabled"):
+        out["stages"] = {"stage_ms_avg": tr.get("stage_ms_avg"),
+                         "traces_finished": tr.get("finished"),
+                         "slow_sampled": tr.get("slow_sampled")}
     if "replicas" in stats:
         out["serve_devices"] = serve_devices
         out["replicas"] = [
@@ -766,6 +776,32 @@ def bench_serve_wire(**kwargs) -> dict:
             / u8w[0]["h2d_bytes_per_batch"], 2)
     last["wire_sweep"] = table
     return last
+
+
+def bench_serve_obs(**kwargs) -> dict:
+    """Observability-overhead comparison (``bench.py --serve
+    --serve-obs``; docs/PERF.md "Observability overhead"): the serve
+    bench twice — per-request tracing OFF, then ON — same engine
+    parameters, fresh engine each run.  Emits the traced run's full
+    detail plus ``obs_overhead``: img/s and p99 at the top load point
+    for both runs and the on-vs-off deltas in percent (the acceptance
+    bar is < 2% on both)."""
+    kwargs.pop("trace", None)
+    off = bench_serve(trace=False, **kwargs)
+    on = bench_serve(trace=True, **kwargs)
+    t_off, t_on = off["loads"][-1], on["loads"][-1]
+    on["obs_overhead"] = {
+        "img_per_sec_off": t_off["img_per_sec"],
+        "img_per_sec_on": t_on["img_per_sec"],
+        "img_per_sec_delta_pct": round(
+            100.0 * (t_off["img_per_sec"] - t_on["img_per_sec"])
+            / max(1e-9, t_off["img_per_sec"]), 2),
+        "p99_ms_off": t_off["p99_ms"],
+        "p99_ms_on": t_on["p99_ms"],
+        "p99_delta_pct": round(
+            100.0 * (t_on["p99_ms"] - t_off["p99_ms"])
+            / max(1e-9, t_off["p99_ms"]), 2)}
+    return on
 
 
 def bench_gateway(model_name: str = "lenet5", loads: tuple = (1, 8),
@@ -1313,6 +1349,14 @@ def main():
                    help="in-flight batch window (--serve): 1 = the "
                         "synchronous comparison path, 2 = overlap batch "
                         "formation/H2D with device compute")
+    p.add_argument("--serve-obs", action="store_true",
+                   help="observability-overhead comparison (--serve): "
+                        "tracing off then on at identical parameters, "
+                        "one JSON with the on-run detail + img/s and "
+                        "p99 deltas (docs/PERF.md)")
+    p.add_argument("--serve-no-trace", action="store_true",
+                   help="disable per-request span collection for a "
+                        "single --serve run")
     p.add_argument("--serve-wire", action="store_true",
                    help="wire-format comparison sweep (--serve): f32 vs "
                         "uint8 wire x f32 vs bf16 compute, one JSON "
@@ -1389,8 +1433,11 @@ def main():
             loads=tuple(int(c) for c in args.serve_loads.split(",")),
             duration_s=args.serve_duration, max_batch=args.batch or 8,
             pipeline_depth=args.serve_pipeline_depth,
-            faults=args.faults, fault_seed=args.fault_seed)
-        if args.serve_wire:
+            faults=args.faults, fault_seed=args.fault_seed,
+            trace=not args.serve_no_trace)
+        if args.serve_obs:
+            print(json.dumps(bench_serve_obs(**serve_kwargs)))
+        elif args.serve_wire:
             print(json.dumps(bench_serve_wire(**serve_kwargs)))
         elif args.serve_devices > 1:
             print(json.dumps(bench_serve_scaling(

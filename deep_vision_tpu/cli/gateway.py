@@ -30,10 +30,15 @@ import argparse
 def build_gateway(args):
     """argparse namespace → (Gateway, GatewayServer); shared with
     ``tests/gateway_smoke.py`` so the smoke boots production wiring."""
+    from deep_vision_tpu.obs.trace import Tracer
     from deep_vision_tpu.serve.gateway import Gateway, GatewayServer
 
+    tracer = Tracer(ring=getattr(args, "trace_ring", 256),
+                    slow_ms=getattr(args, "slow_trace_ms", 250.0),
+                    enabled=not getattr(args, "no_trace", False))
     gw = Gateway(
         list(args.backend),
+        tracer=tracer,
         probe_interval_s=getattr(args, "probe_interval_ms", 250.0) / 1e3,
         probe_timeout_s=getattr(args, "probe_timeout_s", 1.0),
         request_timeout_s=getattr(args, "request_timeout_s", 30.0),
@@ -105,8 +110,24 @@ def main(argv=None):
                         "disables); same slow-loris guard as the "
                         "backends")
     p.add_argument("--verbose", action="store_true")
+    # -- observability (docs/OBSERVABILITY.md) --
+    p.add_argument("--log-level", default="info",
+                   choices=("debug", "info", "warning", "error"),
+                   help="structured-log threshold for the dvt.serve.* "
+                        "loggers (one JSON line per event on stderr)")
+    p.add_argument("--trace-ring", type=int, default=256,
+                   help="per-request spans kept in memory for "
+                        "GET /v1/traces")
+    p.add_argument("--slow-trace-ms", type=float, default=250.0,
+                   help="requests slower than this emit their full span "
+                        "as a slow_request log line; 0 disables")
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable per-request span collection")
     args = p.parse_args(argv)
 
+    from deep_vision_tpu.obs.log import configure_logging
+
+    configure_logging(args.log_level)
     gw, server = build_gateway(args)
     ok, health = gw.healthz()
     print(f"[gateway] listening on http://{server.host}:{server.port} "

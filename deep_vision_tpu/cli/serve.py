@@ -49,6 +49,7 @@ def build_server(args):
     builds ONE engine whose padded batches span the data axis of a mesh
     over those devices (mutually exclusive by construction — replication
     parallelizes many small batches, sharding one large batch)."""
+    from deep_vision_tpu.obs.trace import Tracer
     from deep_vision_tpu.serve.admission import AdmissionController
     from deep_vision_tpu.serve.engine import BatchingEngine, sharded_buckets
     from deep_vision_tpu.serve.faults import FaultPlane
@@ -94,9 +95,13 @@ def build_server(args):
         devices = local_devices(serve_devices or None)
     else:
         devices = None  # the PR 1–3 single-engine path, untouched
+    tracer = Tracer(ring=getattr(args, "trace_ring", 256),
+                    slow_ms=getattr(args, "slow_trace_ms", 250.0),
+                    enabled=not getattr(args, "no_trace", False))
     engine_kwargs = dict(
         max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
         buckets=buckets,
+        tracer=tracer,
         pipeline_depth=getattr(args, "pipeline_depth", 2),
         faults=faults,
         watchdog_interval_s=getattr(args, "watchdog_interval_ms", 50.0)
@@ -131,7 +136,8 @@ def build_server(args):
         verbose=args.verbose,
         max_body_bytes=int(getattr(args, "max_body_mb", 32) * 2**20),
         socket_timeout_s=socket_timeout_s if socket_timeout_s > 0
-        else None)
+        else None,
+        tracer=tracer)
     return engine, server
 
 
@@ -229,10 +235,27 @@ def main(argv=None):
                         "client (slow-loris) is closed / answered 408 "
                         "instead of pinning a handler thread; 0 "
                         "disables")
+    # -- observability (docs/OBSERVABILITY.md) --
+    p.add_argument("--log-level", default="info",
+                   choices=("debug", "info", "warning", "error"),
+                   help="structured-log threshold for the dvt.serve.* "
+                        "loggers (one JSON line per event on stderr)")
+    p.add_argument("--trace-ring", type=int, default=256,
+                   help="per-request spans kept in memory for "
+                        "GET /v1/traces")
+    p.add_argument("--slow-trace-ms", type=float, default=250.0,
+                   help="requests slower than this emit their full span "
+                        "as a slow_request log line; 0 disables")
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable per-request span collection entirely "
+                        "(tracing costs ~one dict per request; this "
+                        "removes even that)")
     args = p.parse_args(argv)
 
     from deep_vision_tpu.core.compile_cache import enable_compile_cache
+    from deep_vision_tpu.obs.log import configure_logging
 
+    configure_logging(args.log_level)
     enable_compile_cache()
     engine, server = build_server(args)
     sm = engine.model
@@ -252,6 +275,7 @@ def main(argv=None):
         print(f"[serve] FAULT INJECTION ACTIVE: '{engine.faults.spec}' "
               f"(seed {engine.faults.seed})")
     print(f"[serve] try: curl http://{server.host}:{server.port}/v1/healthz")
+    print(f"[serve] metrics: curl http://{server.host}:{server.port}/metrics")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -185,6 +185,102 @@ class LatencyHistogram:
         return self
 
 
+def _prom_num(v) -> str:
+    """Prometheus sample/edge value formatting: integers stay integral,
+    floats use repr (deterministic, full precision — bucket ``le``
+    labels must be byte-identical across scrapes or the series forks)."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    return str(int(f)) if f.is_integer() and abs(f) < 1e15 else repr(f)
+
+
+def _prom_escape(v: str) -> str:
+    return v.replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+class PromText:
+    """Prometheus text-exposition (format 0.0.4) renderer — stdlib only.
+
+    The serving ``/metrics`` endpoints (serve/http.py, serve/gateway.py)
+    feed their existing counters/gauges and ``LatencyHistogram`` states
+    through this instead of maintaining a parallel metric registry:
+    the stats dicts stay the source of truth, this renders a snapshot.
+
+    ``histogram`` renders a ``LatencyHistogram.state_dict`` as the
+    cumulative ``le`` buckets Prometheus expects: bucket[le=edges[j]] =
+    counts[0..j] summed (counts[0] is the <lo underflow bin, so it
+    folds into the first edge), ``+Inf`` = total, plus ``_sum`` and
+    ``_count``.  Every edge is always emitted — empty buckets included
+    — so the bucket series are stable across scrapes and quantile
+    recomputation (histogram_quantile) sees the full grid.
+    """
+
+    def __init__(self):
+        self._lines: list[str] = []
+        self._typed: set[str] = set()
+
+    def _meta(self, name: str, typ: str, help_: str):
+        if name in self._typed:
+            return
+        self._typed.add(name)
+        if help_:
+            self._lines.append(f"# HELP {name} {help_}")
+        self._lines.append(f"# TYPE {name} {typ}")
+
+    @staticmethod
+    def _labels(labels: dict | None) -> str:
+        if not labels:
+            return ""
+        inner = ",".join(f'{k}="{_prom_escape(str(v))}"'
+                         for k, v in sorted(labels.items()))
+        return "{" + inner + "}"
+
+    def sample(self, name: str, value, labels: dict | None = None, *,
+               typ: str = "gauge", help: str = ""):
+        """One sample line; ``None`` values are skipped (an unknown
+        gauge is absent, never fabricated as 0)."""
+        if value is None:
+            return
+        self._meta(name, typ, help)
+        self._lines.append(f"{name}{self._labels(labels)} "
+                           f"{_prom_num(value)}")
+
+    def counter(self, name: str, value, labels: dict | None = None,
+                help: str = ""):
+        self.sample(name, value, labels, typ="counter", help=help)
+
+    def gauge(self, name: str, value, labels: dict | None = None,
+              help: str = ""):
+        self.sample(name, value, labels, typ="gauge", help=help)
+
+    def histogram(self, name: str, state: dict,
+                  labels: dict | None = None, help: str = ""):
+        """Cumulative buckets from a ``LatencyHistogram.state_dict``
+        (``le`` values in seconds, matching what ``record`` observes)."""
+        self._meta(name, "histogram", help)
+        labels = dict(labels or {})
+        edges, counts = state["edges"], state["counts"]
+        cum = 0
+        for i, edge in enumerate(edges):
+            cum += counts[i]
+            self._lines.append(
+                f"{name}_bucket"
+                f"{self._labels({**labels, 'le': _prom_num(edge)})} {cum}")
+        total = int(state["total"])
+        self._lines.append(
+            f"{name}_bucket{self._labels({**labels, 'le': '+Inf'})} "
+            f"{total}")
+        self._lines.append(f"{name}_sum{self._labels(labels)} "
+                           f"{_prom_num(float(state['sum']))}")
+        self._lines.append(f"{name}_count{self._labels(labels)} {total}")
+
+    def render(self) -> str:
+        return "\n".join(self._lines) + "\n"
+
+
 class ThroughputMeter:
     """Images/sec with warmup exclusion — the reference printed this per-100
     batches (YOLO/tensorflow/train.py:217-223)."""

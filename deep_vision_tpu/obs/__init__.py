@@ -1,0 +1,36 @@
+"""Serving observability: per-request tracing, structured logging, MFU.
+
+Three small, dependency-free pieces that the serving stack
+(``deep_vision_tpu/serve``) threads through every layer — batcher,
+drainer, router, watchdog, prober — without perturbing the clean hot
+path (the same discipline as ``faults.py``: one ``enabled``/``is None``
+read guards every touch point):
+
+    trace.py  ``Span`` (per-request stage timestamps + hop notes) and
+              ``Tracer`` (bounded in-memory ring of recent traces, a
+              slow-request JSONL sampler, per-stage aggregate sums).
+              Request ids arrive at the edge (``X-DVT-Request-Id``,
+              generated at gateway or backend, propagated via header);
+              ``?debug=1`` echoes a request's own breakdown.
+    log.py    ``logging``-based structured one-line-JSON events under
+              the ``dvt.serve.*`` namespaces (watchdog restarts,
+              breaker transitions, quarantines, evacuations each emit
+              exactly one line with the request/batch context).
+    mfu.py    serving MFU: per-bucket analytic FLOPs (XLA cost
+              analysis, with a documented params-based fallback) over
+              measured compute-stage seconds against the device peak —
+              a ``serving_mfu`` gauge in ``/metrics``, ``/v1/stats``
+              and ``bench.py --serve``.
+
+The Prometheus text renderer the ``/metrics`` endpoints use lives in
+``core/metrics.py`` (``PromText``) next to ``LatencyHistogram``, whose
+fixed shared bin edges are what make cumulative-bucket export and
+cross-process merging exact.  Docs: docs/OBSERVABILITY.md.
+"""
+
+from deep_vision_tpu.obs.log import configure_logging, event, get_logger
+from deep_vision_tpu.obs.mfu import MfuMeter, peak_flops_per_s
+from deep_vision_tpu.obs.trace import Span, Tracer, new_request_id
+
+__all__ = ["MfuMeter", "Span", "Tracer", "configure_logging", "event",
+           "get_logger", "new_request_id", "peak_flops_per_s"]

@@ -1,0 +1,178 @@
+"""Serving MFU: analytic FLOPs over measured compute-stage seconds.
+
+Training has had an MFU number since PR 1 (bench.py, 31.4% on the
+reference step); serving had none.  The meter closes that: each bucket
+program's FLOP count comes from XLA's own cost analysis on the AOT
+executable (``jax.jit(...).lower(...).compile().cost_analysis()`` —
+the registry attaches it to the bucket callable at compile time), and
+the engine feeds in the measured per-batch compute-stage seconds it
+already derives for admission control (completion minus the later of
+dispatch or the previous batch's completion, i.e. device occupancy
+under pipelining, not queue wait).
+
+    serving_mfu = Σ(batches_b × flops_b) / Σ compute_s / peak_flops
+
+Fallback, documented: when XLA cost analysis is unavailable (a loaded
+StableHLO blob has no compiled object; some backends return no
+``flops`` key) the registry substitutes ``2 × params × batch`` — a
+dense-matmul LOWER BOUND that ignores convolution reuse — and labels
+the source ``params_lower_bound`` so a too-good-to-be-true gauge is
+never silently wrong.  Peak FLOP/s comes from the same public
+spec-sheet table bench.py has always used (bf16 dense, per chip);
+non-TPU backends fall back to the v5e figure, which makes CPU-run MFU
+honest only as a "> 0 and sane" plumbing check, not a roofline.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# peak dense bf16 TFLOP/s per chip by device kind (public spec sheets);
+# bench.py imports this table — one source of truth for both MFUs
+PEAK_BF16_TFLOPS = {
+    "TPU v4": 275.0,
+    "TPU v5 lite": 197.0,   # v5e
+    "TPU v5": 459.0,        # v5p
+    "TPU v6 lite": 918.0,   # Trillium
+}
+
+_DEFAULT_TFLOPS = 197.0  # conservative: v5e
+
+
+def peak_tflops(device_kind: str | None = None) -> float:
+    """Peak bf16 TFLOP/s for a device kind (current backend if None)."""
+    if device_kind is None:
+        import jax
+
+        device_kind = jax.devices()[0].device_kind
+    for k, v in PEAK_BF16_TFLOPS.items():
+        if device_kind.startswith(k):
+            return v
+    return _DEFAULT_TFLOPS
+
+
+def peak_flops_per_s(device_kind: str | None = None) -> float:
+    return peak_tflops(device_kind) * 1e12
+
+
+def compiled_flops(compiled) -> float | None:
+    """FLOPs of one executable per XLA's cost analysis (honest MFU
+    numerator — no hand-derived constants); None when the backend
+    doesn't report it."""
+    try:
+        cost = compiled.cost_analysis()
+        ca = cost[0] if isinstance(cost, (list, tuple)) else cost
+        return float(ca.get("flops", 0.0)) or None
+    except Exception:  # noqa: BLE001 — cost analysis is best-effort
+        return None
+
+
+def params_flops_lower_bound(variables, batch: int) -> float:
+    """The documented fallback: 2 × float-param count × batch (one
+    multiply-add per weight per image — exact for dense layers, a lower
+    bound for convolutions, which reuse each weight spatially)."""
+    import jax
+    import numpy as np
+
+    n = sum(int(np.prod(a.shape))
+            for a in jax.tree_util.tree_leaves(variables)
+            if getattr(a, "dtype", np.dtype("O")).kind == "f")
+    return 2.0 * n * batch
+
+
+def round_mfu(mfu: float | None) -> float | None:
+    """6 SIGNIFICANT digits, not 6 decimals: a CPU smoke run's honest
+    ~1e-8 MFU must survive reporting instead of rounding to 0."""
+    return float(f"{mfu:.6g}") if mfu is not None else None
+
+
+class MfuMeter:
+    """Accumulates (bucket flops × batches) and compute seconds.
+
+    Thread-safe under its own lock: ``observe`` is called from the
+    drainer (pipelined path) and from the synchronous retry path.  The
+    peak resolves lazily on first ``report`` so constructing an engine
+    never initializes the JAX backend.
+    """
+
+    def __init__(self, peak: float | None = None):
+        self._lock = threading.Lock()
+        self._peak = peak
+        self._bucket_flops: dict[int, float | None] = {}
+        self._source: str | None = None
+        self.batches = 0
+        self.images = 0
+        self.compute_s = 0.0
+        self.flops = 0.0
+        self.unknown_flops_batches = 0
+
+    def set_bucket_flops(self, bucket: int, flops: float | None,
+                         source: str | None = None):
+        with self._lock:
+            self._bucket_flops[int(bucket)] = flops
+            if source is not None:
+                self._source = source
+
+    def observe(self, bucket: int, images: int, compute_s: float):
+        """One executed batch: its bucket, live image count, and
+        measured compute-stage seconds."""
+        with self._lock:
+            self.batches += 1
+            self.images += int(images)
+            self.compute_s += max(0.0, float(compute_s))
+            f = self._bucket_flops.get(int(bucket))
+            if f:
+                self.flops += f
+            else:
+                self.unknown_flops_batches += 1
+
+    def peak(self) -> float:
+        if self._peak is None:
+            self._peak = peak_flops_per_s()
+        return self._peak
+
+    def mfu(self) -> float | None:
+        with self._lock:
+            if self.compute_s <= 0 or self.flops <= 0:
+                return None
+            flops, secs = self.flops, self.compute_s
+        return flops / secs / self.peak()
+
+    def report(self) -> dict:
+        mfu = self.mfu()
+        with self._lock:
+            return {"serving_mfu": round_mfu(mfu),
+                    "flops_total": self.flops,
+                    "compute_s": round(self.compute_s, 6),
+                    "batches": self.batches,
+                    "images": self.images,
+                    "unknown_flops_batches": self.unknown_flops_batches,
+                    "peak_flops_per_s": self._peak,
+                    "flops_source": self._source,
+                    "flops_by_bucket": {
+                        str(b): f for b, f in
+                        sorted(self._bucket_flops.items())}}
+
+    @staticmethod
+    def merged_report(meters: list["MfuMeter"]) -> dict:
+        """Fleet view over replica meters (same process, same peak):
+        FLOPs and compute seconds sum; MFU recomputes from the sums."""
+        flops = sum(m.flops for m in meters)
+        secs = sum(m.compute_s for m in meters)
+        peak = meters[0].peak() if meters else peak_flops_per_s()
+        mfu = flops / secs / peak if secs > 0 and flops > 0 else None
+        by_bucket: dict[str, float | None] = {}
+        for m in meters:
+            for b, f in m._bucket_flops.items():
+                by_bucket.setdefault(str(b), f)
+        return {"serving_mfu": round_mfu(mfu),
+                "flops_total": flops,
+                "compute_s": round(secs, 6),
+                "batches": sum(m.batches for m in meters),
+                "images": sum(m.images for m in meters),
+                "unknown_flops_batches": sum(m.unknown_flops_batches
+                                             for m in meters),
+                "peak_flops_per_s": peak,
+                "flops_source": next((m._source for m in meters
+                                      if m._source), None),
+                "flops_by_bucket": dict(sorted(by_bucket.items()))}

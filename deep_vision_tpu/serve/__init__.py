@@ -23,13 +23,21 @@ stack's restore path.
     http.py       stdlib HTTP front-end (/v1/classify, /v1/detect,
                   deep /v1/healthz with 503-on-degraded, /v1/drain
                   zero-downtime shutdown, per-connection socket
-                  timeouts)
+                  timeouts, Prometheus-text /metrics, /v1/traces,
+                  ?debug=1 per-request timing breakdowns)
     gateway.py    cross-host front tier: proxies /v1/classify|detect
                   over a table of backend serve processes with active
                   healthz probing, per-backend circuit breakers,
                   least-outstanding-work routing, bounded retries with
                   failover (a SIGKILL'd backend loses zero admitted
                   requests), and optional tail hedging
+
+Observability (docs/OBSERVABILITY.md) lives in the sibling
+``deep_vision_tpu.obs`` package: per-request spans with request-id
+propagation (``X-DVT-Request-Id``, gateway → backend), structured
+JSON-line logging under the ``dvt.serve.*`` namespaces, and serving-MFU
+accounting (analytic per-bucket FLOPs ÷ measured compute time).  Both
+HTTP front-ends export ``GET /metrics`` in Prometheus text format.
 
 Entry points: ``python -m deep_vision_tpu.cli.serve`` (one backend),
 ``python -m deep_vision_tpu.cli.gateway`` (front tier); load generator:

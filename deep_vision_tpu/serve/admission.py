@@ -26,6 +26,10 @@ import dataclasses
 import threading
 import time
 
+from deep_vision_tpu.obs.log import event, get_logger
+
+_log = get_logger("dvt.serve.admission")
+
 
 @dataclasses.dataclass
 class Shed:
@@ -61,6 +65,11 @@ class AdmissionController:
         self._lock = threading.Lock()
         self.shed_queue_full = 0
         self.shed_deadline = 0
+        # edge-triggered overload logging: one line when queue_full
+        # shedding STARTS, one when an admit clears it — never a line
+        # per shed request (a saturated engine must not also saturate
+        # its own log)
+        self._overloaded = False
 
     def observe_exec(self, seconds: float, bucket: int | None = None):
         """Feed one batch's execution time into the EWMAs (global + the
@@ -120,10 +129,22 @@ class AdmissionController:
         if queue_depth >= self.max_queue:
             with self._lock:
                 self.shed_queue_full += 1
+                entered = not self._overloaded
+                self._overloaded = True
+            if entered:
+                event(_log, "overload_shed_start",
+                      queue_depth=queue_depth, max_queue=self.max_queue,
+                      inflight=inflight)
             return Shed("queue_full",
                         f"queue depth {queue_depth} >= {self.max_queue}",
                         retry_after_s=self.estimated_service_s(
                             bucket, inflight))
+        with self._lock:
+            cleared = self._overloaded
+            self._overloaded = False
+        if cleared:
+            event(_log, "overload_cleared", queue_depth=queue_depth,
+                  shed_queue_full=self.shed_queue_full)
         if deadline is not None:
             now = time.monotonic() if now is None else now
             est = self.estimated_service_s(bucket, inflight)

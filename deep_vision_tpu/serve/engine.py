@@ -75,6 +75,9 @@ from concurrent.futures import Future
 import numpy as np
 
 from deep_vision_tpu.core.metrics import LatencyHistogram, ThroughputMeter
+from deep_vision_tpu.obs.log import event, get_logger
+from deep_vision_tpu.obs.mfu import MfuMeter
+from deep_vision_tpu.obs.trace import Tracer
 from deep_vision_tpu.serve.admission import AdmissionController, Shed
 from deep_vision_tpu.serve.faults import (
     FaultPlane,
@@ -83,6 +86,8 @@ from deep_vision_tpu.serve.faults import (
     Quarantined,
 )
 from deep_vision_tpu.serve.health import EngineHealth
+
+_log = get_logger("dvt.serve.engine")
 
 
 def power_of_two_buckets(max_batch: int) -> list[int]:
@@ -105,14 +110,19 @@ def sharded_buckets(max_batch: int, num_devices: int) -> list[int]:
 
 
 class _Request:
-    __slots__ = ("image", "deadline", "enqueued_at", "future", "poison")
+    __slots__ = ("image", "deadline", "enqueued_at", "future", "poison",
+                 "span")
 
-    def __init__(self, image, deadline, enqueued_at, future, poison=False):
+    def __init__(self, image, deadline, enqueued_at, future, poison=False,
+                 span=None):
         self.image = image
         self.deadline = deadline
         self.enqueued_at = enqueued_at
         self.future = future
         self.poison = poison
+        # obs.trace.Span or None (tracing off): every touch point on
+        # the hot path guards on that single None read, faults.py-style
+        self.span = span
 
 
 class _Inflight:
@@ -212,7 +222,8 @@ class BatchingEngine:
                  retry_backoff_max_ms: float = 100.0,
                  degraded_after: int = 1, dead_after: int = 5,
                  external_batcher: bool = False,
-                 rescue=None):
+                 rescue=None,
+                 tracer: Tracer | None = None):
         self.model = model
         if model.fixed_batch is not None:
             # a StableHLO blob serves exactly its traced shapes; an
@@ -236,6 +247,11 @@ class BatchingEngine:
             max_wait_ms=max_wait_ms)
         self.latency = LatencyHistogram()
         self.throughput = ThroughputMeter(warmup_steps=1)
+        # request tracing + serving-MFU accounting (obs/): the tracer is
+        # shared with the HTTP front-end (and across replicas) so one
+        # ring holds the whole process's recent traces
+        self.tracer = tracer or Tracer()
+        self.mfu = MfuMeter()
         # the model's wire format IS the staging/H2D dtype: submit casts
         # to it, pooled buffers allocate in it, the bulk device_put
         # ships it (uint8 wire = 4× fewer staged bytes than float32)
@@ -384,14 +400,25 @@ class BatchingEngine:
 
     # -- request path ------------------------------------------------------
 
-    def submit(self, image, deadline_ms: float | None = None) -> Future:
+    def submit(self, image, deadline_ms: float | None = None,
+               span=None) -> Future:
         fut: Future = Future()
+        # span ownership: a caller-provided span (HTTP front-end) is
+        # marked here but finished by its creator; an engine-created
+        # span seals itself on ANY terminal path via the future's
+        # done-callback (served, shed, quarantined, timed out)
+        if span is None and self.tracer.enabled:
+            span = self.tracer.start()
+            fut.add_done_callback(
+                lambda _f, _s=span: self.tracer.finish(_s))
         if not self._accepting:
             # fail fast: nothing drains the queue before start()/after
             # stop(), so enqueueing would park the future forever
             with self._lock:
                 self.submitted += 1
                 self.shed_shutdown += 1
+            if span is not None:
+                span.note("shed", "shutdown")
             fut.set_result(Shed(
                 "shutdown", "engine is not accepting requests "
                             "(stopped or not started)"))
@@ -408,18 +435,22 @@ class BatchingEngine:
             bucket=self._bucket_for(min(depth + 1, self.max_batch)),
             inflight=inflight)
         if shed is not None:
+            if span is not None:
+                span.note("shed", shed.reason)
             fut.set_result(shed)
             return fut
         poison = self.faults.mark_poison() if self.faults.enabled else False
+        if span is not None:
+            span.mark("admit")
         # the request rides the WIRE dtype end to end: uint8 clients hand
         # raw pixels straight through to the staged batch (no float copy)
         self._queue.put(_Request(np.asarray(image, self.wire_dtype),
-                                 deadline, now, fut, poison))
+                                 deadline, now, fut, poison, span))
         return fut
 
     def infer(self, image, deadline_ms: float | None = None,
-              timeout: float | None = 30.0):
-        return self.submit(image, deadline_ms).result(timeout)
+              timeout: float | None = 30.0, span=None):
+        return self.submit(image, deadline_ms, span=span).result(timeout)
 
     # -- batcher thread (stage + dispatch) ---------------------------------
 
@@ -433,6 +464,8 @@ class BatchingEngine:
                     first = self._queue.get(timeout=0.05)
                 except queue.Empty:
                     continue
+                if first.span is not None:
+                    first.span.mark("queue_wait")
                 # non-zero while requests are in hand but not yet in the
                 # in-flight window, so stop(drain_deadline=...) can't
                 # slip between queue drain and dispatch
@@ -445,10 +478,12 @@ class BatchingEngine:
                         if remaining <= 0:
                             break
                         try:
-                            batch.append(
-                                self._queue.get(timeout=remaining))
+                            req = self._queue.get(timeout=remaining)
                         except queue.Empty:
                             break
+                        if req.span is not None:
+                            req.span.mark("queue_wait")
+                        batch.append(req)
                     self.dispatch_cohort(batch)
                 finally:
                     self._forming = 0
@@ -487,6 +522,12 @@ class BatchingEngine:
             self._executables[bucket] = fn
             with self._lock:
                 self.compiles += 1
+            # registry attaches the bucket program's analytic FLOPs at
+            # compile time (XLA cost analysis, or the documented
+            # params-based lower bound) — the serving-MFU numerator
+            self.mfu.set_bucket_flops(
+                bucket, getattr(fn, "cost_flops", None),
+                getattr(fn, "flops_source", None))
         return fn
 
     def _fill(self, buf: np.ndarray, requests: list[_Request]):
@@ -521,8 +562,12 @@ class BatchingEngine:
         for req in batch:
             expired = self.admission.expired(req.deadline)
             if expired is not None:
+                if req.span is not None:
+                    req.span.note("shed", "deadline expired in queue")
                 req.future.set_result(expired)
             else:
+                if req.span is not None:
+                    req.span.mark("batch_form")
                 live.append(req)
         if not live:
             return
@@ -538,6 +583,12 @@ class BatchingEngine:
             if self.faults.enabled:
                 self.faults.inject("staging", stop=self._stop)
             self._fill(buf, live)
+            # the staging segment covers compile (first hit only), the
+            # in-flight-slot wait (pipeline backpressure) and the buffer
+            # fill — everything between formation and the H2D issue
+            for req in live:
+                if req.span is not None:
+                    req.span.mark("staging")
             t0 = time.monotonic()
             if self.faults.enabled:
                 self.faults.inject("dispatch", stop=self._stop)
@@ -556,6 +607,9 @@ class BatchingEngine:
             self._inflight_sem.release()
             self._cohort_failed(live, e)
             return
+        for req in live:
+            if req.span is not None:
+                req.span.mark("h2d_dispatch")
         rec = _Inflight(live, bucket, out, buf, t0,
                         threading.Event() if self.faults.enabled else None)
         with self._lock:
@@ -641,6 +695,9 @@ class BatchingEngine:
             busy_from = rec.dispatched_at if self._last_done is None \
                 else max(rec.dispatched_at, self._last_done)
         self.admission.observe_exec(t_done - busy_from, bucket=rec.bucket)
+        # the same device-occupancy measurement is the serving-MFU
+        # denominator: compute-stage seconds, not queue or drain wait
+        self.mfu.observe(rec.bucket, n, t_done - busy_from)
         nbytes = int(sum(np.asarray(a).nbytes
                          for a in jax.tree_util.tree_leaves(host)))
         with self._lock:
@@ -652,6 +709,11 @@ class BatchingEngine:
         self.throughput.update(n)
         for i, req in enumerate(rec.requests):
             self.latency.record(t_done - req.enqueued_at)
+            if req.span is not None:
+                # marked BEFORE resolving the future: the span's owner
+                # (HTTP handler / done-callback) takes over at resolve,
+                # so the engine never appends to a span concurrently
+                req.span.mark("compute_d2h")
             if not req.future.done():
                 req.future.set_result(
                     jax.tree_util.tree_map(lambda a: np.asarray(a)[i],
@@ -678,8 +740,14 @@ class BatchingEngine:
             self.batch_failures += 1
         self.health.record_failure()
         pending = [r for r in requests if not r.future.done()]
+        event(_log, "batch_failure", model=self.model.name,
+              cohort=len(requests), pending=len(pending),
+              error=f"{type(err).__name__}: {err}")
         if not pending:
             return
+        for r in pending:
+            if r.span is not None:
+                r.span.note("batch_failure", type(err).__name__)
         budget = [self.retry_budget]
         self._isolate(pending, err, budget)
 
@@ -727,10 +795,15 @@ class BatchingEngine:
     def _quarantine(self, req: _Request, err: Exception, exhausted: bool):
         with self._lock:
             self.quarantined += 1
+        reason = "retry_budget" if exhausted else "poison"
+        if req.span is not None:
+            req.span.note("quarantined", reason)
+        event(_log, "quarantine", model=self.model.name, reason=reason,
+              request_id=req.span.request_id if req.span else None,
+              error=f"{type(err).__name__}: {err}")
         if not req.future.done():
             req.future.set_result(Quarantined(
-                "retry_budget" if exhausted else "poison",
-                f"{type(err).__name__}: {err}"))
+                reason, f"{type(err).__name__}: {err}"))
 
     def _execute_subset(self, requests: list[_Request]):
         """Synchronous re-execution of a retry cohort: own staging
@@ -741,8 +814,12 @@ class BatchingEngine:
         with self._lock:
             self.retry_executions += 1
         n = len(requests)
+        for req in requests:
+            if req.span is not None:
+                req.span.note("bisect_retry", f"cohort of {n}")
         bucket = self._bucket_for(n)
         fn = self._compiled(bucket)
+        t0 = time.monotonic()
         # same allocation contract as the pipelined path: pooled staging
         # buffer + the shared placement-aware transfer — never a fresh
         # np.zeros / bare device_put per retry batch
@@ -765,6 +842,9 @@ class BatchingEngine:
         finally:
             self.staging.release(bucket, buf)
         t_done = time.monotonic()
+        # the retry ran synchronously, so its wall time IS its compute
+        # occupancy — feed the MFU meter the same way the drainer does
+        self.mfu.observe(bucket, n, t_done - t0)
         nbytes = int(sum(np.asarray(a).nbytes
                          for a in jax.tree_util.tree_leaves(host)))
         with self._lock:
@@ -776,6 +856,8 @@ class BatchingEngine:
         self.throughput.update(n)
         for i, req in enumerate(requests):
             self.latency.record(t_done - req.enqueued_at)
+            if req.span is not None:
+                req.span.mark("retry_exec")
             if not req.future.done():
                 req.future.set_result(
                     jax.tree_util.tree_map(lambda a: np.asarray(a)[i],
@@ -821,8 +903,13 @@ class BatchingEngine:
             self.health.force_dead(
                 f"{which} died and the restart budget "
                 f"({self.restart_budget}) is exhausted")
+            event(_log, "engine_dead", model=self.model.name, which=which,
+                  restart_budget=self.restart_budget)
             return
         self.health.record_restart()
+        event(_log, "watchdog_restart", model=self.model.name, which=which,
+              restarts=self.health.watchdog_restarts,
+              budget=self.restart_budget)
         thread = threading.Thread(
             target=self._loop if which == "batcher" else self._drain_loop,
             name=f"{which}-{self.model.name}", daemon=True)
@@ -846,12 +933,19 @@ class BatchingEngine:
         if not recs:
             return
         self.health.record_failure()
+        event(_log, "exec_timeout", model=self.model.name,
+              age_ms=round(age_s * 1e3, 1), limit_ms=round(limit_s * 1e3, 1),
+              windows=len(recs))
         err = TimeoutError(
             f"in-flight batch exceeded exec timeout: age {age_s * 1e3:.0f}"
             f"ms > limit {limit_s * 1e3:.0f}ms; failing the window fast")
         for rec in recs:
             if rec.cancel is not None:
                 rec.cancel.set()
+            for r in rec.requests:
+                if r.span is not None and not r.future.done():
+                    r.span.note("exec_timeout",
+                                f"age {age_s * 1e3:.0f}ms")
             pending = [r for r in rec.requests if not r.future.done()]
             if pending and self._rescue is not None:
                 # replica mode: offer the cohort to a healthy replica
@@ -934,7 +1028,13 @@ class BatchingEngine:
                            if span and span > 0 else None)}}
         out["pipeline"]["staging"] = self.staging.stats()
         out["latency"] = self.latency.percentiles()
+        # full histogram state rides along so upstream aggregators (the
+        # gateway) can LatencyHistogram.merge real distributions instead
+        # of eyeballing per-backend percentiles
+        out["latency_hist"] = self.latency.state_dict()
         out["img_per_sec"] = self.throughput.images_per_sec
         out["admission"] = self.admission.stats()
         out["health"] = self.health_report()
+        out["mfu"] = self.mfu.report()
+        out["trace"] = self.tracer.summary()
         return out

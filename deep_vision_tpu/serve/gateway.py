@@ -45,7 +45,15 @@ survives any single backend dying:
                   the loser's response is discarded.
 
 ``GET /v1/stats`` aggregates every backend's own stats under the
-gateway's counters (retries, failovers, hedges, breaker transitions);
+gateway's counters (retries, failovers, hedges, breaker transitions),
+plus the fleet-level latency DISTRIBUTION (per-backend histogram
+states merged bin-wise — a true fleet p99, not an average of p99s) and
+the aggregate serving MFU; ``GET /metrics`` renders the same as
+Prometheus text; ``GET /v1/traces`` exposes the gateway's trace ring.
+Every proxied request carries an ``X-DVT-Request-Id`` header to the
+backend (client-provided or minted here) so one id names the whole
+gateway→backend→engine path — ``?debug=1`` responses carry both the
+backend's ``trace`` and the gateway-side ``gateway_trace`` breakdown.
 ``GET /v1/healthz`` answers 200 while ANY backend is routable.  Entry
 point: ``python -m deep_vision_tpu.cli.gateway``; chaos suite:
 ``tests/test_gateway.py`` (marker ``gateway``); end-to-end smoke with a
@@ -63,9 +71,19 @@ import time
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from http.client import HTTPConnection, HTTPException
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 from deep_vision_tpu.core.metrics import LatencyHistogram
+from deep_vision_tpu.obs.log import event, get_logger
+from deep_vision_tpu.obs.mfu import round_mfu
+from deep_vision_tpu.obs.trace import (
+    REQUEST_ID_HEADER,
+    Tracer,
+    new_request_id,
+)
 from deep_vision_tpu.serve.health import DEAD, DEGRADED, OK
+
+_log = get_logger("dvt.serve.gateway")
 
 CLOSED = "closed"
 OPEN = "open"
@@ -155,16 +173,22 @@ class Backend:
         self.consecutive_failures += 1
         self.failures += 1
         self.last_error = err
+        opened = False
         if self.breaker == HALF_OPEN:
             # the trial failed: re-open with a fresh cooldown
             self.breaker = OPEN
             self.opened_at = now
             self.breaker_opens += 1
+            opened = True
         elif self.breaker == CLOSED and \
                 self.consecutive_failures >= self.breaker_threshold:
             self.breaker = OPEN
             self.opened_at = now
             self.breaker_opens += 1
+            opened = True
+        if opened:
+            event(_log, "breaker_open", backend=self.name, error=err,
+                  consecutive_failures=self.consecutive_failures)
         if self.consecutive_failures >= self.dead_after:
             self.state = DEAD
         elif self.consecutive_failures >= self.degraded_after:
@@ -175,6 +199,7 @@ class Backend:
         if self.breaker != CLOSED:
             self.breaker = CLOSED
             self.breaker_closes += 1
+            event(_log, "breaker_close", backend=self.name)
         self._trial_inflight = False
         self.state = OK
 
@@ -290,7 +315,8 @@ class Gateway:
                  degraded_after: int = 1, dead_after: int = 5,
                  hedge: bool = False,
                  hedge_after_ms: float | None = None,
-                 hedge_min_history: int = 32):
+                 hedge_min_history: int = 32,
+                 tracer: Tracer | None = None):
         if not backends:
             raise ValueError("gateway needs at least one backend")
         self.backends = [Backend(u, breaker_threshold=breaker_threshold,
@@ -310,6 +336,7 @@ class Gateway:
         self.hedge = hedge
         self.hedge_after_ms = hedge_after_ms
         self.hedge_min_history = hedge_min_history
+        self.tracer = tracer or Tracer()
         self.latency = LatencyHistogram()
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -380,10 +407,47 @@ class Gateway:
 
     # -- request path ------------------------------------------------------
 
-    def forward(self, path: str, body: bytes
+    def forward(self, path: str, body: bytes,
+                request_id: str | None = None
                 ) -> tuple[int, dict, bytes]:
         """Proxy one inference request: route, retry, fail over, hedge.
-        Returns ``(status, headers, payload)`` for the client."""
+        Returns ``(status, headers, payload)`` for the client.  The
+        request id (client-provided or minted here) rides the
+        ``X-DVT-Request-Id`` header to the backend and back, so one id
+        names the whole gateway→backend→engine path; ``?debug=1``
+        responses additionally carry the gateway-side span as
+        ``gateway_trace`` next to the backend's ``trace``."""
+        rid = request_id or new_request_id()
+        span = self.tracer.start(rid, origin="recv")
+        try:
+            status, headers, payload = self._forward(path, body, rid,
+                                                     span)
+            if span is not None:
+                span.mark("respond")
+                if status == 200 and self._debug_requested(path):
+                    payload = self._attach_gateway_trace(payload, span)
+            headers = dict(headers)
+            headers[REQUEST_ID_HEADER] = rid
+            return status, headers, payload
+        finally:
+            self.tracer.finish(span)
+
+    @staticmethod
+    def _debug_requested(path: str) -> bool:
+        q = path.partition("?")[2]
+        return parse_qs(q).get("debug", ["0"])[0] not in ("", "0")
+
+    @staticmethod
+    def _attach_gateway_trace(payload: bytes, span) -> bytes:
+        try:
+            doc = json.loads(payload)
+            doc["gateway_trace"] = span.to_dict()
+            return json.dumps(doc).encode()
+        except (ValueError, TypeError):
+            return payload  # not JSON: leave the body alone
+
+    def _forward(self, path: str, body: bytes, rid: str, span
+                 ) -> tuple[int, dict, bytes]:
         t0 = time.monotonic()
         with self._lock:
             self.proxied += 1
@@ -406,13 +470,23 @@ class Gateway:
                     self.retries += 1
                     if prev is not None and b is not prev:
                         self.failovers += 1
+                if span is not None:
+                    span.note("failover" if b is not prev else "retry",
+                              b.name)
                 if last_shed is None or b is prev:
                     # backoff applies to failures and same-backend
                     # retries; failing a 429 over to a DIFFERENT
                     # backend goes immediately
                     self._backoff(attempt)
             prev = b
-            out = self._attempt(b, path, body, allow_hedge=attempt == 0)
+            if span is not None:
+                span.note("attempt", b.name)
+            out = self._attempt(b, path, body, allow_hedge=attempt == 0,
+                                rid=rid, span=span)
+            if span is not None:
+                # one backend_hop segment per attempt (accumulates):
+                # the span's proxy-side time is attempts + respond
+                span.mark("backend_hop")
             if out.kind == "ok":
                 with self._lock:  # histogram increments aren't atomic
                     self.latency.record(time.monotonic() - t0)
@@ -422,6 +496,8 @@ class Gateway:
                 tried.append(out.hedge_backend)
             if out.kind == "shed":
                 last_shed = out
+                if span is not None:
+                    span.note("shed", out.backend.name)
                 if self._pick(tried) is None:
                     break  # nobody with headroom: propagate the 429
             else:
@@ -482,12 +558,13 @@ class Gateway:
     # -- single attempt + hedging ------------------------------------------
 
     def _attempt(self, b: Backend, path: str, body: bytes,
-                 allow_hedge: bool) -> _Outcome:
+                 allow_hedge: bool, rid: str | None = None,
+                 span=None) -> _Outcome:
         delay_s = self._hedge_delay_s() if allow_hedge else None
         if delay_s is None:
-            return self._single(b, path, body)
+            return self._single(b, path, body, rid)
         pool = self._hedge_pool()
-        primary = pool.submit(self._single, b, path, body)
+        primary = pool.submit(self._single, b, path, body, rid)
         done, _ = wait([primary], timeout=delay_s)
         if done:
             return primary.result()
@@ -496,7 +573,11 @@ class Gateway:
             return primary.result()  # nobody to hedge to: just wait
         with self._lock:
             self.hedges += 1
-        hedge = pool.submit(self._single, b2, path, body)
+        if span is not None:
+            # noted from the forwarding thread only — the pool workers
+            # never touch the span (single-writer ownership rule)
+            span.note("hedge", b2.name)
+        hedge = pool.submit(self._single, b2, path, body, rid)
         pending = {primary, hedge}
         while pending:
             done, pending = wait(pending, return_when=FIRST_COMPLETED)
@@ -508,6 +589,8 @@ class Gateway:
                     if f is hedge:
                         with self._lock:
                             self.hedge_wins += 1
+                        if span is not None:
+                            span.note("hedge_win", b2.name)
                     return out
         out = primary.result()
         if out.kind == "ok":  # pending-set raced: prefer any success
@@ -535,12 +618,14 @@ class Gateway:
                     thread_name_prefix="gateway-hedge")
             return self._pool
 
-    def _single(self, b: Backend, path: str, body: bytes) -> _Outcome:
+    def _single(self, b: Backend, path: str, body: bytes,
+                rid: str | None = None) -> _Outcome:
         b.begin()
         t0 = time.monotonic()
         try:
             status, headers, payload = self._call(
-                b, "POST", path, body, self.request_timeout_s)
+                b, "POST", path, body, self.request_timeout_s,
+                extra_headers={REQUEST_ID_HEADER: rid} if rid else None)
         except (OSError, HTTPException) as e:
             err = f"{b.name}: {type(e).__name__}: {e}"
             b.done_failure(err)
@@ -557,7 +642,8 @@ class Gateway:
 
     @staticmethod
     def _call(b: Backend, method: str, path: str, body: bytes | None,
-              timeout: float) -> tuple[int, dict, bytes]:
+              timeout: float, extra_headers: dict | None = None
+              ) -> tuple[int, dict, bytes]:
         """One HTTP exchange with a backend.  A fresh connection per
         call: the failure modes we must detect (SIGKILL'd process, TCP
         reset) surface as plain connect/read errors, never as a stale
@@ -566,6 +652,8 @@ class Gateway:
         try:
             headers = {"Content-Type": "application/json"} if body \
                 else {}
+            if extra_headers:
+                headers.update(extra_headers)
             conn.request(method, path, body=body, headers=headers)
             resp = conn.getresponse()
             return resp.status, dict(resp.getheaders()), resp.read()
@@ -602,8 +690,13 @@ class Gateway:
 
     def stats(self, include_backend_stats: bool = True) -> dict:
         now = time.monotonic()
+        with self._lock:
+            gw_latency = self.latency.percentiles()
+            gw_hist = self.latency.state_dict()
         out = {"gateway": {**self.counters(),
-                           "latency": self.latency.percentiles(),
+                           "latency": gw_latency,
+                           "latency_hist": gw_hist,
+                           "trace": self.tracer.summary(),
                            "backends": {b.name: b.report(now)
                                         for b in self.backends}}}
         if include_backend_stats:
@@ -618,11 +711,139 @@ class Gateway:
                 except (OSError, HTTPException, ValueError) as e:
                     agg[b.name] = {"error": f"{type(e).__name__}: {e}"}
             out["backends"] = agg
+            merged, mfu = self._aggregate_backends(agg)
+            # fleet-level latency DISTRIBUTION: per-backend histogram
+            # states sum bin-wise (identical fixed edges), so the p99
+            # here is the true fleet p99 — not an average of per-backend
+            # p99s, which has no meaning
+            out["gateway"]["backend_latency"] = \
+                merged.percentiles() if merged is not None else None
+            out["gateway"]["backend_latency_hist"] = \
+                merged.state_dict() if merged is not None else None
+            out["gateway"]["mfu"] = mfu
         return out
+
+    @staticmethod
+    def _aggregate_backends(agg: dict):
+        """Fold fetched backend /v1/stats into fleet-level views: one
+        merged ``LatencyHistogram`` and one MFU report (FLOPs and
+        compute seconds sum across backends, MFU recomputes from the
+        sums — a throughput-weighted aggregate by construction)."""
+        merged: LatencyHistogram | None = None
+        flops = secs = 0.0
+        batches = images = 0
+        peak = None
+        source = None
+        for bstats in agg.values():
+            if not isinstance(bstats, dict) or "error" in bstats:
+                continue
+            for mstats in bstats.values():
+                if not isinstance(mstats, dict):
+                    continue
+                hist = mstats.get("latency_hist")
+                if hist:
+                    try:
+                        if merged is None:
+                            merged = LatencyHistogram()
+                            merged.load_state_dict(hist)
+                        else:
+                            merged.merge(hist)
+                    except (KeyError, ValueError, TypeError):
+                        pass  # malformed or mismatched bins: skip
+                m = mstats.get("mfu") or {}
+                flops += float(m.get("flops_total") or 0.0)
+                secs += float(m.get("compute_s") or 0.0)
+                batches += int(m.get("batches") or 0)
+                images += int(m.get("images") or 0)
+                if peak is None:
+                    peak = m.get("peak_flops_per_s")
+                if source is None:
+                    source = m.get("flops_source")
+        mfu_val = flops / secs / peak \
+            if secs > 0 and flops > 0 and peak else None
+        mfu = {"serving_mfu": round_mfu(mfu_val),
+               "flops_total": flops, "compute_s": round(secs, 6),
+               "batches": batches, "images": images,
+               "peak_flops_per_s": peak, "flops_source": source}
+        return merged, mfu
+
+
+def render_gateway_metrics(gw: Gateway) -> str:
+    """Prometheus text for ``GET /metrics`` on the gateway: its own
+    counters + per-backend breaker/load gauges + its request-latency
+    histogram, plus the fleet aggregates (merged backend latency
+    distribution and ``dvt_gateway_serving_mfu``) fetched from backend
+    /v1/stats — one scrape sees the whole serving tier."""
+    from deep_vision_tpu.core.metrics import PromText
+
+    s = gw.stats()
+    g = s["gateway"]
+    p = PromText()
+    p.counter("dvt_gateway_proxied_total", g["proxied"],
+              help="Inference requests entering forward()")
+    p.counter("dvt_gateway_retries_total", g["retries"],
+              help="Attempts beyond each request's first")
+    p.counter("dvt_gateway_failovers_total", g["failovers"],
+              help="Retries that moved to a different backend")
+    p.counter("dvt_gateway_hedges_total", g["hedges"],
+              help="Tail-hedge duplicates issued")
+    p.counter("dvt_gateway_hedge_wins_total", g["hedge_wins"],
+              help="Hedged duplicates that answered first")
+    p.counter("dvt_gateway_exhausted_total", g["exhausted"],
+              help="Requests that failed every attempt")
+    p.counter("dvt_gateway_no_backend_total", g["no_backend"],
+              help="Requests with no routable backend at all")
+    p.gauge("dvt_gateway_routable_backends",
+            len(gw.routable_backends()),
+            help="Backends currently accepting routed traffic")
+    for b in gw.backends:
+        r = b.report()
+        lab = {"backend": b.name}
+        p.gauge("dvt_gateway_backend_up",
+                1 if r["breaker"] == CLOSED and not r["unavailable"]
+                else 0, lab,
+                help="1 while breaker-closed and not draining")
+        p.gauge("dvt_gateway_backend_breaker_state",
+                {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}[r["breaker"]], lab,
+                help="0 closed, 1 half-open, 2 open")
+        p.counter("dvt_gateway_backend_successes_total",
+                  r["successes"], lab)
+        p.counter("dvt_gateway_backend_failures_total",
+                  r["failures"], lab)
+        p.counter("dvt_gateway_backend_sheds_total", r["sheds"], lab)
+        p.counter("dvt_gateway_backend_breaker_opens_total",
+                  r["breaker_opens"], lab)
+        p.gauge("dvt_gateway_backend_outstanding", r["outstanding"],
+                lab, help="Requests in flight to this backend")
+        p.gauge("dvt_gateway_backend_ewma_seconds",
+                r["ewma_ms"] / 1e3 if r["ewma_ms"] is not None
+                else None, lab, help="Per-backend latency EWMA")
+    p.histogram("dvt_gateway_request_latency_seconds",
+                g["latency_hist"],
+                help="Gateway-side forward() latency (incl. retries)")
+    if g.get("backend_latency_hist"):
+        p.histogram("dvt_gateway_backend_latency_seconds",
+                    g["backend_latency_hist"],
+                    help="Backend engine latency merged fleet-wide")
+    mfu = g.get("mfu") or {}
+    p.gauge("dvt_gateway_serving_mfu", mfu.get("serving_mfu"),
+            help="Fleet serving MFU (summed FLOPs / summed compute "
+                 "seconds / peak)")
+    tr = g.get("trace") or {}
+    p.counter("dvt_gateway_traces_finished_total", tr.get("finished"),
+              help="Gateway spans sealed into the ring")
+    p.counter("dvt_gateway_slow_traces_total", tr.get("slow_sampled"),
+              help="Gateway traces over the slow threshold")
+    for stage, secs in (tr.get("stage_s_total") or {}).items():
+        p.counter("dvt_gateway_stage_seconds_total", secs,
+                  {"stage": stage},
+                  help="Cumulative gateway span stage time")
+    return p.render()
 
 
 class _GatewayHandler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    _rid = None
 
     def setup(self):
         # per-connection socket timeout (StreamRequestHandler applies
@@ -644,6 +865,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         self.send_response(status)
         headers = dict(headers or {})
         headers.setdefault("Content-Type", "application/json")
+        if self._rid is not None:
+            headers.setdefault(REQUEST_ID_HEADER, self._rid)
         for k, v in headers.items():
             self.send_header(k, str(v))
         self.send_header("Content-Length", str(len(blob)))
@@ -652,18 +875,34 @@ class _GatewayHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         gw: Gateway = self.server.gateway  # type: ignore[attr-defined]
-        if self.path == "/v1/healthz":
+        path, _, query = self.path.partition("?")
+        if path == "/v1/healthz":
             ok, payload = gw.healthz()
             self._reply(200 if ok else 503, payload)
-        elif self.path == "/v1/stats":
+        elif path == "/v1/stats":
             self._reply(200, gw.stats())
+        elif path == "/metrics":
+            self._reply_raw(
+                200, render_gateway_metrics(gw).encode(),
+                {"Content-Type":
+                 "text/plain; version=0.0.4; charset=utf-8"})
+        elif path == "/v1/traces":
+            n = int(parse_qs(query).get("n", ["32"])[0])
+            self._reply(200, {"traces": gw.tracer.recent(n),
+                              "summary": gw.tracer.summary()})
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):
         gw: Gateway = self.server.gateway  # type: ignore[attr-defined]
+        path = self.path.partition("?")[0]
+        # one id for the whole path: reuse the client's if it sent one,
+        # mint otherwise; forward() sends it to the backend and its
+        # reply echo lands on our response via _reply_raw
+        self._rid = self.headers.get(REQUEST_ID_HEADER) \
+            or new_request_id()
         try:
-            if self.path not in ("/v1/classify", "/v1/detect"):
+            if path not in ("/v1/classify", "/v1/detect"):
                 self._reply(404, {"error": f"no route {self.path}"})
                 return
             length = int(self.headers.get("Content-Length") or 0)
@@ -677,7 +916,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                                            f"exceeds the {cap}-byte cap"})
                 return
             body = self.rfile.read(length)
-            status, headers, payload = gw.forward(self.path, body)
+            status, headers, payload = gw.forward(self.path, body,
+                                                  request_id=self._rid)
             self._reply_raw(status, payload, headers)
         except TimeoutError:
             # client stalled mid-body: answer 408 and drop the
@@ -686,6 +926,8 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             self._reply(408, {"error": "timed out reading request body"})
         except Exception as e:  # noqa: BLE001 — surface, don't kill worker
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        finally:
+            self._rid = None
 
 
 class GatewayServer:

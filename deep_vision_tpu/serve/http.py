@@ -11,9 +11,18 @@ Routes (JSON in, JSON out):
                        drain traffic, 200 again after recovery
     GET  /v1/stats     per-model engine stats (latency p50/p95/p99,
                        throughput, shed counts, compile/bucket state,
-                       the pipelined executor's overlap block, and the
+                       the pipelined executor's overlap block, the
                        ``health`` block: state, failures, retries,
-                       quarantines, watchdog restarts)
+                       quarantines, watchdog restarts — plus the
+                       ``mfu`` and ``trace`` observability blocks)
+    GET  /metrics      Prometheus text exposition (format 0.0.4) of the
+                       same stats: dvt_serve_* counters/gauges, the
+                       request-latency histogram as cumulative ``le``
+                       buckets, and the ``dvt_serve_mfu`` gauge
+                       (docs/OBSERVABILITY.md has the full name table)
+    GET  /v1/traces    recent finished request traces from the bounded
+                       in-memory ring (``?n=`` caps the count) plus the
+                       tracer summary (per-stage time aggregates)
     POST /v1/classify  {"pixels": [[...]] | "image_b64": "...",
                         "model"?, "deadline_ms"?, "top_k"?}
     POST /v1/detect    same inputs + "score_threshold"?; YOLO models
@@ -24,6 +33,13 @@ Routes (JSON in, JSON out):
                        in-flight work via ``stop(drain_deadline=)``
                        (body: {"drain_deadline_s"?: float, default 10})
                        before the 200 reply — no admitted request fails
+
+Request tracing: every POST carries a request id — the client's
+``X-DVT-Request-Id`` header if present (the gateway forwards its own),
+else generated here — echoed on the response and stamped on the
+request's span.  ``?debug=1`` on classify/detect adds the span's
+per-stage timing breakdown to the response body; the same traces land
+in the in-memory ring behind ``GET /v1/traces``.
 
 Image payloads: ``pixels`` is an (H, W, C) array in the model's WIRE
 dtype — raw 0–255 integers on the uint8 wire (the ``cli.serve``
@@ -52,6 +68,9 @@ import json
 import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
+
+from deep_vision_tpu.obs.trace import REQUEST_ID_HEADER, new_request_id
 
 DEFAULT_MAX_BODY_BYTES = 32 * 2**20
 
@@ -137,8 +156,102 @@ def _decode_pixels(body: dict, model):
     raise ServeError(400, "body needs 'pixels' or 'image_b64'")
 
 
+def render_serve_metrics(stats: dict) -> str:
+    """Render per-model ``engine.stats()`` dicts as Prometheus text.
+
+    No parallel metric registry: the stats dicts stay the single source
+    of truth and this snapshots them through ``core.metrics.PromText``
+    (docs/OBSERVABILITY.md tabulates every name emitted here).
+    """
+    from deep_vision_tpu.core.metrics import PromText
+
+    p = PromText()
+    for name, s in stats.items():
+        lab = {"model": name}
+        p.counter("dvt_serve_requests_submitted_total", s["submitted"],
+                  lab, help="Requests entering submit (incl. shed)")
+        p.counter("dvt_serve_requests_served_total", s["served"], lab,
+                  help="Requests served a model output")
+        p.counter("dvt_serve_batches_total", s["batches"], lab,
+                  help="Executed batches (incl. retry executions)")
+        p.counter("dvt_serve_compiles_total", s["compiles"], lab,
+                  help="Bucket program compiles")
+        p.counter("dvt_serve_padded_images_total", s["padded_images"],
+                  lab, help="Pad rows executed beyond live requests")
+        p.gauge("dvt_serve_queue_depth", s["queue_depth"], lab,
+                help="Requests queued awaiting batch formation")
+        adm = s.get("admission", {})
+        h = s.get("health", {})
+        p.counter("dvt_serve_shed_total", adm.get("shed_queue_full"),
+                  {**lab, "reason": "queue_full"},
+                  help="Requests shed at admission or formation")
+        p.counter("dvt_serve_shed_total", adm.get("shed_deadline"),
+                  {**lab, "reason": "deadline"})
+        p.counter("dvt_serve_shed_total", h.get("shed_shutdown"),
+                  {**lab, "reason": "shutdown"})
+        p.counter("dvt_serve_batch_failures_total",
+                  h.get("batch_failures"), lab,
+                  help="Dispatched/drained cohorts that raised")
+        p.counter("dvt_serve_retry_executions_total",
+                  h.get("retry_executions"), lab,
+                  help="Bisect-retry sub-cohort executions")
+        p.counter("dvt_serve_quarantined_total", h.get("quarantined"),
+                  lab, help="Requests isolated as poison")
+        p.counter("dvt_serve_exec_timeouts_total",
+                  h.get("exec_timeouts"), lab,
+                  help="In-flight windows fast-failed by the watchdog")
+        p.counter("dvt_serve_watchdog_restarts_total",
+                  h.get("watchdog_restarts"), lab,
+                  help="Worker-thread restarts by supervision")
+        p.gauge("dvt_serve_up",
+                1 if h.get("can_serve") else 0, lab,
+                help="1 while this engine can serve (healthz 200)")
+        pipe = s.get("pipeline", {})
+        p.gauge("dvt_serve_inflight", pipe.get("inflight"), lab,
+                help="Dispatched-but-undrained batches")
+        p.counter("dvt_serve_h2d_transfers_total",
+                  pipe.get("h2d_transfers"), lab,
+                  help="Staged-batch host-to-device transfers")
+        p.counter("dvt_serve_h2d_bytes_total", pipe.get("h2d_bytes"),
+                  lab, help="Wire-format bytes shipped to the device")
+        for b, ms in (adm.get("exec_ewma_ms_by_bucket") or {}).items():
+            p.gauge("dvt_serve_exec_ewma_seconds", ms / 1e3,
+                    {**lab, "bucket": b},
+                    help="Per-bucket batch execution EWMA")
+        p.gauge("dvt_serve_img_per_sec", s.get("img_per_sec"), lab,
+                help="Served images per second (post-warmup)")
+        if "latency_hist" in s:
+            p.histogram("dvt_serve_request_latency_seconds",
+                        s["latency_hist"], lab,
+                        help="Submit-to-result latency")
+        mfu = s.get("mfu") or {}
+        p.gauge("dvt_serve_mfu", mfu.get("serving_mfu"), lab,
+                help="Model FLOPs utilization of the compute stage "
+                     "(analytic FLOPs / measured compute time / peak)")
+        p.counter("dvt_serve_compute_seconds_total",
+                  mfu.get("compute_s"), lab,
+                  help="Measured device-occupancy seconds")
+        p.counter("dvt_serve_flops_total", mfu.get("flops_total"), lab,
+                  help="Analytic FLOPs executed")
+        tr = s.get("trace") or {}
+        p.counter("dvt_serve_traces_started_total", tr.get("started"),
+                  lab, help="Spans started")
+        p.counter("dvt_serve_traces_finished_total", tr.get("finished"),
+                  lab, help="Spans sealed into the ring")
+        p.counter("dvt_serve_slow_traces_total", tr.get("slow_sampled"),
+                  lab, help="Traces over the slow-request threshold")
+        for stage, secs in (tr.get("stage_s_total") or {}).items():
+            p.counter("dvt_serve_stage_seconds_total", secs,
+                      {**lab, "stage": stage},
+                      help="Cumulative per-stage span time")
+    return p.render()
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # per-request trace state (set at the top of do_POST)
+    _rid = None
+    _span = None
 
     # -- plumbing ----------------------------------------------------------
 
@@ -157,9 +270,15 @@ class _Handler(BaseHTTPRequestHandler):
     def _reply(self, status: int, payload: dict,
                headers: dict | None = None):
         blob = json.dumps(payload).encode()
+        self._reply_raw(status, blob, "application/json", headers)
+
+    def _reply_raw(self, status: int, blob: bytes, ctype: str,
+                   headers: dict | None = None):
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(blob)))
+        if self._rid is not None:
+            self.send_header(REQUEST_ID_HEADER, self._rid)
         for k, v in (headers or {}).items():
             self.send_header(k, str(v))
         self.end_headers()
@@ -195,7 +314,10 @@ class _Handler(BaseHTTPRequestHandler):
         if engine.faults.enabled:
             engine.faults.inject("decode")
         x = _decode_pixels(body, model)
-        result = engine.infer(x, deadline_ms=body.get("deadline_ms"))
+        if self._span is not None:
+            self._span.mark("decode")
+        result = engine.infer(x, deadline_ms=body.get("deadline_ms"),
+                              span=self._span)
         from deep_vision_tpu.serve.admission import Shed
         from deep_vision_tpu.serve.faults import Quarantined
 
@@ -214,7 +336,8 @@ class _Handler(BaseHTTPRequestHandler):
     # -- routes ------------------------------------------------------------
 
     def do_GET(self):
-        if self.path == "/v1/healthz":
+        path, _, query = self.path.partition("?")
+        if path == "/v1/healthz":
             engines = self.server.engines
             if getattr(self.server, "draining", False):
                 # draining outranks engine health: traffic must move
@@ -233,24 +356,54 @@ class _Handler(BaseHTTPRequestHandler):
                         {"status": "ok" if healthy else "unhealthy",
                          "models": self.server.registry.names(),
                          "engines": reports})
-        elif self.path == "/v1/stats":
+        elif path == "/v1/stats":
             self._reply(200, {name: eng.stats()
                               for name, eng in self.server.engines.items()})
+        elif path == "/metrics":
+            text = render_serve_metrics(
+                {name: eng.stats()
+                 for name, eng in self.server.engines.items()})
+            self._reply_raw(
+                200, text.encode(),
+                "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/v1/traces":
+            params = parse_qs(query)
+            n = int(params.get("n", ["32"])[0])
+            tracer = getattr(self.server, "tracer", None)
+            self._reply(200, {
+                "traces": tracer.recent(n) if tracer is not None else [],
+                "summary": tracer.summary() if tracer is not None
+                else None})
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):
+        path, _, query = self.path.partition("?")
+        debug = parse_qs(query).get("debug", ["0"])[0] not in ("", "0")
+        # request id: the edge's header wins (a gateway hop forwards its
+        # own, keeping one id across the whole path); else minted here
+        self._rid = self.headers.get(REQUEST_ID_HEADER) \
+            or new_request_id()
+        tracer = getattr(self.server, "tracer", None)
+        span = self._span = tracer.start(self._rid, origin="recv") \
+            if tracer is not None else None
         try:
-            if self.path == "/v1/drain":
+            if path == "/v1/drain":
                 self._reply(200, self._drain())
                 return
             body = self._body()
-            if self.path == "/v1/classify":
-                self._reply(200, self._classify(body))
-            elif self.path == "/v1/detect":
-                self._reply(200, self._detect(body))
+            if path == "/v1/classify":
+                payload = self._classify(body)
+            elif path == "/v1/detect":
+                payload = self._detect(body)
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
+                return
+            if span is not None:
+                span.mark("respond")
+                if debug:
+                    payload["trace"] = span.to_dict()
+            self._reply(200, payload)
         except ServeError as e:
             self._reply(e.status, {"error": str(e)}, headers=e.headers)
         except TimeoutError:
@@ -260,6 +413,13 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(408, {"error": "timed out reading request body"})
         except Exception as e:  # noqa: BLE001 — surface, don't kill worker
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
+        finally:
+            # this handler created the span, so it seals it — error
+            # paths included (finish is idempotent and never raises)
+            if tracer is not None:
+                tracer.finish(span)
+            self._span = None
+            self._rid = None
 
     def _drain(self) -> dict:
         """Flip healthz to draining, then finish admitted work.
@@ -326,7 +486,8 @@ class ServeServer:
     def __init__(self, registry, engines: dict, host: str = "127.0.0.1",
                  port: int = 0, verbose: bool = False,
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
-                 socket_timeout_s: float | None = 30.0):
+                 socket_timeout_s: float | None = 30.0,
+                 tracer=None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.registry = registry
         self.httpd.engines = engines
@@ -335,6 +496,14 @@ class ServeServer:
         self.httpd.socket_timeout_s = socket_timeout_s
         self.httpd.draining = False
         self.httpd.drain_lock = threading.Lock()
+        if tracer is None:
+            # share the first engine's tracer so handler-created spans
+            # land in the same ring /v1/traces reads
+            for eng in engines.values():
+                tracer = getattr(eng, "tracer", None)
+                if tracer is not None:
+                    break
+        self.httpd.tracer = tracer
         self._thread: threading.Thread | None = None
 
     @property

@@ -278,6 +278,23 @@ class CheckpointServingModel(ServingModel):
                     message="Some donated buffers were not usable")
                 return compiled(variables, x)
 
+        # analytic FLOPs ride on the callable for the engine's
+        # serving-MFU meter: XLA's own cost analysis on the AOT
+        # executable, or the documented 2·params·batch lower bound when
+        # the backend doesn't report flops (obs/mfu.py)
+        from deep_vision_tpu.obs.mfu import (
+            compiled_flops,
+            params_flops_lower_bound,
+        )
+
+        flops = compiled_flops(compiled)
+        if flops is not None:
+            call.cost_flops = flops
+            call.flops_source = "xla_cost_analysis"
+        else:
+            call.cost_flops = params_flops_lower_bound(
+                self._variables, batch)
+            call.flops_source = "params_lower_bound"
         return call
 
 
@@ -323,6 +340,12 @@ class ExportedServingModel(ServingModel):
                 raise self._unavailable(x.shape[0])
             return call(variables, x)
 
+        # a deserialized blob exposes no compiled executable to cost-
+        # analyze, so the MFU numerator uses the documented fallback
+        from deep_vision_tpu.obs.mfu import params_flops_lower_bound
+
+        run.cost_flops = params_flops_lower_bound(variables, batch)
+        run.flops_source = "params_lower_bound"
         return run
 
 

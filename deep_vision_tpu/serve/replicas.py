@@ -58,10 +58,15 @@ from concurrent.futures import Future
 import numpy as np
 
 from deep_vision_tpu.core.metrics import LatencyHistogram
+from deep_vision_tpu.obs.log import event, get_logger
+from deep_vision_tpu.obs.mfu import MfuMeter
+from deep_vision_tpu.obs.trace import Tracer
 from deep_vision_tpu.serve.admission import AdmissionController, Shed
 from deep_vision_tpu.serve.engine import BatchingEngine, _Request
 from deep_vision_tpu.serve.faults import FaultPlane, KillThread
 from deep_vision_tpu.serve.health import DEAD, OK, EngineHealth
+
+_log = get_logger("dvt.serve.replicas")
 
 
 def local_devices(limit: int | None = None) -> list:
@@ -101,6 +106,7 @@ class ReplicatedEngine:
                  faults: FaultPlane | None = None,
                  watchdog_interval_s: float = 0.05,
                  restart_budget: int = 3,
+                 tracer: Tracer | None = None,
                  **engine_kwargs):
         self.devices = list(devices) if devices is not None \
             else local_devices()
@@ -119,6 +125,10 @@ class ReplicatedEngine:
         # the ROUTER's own health (each replica owns its machine); its
         # heartbeats/restarts feed the aggregate health_report
         self.health = EngineHealth()
+        # one tracer (one ring, one slow sampler) for the whole fleet —
+        # a request's span crosses replica boundaries on rescue, so the
+        # trace state must not be per-replica
+        self.tracer = tracer or Tracer()
         self.replicas: list[BatchingEngine] = []
         for i, dev in enumerate(self.devices):
             view = model.for_device(dev) if hasattr(model, "for_device") \
@@ -132,6 +142,7 @@ class ReplicatedEngine:
                 external_batcher=True,
                 rescue=(lambda pending, err, _i=i:
                         self._rescue_from(_i, pending, err)),
+                tracer=self.tracer,
                 **engine_kwargs))
         self.buckets = self.replicas[0].buckets
         self.max_batch = self.replicas[0].max_batch
@@ -224,12 +235,22 @@ class ReplicatedEngine:
     def total_inflight(self) -> int:
         return sum(r._inflight + r._forming for r in self.replicas)
 
-    def submit(self, image, deadline_ms: float | None = None) -> Future:
+    def submit(self, image, deadline_ms: float | None = None,
+               span=None) -> Future:
         fut: Future = Future()
+        # same ownership contract as BatchingEngine.submit: borrowed
+        # spans are marked here, engine-created spans self-seal via the
+        # future's done-callback
+        if span is None and self.tracer.enabled:
+            span = self.tracer.start()
+            fut.add_done_callback(
+                lambda _f, _s=span: self.tracer.finish(_s))
         if not self._accepting:
             with self._lock:
                 self.submitted += 1
                 self.shed_shutdown += 1
+            if span is not None:
+                span.note("shed", "shutdown")
             fut.set_result(Shed(
                 "shutdown", "engine is not accepting requests "
                             "(stopped or not started)"))
@@ -246,16 +267,20 @@ class ReplicatedEngine:
                 min(depth + 1, self.max_batch)),
             inflight=self.total_inflight())
         if shed is not None:
+            if span is not None:
+                span.note("shed", shed.reason)
             fut.set_result(shed)
             return fut
         poison = self.faults.mark_poison() if self.faults.enabled else False
+        if span is not None:
+            span.mark("admit")
         self._queue.put(_Request(np.asarray(image, self.wire_dtype),
-                                 deadline, now, fut, poison))
+                                 deadline, now, fut, poison, span))
         return fut
 
     def infer(self, image, deadline_ms: float | None = None,
-              timeout: float | None = 30.0):
-        return self.submit(image, deadline_ms).result(timeout)
+              timeout: float | None = 30.0, span=None):
+        return self.submit(image, deadline_ms, span=span).result(timeout)
 
     # -- shared batcher + router -------------------------------------------
 
@@ -273,6 +298,8 @@ class ReplicatedEngine:
                     first = self._queue.get(timeout=0.05)
                 except queue.Empty:
                     continue
+                if first.span is not None:
+                    first.span.mark("queue_wait")
                 self._forming = 1
                 try:
                     batch = [first]
@@ -282,10 +309,12 @@ class ReplicatedEngine:
                         if remaining <= 0:
                             break
                         try:
-                            batch.append(
-                                self._queue.get(timeout=remaining))
+                            req = self._queue.get(timeout=remaining)
                         except queue.Empty:
                             break
+                        if req.span is not None:
+                            req.span.mark("queue_wait")
+                        batch.append(req)
                     self._route(batch)
                 finally:
                     self._forming = 0
@@ -356,6 +385,12 @@ class ReplicatedEngine:
             return False
         with self._lock:
             self.rescued_requests += len(pending)
+        for r in pending:
+            if r.span is not None:
+                r.span.note("rescued", f"replica {source} -> {target}")
+        event(_log, "rescue", model=self.model.name, source=source,
+              target=target, requests=len(pending),
+              error=f"{type(err).__name__}: {err}")
         # straight to isolation: the failure is SOURCE's, not the
         # target's — going through target._cohort_failed would ding the
         # healthy replica's state machine for its neighbor's crime
@@ -392,8 +427,13 @@ class ReplicatedEngine:
             self.health.force_dead(
                 f"router died and the restart budget "
                 f"({self.restart_budget}) is exhausted")
+            event(_log, "router_dead", model=self.model.name,
+                  restart_budget=self.restart_budget)
             return
         self.health.record_restart()
+        event(_log, "router_restart", model=self.model.name,
+              restarts=self.health.watchdog_restarts,
+              budget=self.restart_budget)
         self._thread = threading.Thread(
             target=self._route_loop,
             name=f"router-{self.model.name}", daemon=True)
@@ -417,8 +457,13 @@ class ReplicatedEngine:
             self.evacuations += 1
         pending = [q for r in recs for q in r.requests
                    if not q.future.done()]
+        event(_log, "evacuation", model=self.model.name, replica=i,
+              reason=rep.health.dead_reason, requests=len(pending))
         if not pending:
             return
+        for q in pending:
+            if q.span is not None:
+                q.span.note("evacuated", f"replica {i} DEAD")
         err = RuntimeError(
             f"replica {i} is DEAD ({rep.health.dead_reason}); "
             f"cohort re-routed")
@@ -548,7 +593,10 @@ class ReplicatedEngine:
                 "dtype": str(self.wire_dtype),
                 "pooled": pooled}}
         out["latency"] = merged.percentiles()
+        out["latency_hist"] = merged.state_dict()
         out["img_per_sec"] = round(img_per_sec, 2)
         out["admission"] = self.admission.stats()
         out["health"] = self.health_report()
+        out["mfu"] = MfuMeter.merged_report([r.mfu for r in self.replicas])
+        out["trace"] = self.tracer.summary()
         return out
