@@ -15,13 +15,28 @@ test:
 test-all:
 	$(PY) -m pytest tests/ -q
 
+# dvtlint: the project's AST static analyzer (docs/ANALYSIS.md) — lock
+# discipline, lock-order cycles, hot-path host syncs, traced-code side
+# effects, wall-clock intervals, broad-except hygiene. --strict = CI
+# mode: any finding (or parse failure) exits 1; escape hatches are
+# counted and reported, never silent
+lint:
+	$(PY) -m deep_vision_tpu.analysis --strict
+
+# the analyzer's own suite: per-rule fixtures both directions, the
+# full-tree clean run, and the SanitizedLock deliberate-inversion proof
+lint-test:
+	$(PY) -m pytest tests/test_lint.py -q -m lint
+
 # boot the HTTP serving stack on a random port against a LeNet fixture,
 # issue one request, assert a 200 — once synchronous (pipeline_depth=1),
 # once pipelined (depth=2), once fault-injected, and once replicated over
 # 2 fake host devices (the cli.serve wiring, end to end; one bulk D2H
 # per batch throughout); then the gateway smoke (cross-host failover)
 # and the observability smoke (/metrics, spans, id propagation)
-serve-smoke:
+# lint + lint-test gate the smoke: a serving-tier change that breaks the
+# machine-checked invariants fails here before any engine boots
+serve-smoke: lint lint-test
 	$(PY) tests/serve_smoke.py
 	$(PY) tests/gateway_smoke.py
 	$(PY) tests/obs_smoke.py
@@ -124,4 +139,4 @@ list:
 .PHONY: test test-all bench bench-serve bench-serve-sync \
 	bench-serve-scaling bench-serve-wire bench-gateway serve-smoke \
 	serve-multi serve-chaos gateway-smoke gateway-test obs-smoke \
-	obs-test list
+	obs-test lint lint-test list

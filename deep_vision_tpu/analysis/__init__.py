@@ -1,0 +1,24 @@
+"""dvtlint — project-specific static analysis + runtime lock sanitizer.
+
+Static rules (see docs/ANALYSIS.md for the catalog and annotation guide):
+
+  DVT001  guarded attribute written outside its ``with self._lock`` block
+  DVT002  cycle in the static lock-acquisition-order graph
+  DVT003  device->host sync inside a ``# dvtlint: hot`` function
+  DVT004  Python side effect inside jit-traced / AOT-lowered code
+  DVT005  elapsed interval computed from ``time.time()`` (wall clock)
+  DVT006  broad except without a ``# noqa: BLE001 — <reason>`` justification
+
+Run with ``python -m deep_vision_tpu.analysis --strict`` (what ``make lint``
+does), or programmatically via :func:`run_paths`. The runtime half lives in
+:mod:`deep_vision_tpu.analysis.sanitizer`.
+
+This package is stdlib-only by design — importing it (e.g. for
+``sanitizer.new_lock``) must never pull in jax.
+"""
+
+from .framework import Finding, Report, run_paths
+
+RULE_CODES = ("DVT001", "DVT002", "DVT003", "DVT004", "DVT005", "DVT006")
+
+__all__ = ["Finding", "Report", "run_paths", "RULE_CODES"]

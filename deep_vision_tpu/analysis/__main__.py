@@ -1,0 +1,42 @@
+"""CLI: ``python -m deep_vision_tpu.analysis [--strict] [paths...]``.
+
+With no paths, analyzes the deep_vision_tpu package itself. Prints one line
+per finding plus a summary that counts escape-hatch suppressions. Exit
+status: 0 when clean; with ``--strict``, any finding (including a DVT000
+parse failure) exits 1 — that is the CI contract behind ``make lint``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .framework import run_paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="python -m deep_vision_tpu.analysis")
+    parser.add_argument("paths", nargs="*",
+                        help="files or directories (default: the package)")
+    parser.add_argument("--strict", action="store_true",
+                        help="exit 1 on any finding (CI mode)")
+    parser.add_argument("--root", default=None,
+                        help="root for relative paths in the report")
+    args = parser.parse_args(argv)
+
+    pkg_dir = Path(__file__).resolve().parent.parent
+    paths = args.paths or [pkg_dir]
+    root = Path(args.root) if args.root else pkg_dir.parent
+
+    report = run_paths(paths, root=root)
+    for f in report.findings:
+        print(f.render())
+    print(report.summary())
+    if report.findings and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,295 @@
+"""dvtlint core: source model, annotations, findings, and the rule runner.
+
+The analyzer is pure stdlib (ast + tokenize) — it never imports jax or any
+serving module, so ``make lint`` is safe on a box with no accelerator and
+costs no device init.
+
+Annotation surface (all trailing comments, parsed from the token stream so
+strings can't fool us):
+
+  ``# guarded-by: _lock``        on a ``self.x = ...`` line in ``__init__``:
+                                 declares the attribute writable only under
+                                 ``with self._lock`` (DVT001).
+  ``# dvtlint: hot``             on (or directly above) a ``def`` line:
+                                 marks the function a serving hot path
+                                 (DVT003 scans it for host syncs).
+  ``# dvtlint: traced``          on (or directly above) a ``def`` line:
+                                 marks a function that is traced/AOT-lowered
+                                 even though the ``jax.jit`` call is not
+                                 syntactically visible (DVT004 scans it).
+  ``# dvtlint: holds=_lock``     on a ``def`` line: the function is only
+                                 ever called with ``self._lock`` held
+                                 (same contract as the ``_locked`` suffix).
+  ``# dvtlint: lock=<name>``     on a ``with`` line: names a lock acquired
+                                 through a non-``self`` receiver so DVT002
+                                 can place it in the global order graph.
+  ``# dvtlint: disable=CODE[,CODE]``
+                                 escape hatch; suppresses the listed codes
+                                 on that line (or, when placed on a ``def``
+                                 line, for the whole function).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import re
+import tokenize
+from pathlib import Path
+
+GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+DISABLE_RE = re.compile(r"#\s*dvtlint:\s*disable=([A-Z0-9,\s]+)")
+HOT_RE = re.compile(r"#\s*dvtlint:\s*hot\b")
+TRACED_RE = re.compile(r"#\s*dvtlint:\s*traced\b")
+HOLDS_RE = re.compile(r"#\s*dvtlint:\s*holds=([A-Za-z_][A-Za-z0-9_]*)")
+LOCKNAME_RE = re.compile(r"#\s*dvtlint:\s*lock=([A-Za-z_][A-Za-z0-9_.]*)")
+# The justification convention DVT006 enforces: a broad except must carry
+# "# noqa: BLE001 — <reason>" (em dash, en dash, or "--"/"-" accepted).
+NOQA_BLE_RE = re.compile(r"#\s*noqa:\s*BLE001\b\s*(?:[—–-]{1,2}\s*(\S.*))?")
+
+
+@dataclasses.dataclass
+class Finding:
+    code: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}: {self.code} {self.message}{tag}"
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    name: str
+    qualname: str  # "<module>.<Class>.<name>" or "<module>.<name>"
+    class_name: str | None
+    is_hot: bool = False
+    is_traced: bool = False
+    holds: frozenset = frozenset()
+
+
+class FileContext:
+    """One parsed source file plus its comment-borne annotations."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.tree = ast.parse(source, filename=str(path))
+        self.module = rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+        # module name without the package prefix or __init__ suffix, e.g.
+        # "serve.engine" — this is what DVT002 lock names are keyed on.
+        short = self.module
+        for prefix in ("deep_vision_tpu.",):
+            if short.startswith(prefix):
+                short = short[len(prefix):]
+        if short.endswith(".__init__"):
+            short = short[: -len(".__init__")]
+        self.short_module = short
+
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass
+
+        self.disables: dict[int, set] = {}
+        for lineno, comment in self.comments.items():
+            m = DISABLE_RE.search(comment)
+            if m:
+                codes = {c.strip() for c in m.group(1).split(",") if c.strip()}
+                self.disables.setdefault(lineno, set()).update(codes)
+
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+
+        self.functions: list[FunctionInfo] = []
+        self._index_functions()
+
+    # -- annotation helpers -------------------------------------------------
+
+    def _def_comment_lines(self, node) -> list[int]:
+        """Candidate comment lines for a def: the def line itself, each
+        decorator line, and the line immediately above the first of those."""
+        lines = [node.lineno]
+        for dec in getattr(node, "decorator_list", []):
+            lines.append(dec.lineno)
+        lines.append(min(lines) - 1)
+        return lines
+
+    def _index_functions(self) -> None:
+        def visit(node, class_name, qual):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name, f"{qual}.{child.name}")
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    comments = [
+                        self.comments.get(ln, "")
+                        for ln in self._def_comment_lines(child)
+                    ]
+                    blob = "\n".join(comments)
+                    holds = frozenset(HOLDS_RE.findall(blob))
+                    if child.name.endswith("_locked"):
+                        # repo convention: *_locked helpers are only called
+                        # with the instance lock already held
+                        holds = holds | {"_lock"}
+                    self.functions.append(
+                        FunctionInfo(
+                            node=child,
+                            name=child.name,
+                            qualname=f"{qual}.{child.name}",
+                            class_name=class_name,
+                            is_hot=bool(HOT_RE.search(blob)),
+                            is_traced=bool(TRACED_RE.search(blob)),
+                            holds=holds,
+                        )
+                    )
+                    visit(child, class_name, f"{qual}.{child.name}")
+                else:
+                    visit(child, class_name, qual)
+
+        visit(self.tree, None, self.short_module)
+
+    # -- queries ------------------------------------------------------------
+
+    def enclosing_function(self, node) -> FunctionInfo | None:
+        by_node = {fi.node: fi for fi in self.functions}
+        cur = node
+        while cur is not None:
+            if cur in by_node:
+                return by_node[cur]
+            cur = self.parents.get(cur)
+        return None
+
+    def is_disabled(self, code: str, node) -> bool:
+        lines = {getattr(node, "lineno", 0)}
+        end = getattr(node, "end_lineno", None)
+        if end is not None:
+            lines.add(end)
+        fi = self.enclosing_function(node)
+        if fi is not None:
+            lines.update(self._def_comment_lines(fi.node)[:-1])
+        for ln in lines:
+            if code in self.disables.get(ln, set()):
+                return True
+        return False
+
+
+def attr_chain(node) -> str | None:
+    """Render Name/Attribute chains as dotted strings ("self._lock",
+    "jax.device_get"); anything else returns None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclasses.dataclass
+class Report:
+    findings: list  # unsuppressed, sorted
+    suppressed: list  # escape-hatched findings, counted and reported
+    files: int
+
+    def summary(self) -> str:
+        def tally(items):
+            counts: dict[str, int] = {}
+            for f in items:
+                counts[f.code] = counts.get(f.code, 0) + 1
+            return ", ".join(f"{c} x{n}" for c, n in sorted(counts.items()))
+
+        parts = [f"dvtlint: {self.files} file(s)"]
+        if self.findings:
+            parts.append(f"{len(self.findings)} finding(s) [{tally(self.findings)}]")
+        else:
+            parts.append("0 findings")
+        if self.suppressed:
+            parts.append(
+                f"{len(self.suppressed)} suppressed via escape hatch "
+                f"[{tally(self.suppressed)}]"
+            )
+        return "; ".join(parts)
+
+
+def load_context(path: Path, root: Path) -> FileContext | Finding:
+    try:
+        rel = str(path.relative_to(root))
+    except ValueError:
+        rel = str(path)
+    try:
+        source = path.read_text()
+        return FileContext(path, rel, source)
+    except (SyntaxError, UnicodeDecodeError, OSError) as e:
+        return Finding("DVT000", rel, getattr(e, "lineno", 0) or 0,
+                       f"could not parse: {e}")
+
+
+def collect_files(paths) -> list[Path]:
+    out = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            out.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py":
+            out.append(p)
+    return out
+
+
+def run_paths(paths, root=None) -> Report:
+    """Run every rule over the given files/directories.
+
+    DVT002's lock-order graph is global across all analyzed files; all other
+    rules are per-file.
+    """
+    from . import rules_hygiene, rules_jax, rules_locks
+
+    files = collect_files(paths)
+    if root is None:
+        root = Path.cwd()
+    root = Path(root)
+
+    contexts: list[FileContext] = []
+    findings: list[Finding] = []
+    for path in files:
+        ctx = load_context(path, root)
+        if isinstance(ctx, Finding):
+            findings.append(ctx)
+        else:
+            contexts.append(ctx)
+
+    per_file_rules = (
+        rules_locks.check_dvt001,
+        rules_jax.check_dvt003,
+        rules_jax.check_dvt004,
+        rules_hygiene.check_dvt005,
+        rules_hygiene.check_dvt006,
+    )
+    raw: list[tuple[Finding, FileContext, ast.AST]] = []
+    for ctx in contexts:
+        for rule in per_file_rules:
+            raw.extend(rule(ctx))
+    raw.extend(rules_locks.check_dvt002(contexts))
+
+    suppressed: list[Finding] = []
+    for finding, ctx, node in raw:
+        if ctx is not None and node is not None and ctx.is_disabled(finding.code, node):
+            finding.suppressed = True
+            suppressed.append(finding)
+        else:
+            findings.append(finding)
+
+    key = lambda f: (f.path, f.line, f.code)  # noqa: E731
+    return Report(sorted(findings, key=key), sorted(suppressed, key=key),
+                  len(files))

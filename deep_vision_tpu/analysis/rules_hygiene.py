@@ -1,0 +1,100 @@
+"""DVT005 (wall-clock durations) and DVT006 (broad-except hygiene).
+
+DVT005: ``time.time()`` is the wall clock — NTP can step it backwards, so
+any *interval* computed from it (EWMAs, deadlines, histograms) is wrong by
+construction. Durations must use ``time.monotonic()``; ``time.time()`` is
+allowed only as a pass-through record timestamp (log lines, TensorBoard
+events). The rule flags subtraction involving a ``time.time()`` call or a
+name/attribute bound from one.
+
+DVT006: ``except Exception`` / bare ``except`` / ``except BaseException``
+must carry the repo's justification convention on the same line:
+``# noqa: BLE001 — <reason>``. A bare ``# noqa: BLE001`` with no reason is
+also a finding — the reason is the point.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .framework import Finding, NOQA_BLE_RE, attr_chain
+
+
+def _is_wall_call(node) -> bool:
+    return isinstance(node, ast.Call) and attr_chain(node.func) == "time.time"
+
+
+def check_dvt005(ctx):
+    # names (and self-attributes) bound from time.time(), per enclosing scope
+    wall_names: set[str] = set()
+    wall_attrs: set[str] = set()     # "self.<attr>" chains, tracked per class
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and _is_wall_call(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    wall_names.add(tgt.id)
+                else:
+                    chain = attr_chain(tgt)
+                    if chain:
+                        wall_attrs.add(chain)
+
+    def is_wall(expr) -> bool:
+        if _is_wall_call(expr):
+            return True
+        if isinstance(expr, ast.Name):
+            return expr.id in wall_names
+        chain = attr_chain(expr)
+        return chain is not None and chain in wall_attrs
+
+    out = []
+    for node in ast.walk(ctx.tree):
+        operands = []
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+            operands = [node.left, node.right]
+        elif isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Sub):
+            operands = [node.value]
+        if any(is_wall(op) for op in operands):
+            out.append((
+                Finding(
+                    "DVT005", ctx.rel, node.lineno,
+                    "elapsed interval computed from time.time(); wall clock "
+                    "can step backwards — use time.monotonic() for durations",
+                ),
+                ctx, node,
+            ))
+    return out
+
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    types = t.elts if isinstance(t, ast.Tuple) else [t]
+    for item in types:
+        chain = attr_chain(item)
+        if chain is not None and chain.rsplit(".", 1)[-1] in _BROAD:
+            return True
+    return False
+
+
+def check_dvt006(ctx):
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler) or not _is_broad(node):
+            continue
+        comment = ctx.comments.get(node.lineno, "")
+        m = NOQA_BLE_RE.search(comment)
+        if m and m.group(1):
+            continue  # justified: "# noqa: BLE001 — <reason>"
+        if m:
+            msg = ("broad except has `# noqa: BLE001` but no reason — the "
+                   "convention is `# noqa: BLE001 — <reason>`")
+        else:
+            what = "bare except" if node.type is None else "except Exception"
+            msg = (f"{what} without justification — narrow it or annotate "
+                   "`# noqa: BLE001 — <reason>` on the except line")
+        out.append((Finding("DVT006", ctx.rel, node.lineno, msg), ctx, node))
+    return out

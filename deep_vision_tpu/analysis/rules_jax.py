@@ -1,0 +1,159 @@
+"""DVT003 (host sync in a hot path) and DVT004 (side effects in traced code).
+
+DVT003 scans functions annotated ``# dvtlint: hot`` — the engine
+compute/dispatch path, replica routing, and the gateway proxy loop — for
+calls that force a device->host synchronization: ``jax.device_get``,
+``.block_until_ready()``, ``np.asarray``, ``.item()``, ``float()``. A value
+already fetched by ``jax.device_get`` is host memory, so statements that
+mention such a name are exempt from the np/item/float checks (the drainer's
+single bulk fetch is whitelisted at the fetch itself with an explicit
+``# dvtlint: disable=DVT003``).
+
+DVT004 scans traced code — functions passed to ``jax.jit`` in the same
+module, ``@jax.jit``/``@functools.partial(jax.jit, ...)`` decorated
+functions, and functions annotated ``# dvtlint: traced`` (the AOT-lowered
+bucket programs and the serve preprocess prologue) — for Python-level side
+effects that silently bake into (or worse, vanish from) the compiled
+program: ``time.*``, non-PRNG randomness, I/O, and attribute mutation.
+``jax.random`` is fine: explicit keys are pure.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .framework import Finding, attr_chain
+
+_SYNC_CALLS = {"jax.device_get", "np.asarray", "numpy.asarray"}
+_ALWAYS_FLAG = {"jax.device_get"}  # host-derived exemption never applies
+
+
+def _enclosing_stmt(ctx, node):
+    cur = node
+    while cur is not None and not isinstance(cur, ast.stmt):
+        cur = ctx.parents.get(cur)
+    return cur
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def check_dvt003(ctx):
+    out = []
+    for fi in ctx.functions:
+        if not fi.is_hot:
+            continue
+        # names bound from jax.device_get(...) are host values
+        host_names = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call) \
+                    and attr_chain(node.value.func) == "jax.device_get":
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        host_names.add(tgt.id)
+
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            chain = attr_chain(node.func)
+            label = None
+            exemptable = True
+            if chain in _SYNC_CALLS:
+                label = chain
+                exemptable = chain not in _ALWAYS_FLAG
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "block_until_ready":
+                label = ".block_until_ready()"
+                exemptable = False
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                label = ".item()"
+            elif isinstance(node.func, ast.Name) and node.func.id == "float" \
+                    and node.args and not isinstance(node.args[0], ast.Constant):
+                label = "float()"
+            if label is None:
+                continue
+            if exemptable and host_names:
+                stmt = _enclosing_stmt(ctx, node)
+                if stmt is not None and (_names_in(stmt) & host_names):
+                    continue  # operates on an already-fetched host value
+            out.append((
+                Finding(
+                    "DVT003", ctx.rel, node.lineno,
+                    f"{label} in hot function {fi.qualname} forces a "
+                    "device->host sync on the serving hot path",
+                ),
+                ctx, node,
+            ))
+    return out
+
+
+# -- DVT004 ------------------------------------------------------------------
+
+
+def _jit_target_names(ctx):
+    """Names of locally defined functions passed to jax.jit(...) anywhere in
+    the module (covers ``jax.jit(apply, ...)`` in the AOT bucket compile)."""
+    names = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and attr_chain(node.func) == "jax.jit":
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+    return names
+
+
+def _is_jit_decorated(fi):
+    for dec in getattr(fi.node, "decorator_list", []):
+        chain = attr_chain(dec if not isinstance(dec, ast.Call) else dec.func)
+        if chain == "jax.jit":
+            return True
+        # functools.partial(jax.jit, ...) / partial(jax.jit, ...)
+        if isinstance(dec, ast.Call) and chain in ("functools.partial", "partial"):
+            if dec.args and attr_chain(dec.args[0]) == "jax.jit":
+                return True
+    return False
+
+
+_IO_BUILTINS = {"print", "open", "input"}
+_RANDOM_PREFIXES = ("random.", "np.random.", "numpy.random.")
+
+
+def check_dvt004(ctx):
+    jit_names = _jit_target_names(ctx)
+    out = []
+    for fi in ctx.functions:
+        traced = fi.is_traced or fi.name in jit_names or _is_jit_decorated(fi)
+        if not traced:
+            continue
+        for node in ast.walk(fi.node):
+            label = None
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain is not None:
+                    if chain == "time" or chain.startswith("time."):
+                        label = f"{chain}() (trace-time constant, not a clock)"
+                    elif any(chain.startswith(p) for p in _RANDOM_PREFIXES):
+                        label = f"{chain}() (use jax.random with explicit keys)"
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in _IO_BUILTINS:
+                    label = f"{node.func.id}() (I/O)"
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for tgt in targets:
+                    if isinstance(tgt, ast.Attribute):
+                        label = f"attribute store to .{tgt.attr} (Python mutation)"
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                label = f"{type(node).__name__.lower()} statement (Python mutation)"
+            if label is None:
+                continue
+            out.append((
+                Finding(
+                    "DVT004", ctx.rel, node.lineno,
+                    f"side effect in traced function {fi.qualname}: {label}",
+                ),
+                ctx, node,
+            ))
+    return out

@@ -1,0 +1,316 @@
+"""DVT001 (guarded-attribute lock discipline) and DVT002 (lock-order graph).
+
+DVT001: an attribute whose ``__init__`` assignment carries a
+``# guarded-by: <lock>`` comment may only be written while lexically inside
+``with self.<lock>:`` (or from a ``*_locked`` helper / a function annotated
+``# dvtlint: holds=<lock>``, which the repo convention defines as "caller
+already holds the lock"). ``__init__`` itself is exempt — construction
+happens-before publication.
+
+DVT002: builds a global acquisition-order digraph. Nodes are lock *sites*
+("<module>.<Class>.<attr>"); an edge A -> B means some thread can acquire B
+while holding A — either a lexically nested ``with``, or a call made under A
+to a function that (transitively) acquires B. Any cycle is a potential
+deadlock. Non-``self`` receivers can be named with ``# dvtlint: lock=<name>``
+on the ``with`` line; unnamed ones become per-site "?" nodes that can't
+create false cycles across modules.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .framework import Finding, GUARDED_RE, LOCKNAME_RE, attr_chain
+
+
+def _self_attr_writes(node):
+    """Yield (attr_name, node) for stores to self.<attr> (including
+    self.<attr>[k] = v and augmented assigns)."""
+
+    def target_attr(tgt):
+        if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) \
+                and tgt.value.id == "self":
+            return tgt.attr
+        if isinstance(tgt, ast.Subscript):
+            return target_attr(tgt.value)
+        return None
+
+    if isinstance(node, ast.Assign):
+        for tgt in node.targets:
+            targets = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else [tgt]
+            for t in targets:
+                attr = target_attr(t)
+                if attr:
+                    yield attr, node
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        attr = target_attr(node.target)
+        if attr:
+            yield attr, node
+
+
+def _guarded_attrs(ctx, cls):
+    """Map attr -> lock name from ``# guarded-by:`` comments in __init__."""
+    guarded = {}
+    for item in cls.body:
+        if isinstance(item, ast.FunctionDef) and item.name == "__init__":
+            for node in ast.walk(item):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                comment = ctx.comments.get(node.lineno, "") + \
+                    ctx.comments.get(getattr(node, "end_lineno", node.lineno), "")
+                m = GUARDED_RE.search(comment)
+                if not m:
+                    continue
+                for attr, _ in _self_attr_writes(node):
+                    guarded[attr] = m.group(1)
+    return guarded
+
+
+def _under_with_lock(ctx, node, func_node, lock_name):
+    """True when node is lexically inside ``with self.<lock_name>`` within
+    func_node (crossing into a nested def/lambda breaks the containment —
+    closures may run after the lock is released)."""
+    cur = ctx.parents.get(node)
+    while cur is not None and cur is not func_node:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                if attr_chain(item.context_expr) == f"self.{lock_name}":
+                    return True
+        cur = ctx.parents.get(cur)
+    return False
+
+
+def check_dvt001(ctx):
+    out = []
+    for cls in [n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)]:
+        guarded = _guarded_attrs(ctx, cls)
+        if not guarded:
+            continue
+        for fi in ctx.functions:
+            if fi.class_name != cls.name or fi.name == "__init__":
+                continue
+            # only direct methods of this class, not nested helpers
+            if ctx.parents.get(fi.node) is not cls:
+                continue
+            for node in ast.walk(fi.node):
+                for attr, stmt in _self_attr_writes(node):
+                    lock = guarded.get(attr)
+                    if lock is None:
+                        continue
+                    if lock in fi.holds:
+                        continue
+                    if _under_with_lock(ctx, stmt, fi.node, lock):
+                        continue
+                    out.append((
+                        Finding(
+                            "DVT001", ctx.rel, stmt.lineno,
+                            f"write to self.{attr} (guarded-by {lock}) outside "
+                            f"`with self.{lock}` in {fi.qualname}",
+                        ),
+                        ctx, stmt,
+                    ))
+    return out
+
+
+# -- DVT002 ------------------------------------------------------------------
+
+_LOCKISH = ("lock",)
+
+
+def _lock_name_for_with_item(ctx, item, class_name):
+    """Resolve a with-item to a lock-site name, or None if it isn't a lock."""
+    chain = attr_chain(item.context_expr)
+    if chain is None:
+        return None
+    leaf = chain.rsplit(".", 1)[-1]
+    if not any(k in leaf.lower() for k in _LOCKISH):
+        return None
+    # explicit annotation wins
+    with_node = ctx.parents.get(item)
+    for ln in (getattr(with_node, "lineno", 0),):
+        m = LOCKNAME_RE.search(ctx.comments.get(ln, ""))
+        if m:
+            return m.group(1)
+    if chain.startswith("self.") and class_name:
+        return f"{ctx.short_module}.{class_name}.{leaf}"
+    # unresolved receiver: site-local node (unique, cannot alias across files)
+    return f"{ctx.short_module}.?{getattr(item.context_expr, 'lineno', 0)}.{leaf}"
+
+
+class _FuncFacts:
+    def __init__(self):
+        self.acquires = set()       # lock names acquired anywhere in body
+        self.nested_edges = []      # (held, acquired, lineno)
+        self.calls_under = []       # (held_lock, call_node, lineno)
+        self.calls = []             # every call node in body
+
+
+def _attr_types(contexts):
+    """Lightweight constructor-based type inference: for each class, map
+    ``self.<attr>`` to the class name it is constructed with in __init__
+    (``self.x = Foo(...)``). Returns {class_name: {attr: type_name}}."""
+    out = {}
+    for ctx in contexts:
+        for cls in [n for n in ast.walk(ctx.tree) if isinstance(n, ast.ClassDef)]:
+            amap = out.setdefault(cls.name, {})
+            for item in cls.body:
+                if isinstance(item, ast.FunctionDef) and item.name == "__init__":
+                    for node in ast.walk(item):
+                        if isinstance(node, ast.Assign) and \
+                                isinstance(node.value, ast.Call):
+                            ctor = attr_chain(node.value.func)
+                            if ctor is None:
+                                continue
+                            ctor = ctor.rsplit(".", 1)[-1]
+                            for tgt in node.targets:
+                                if isinstance(tgt, ast.Attribute) and \
+                                        isinstance(tgt.value, ast.Name) and \
+                                        tgt.value.id == "self":
+                                    amap[tgt.attr] = ctor
+    return out
+
+
+def _collect_facts(ctx, fi):
+    facts = _FuncFacts()
+
+    def visit(node, held):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # nested defs run later, outside the current lock scope;
+                # their own acquisitions are attributed to their FunctionInfo
+                continue
+            new_held = held
+            if isinstance(child, ast.With):
+                acquired = []
+                for item in child.items:
+                    name = _lock_name_for_with_item(ctx, item, fi.class_name)
+                    if name is not None:
+                        facts.acquires.add(name)
+                        for h in held + acquired:
+                            facts.nested_edges.append((h, name, child.lineno))
+                        acquired.append(name)
+                new_held = held + acquired
+            if isinstance(child, ast.Call):
+                facts.calls.append(child)
+                for h in new_held:
+                    facts.calls_under.append((h, child, child.lineno))
+            visit(child, new_held)
+
+    held0 = []
+    if fi.class_name and fi.holds:
+        held0 = [f"{ctx.short_module}.{fi.class_name}.{h}" for h in sorted(fi.holds)]
+    visit(fi.node, held0)
+    return facts
+
+
+def _resolve_call(call, ctx, fi, attr_types, methods_by_qual, funcs_by_module):
+    """Resolve a call to candidate function qualnames. Precise resolutions
+    only (self.m(), typed self.attr.m(), Class(...).m is out of scope,
+    bare same-module f()) — imprecise fallbacks are skipped rather than
+    risking false lock-order edges."""
+    func = call.func
+    if isinstance(func, ast.Name):
+        qual = f"{ctx.short_module}.{func.id}"
+        return [qual] if qual in funcs_by_module else []
+    if not isinstance(func, ast.Attribute):
+        return []
+    chain = attr_chain(func)
+    if chain is None:
+        return []
+    parts = chain.split(".")
+    if parts[0] == "self" and fi.class_name:
+        if len(parts) == 2:  # self.meth()
+            qual = f"{ctx.short_module}.{fi.class_name}.{parts[1]}"
+            return [qual] if qual in methods_by_qual else []
+        if len(parts) == 3:  # self.attr.meth() with constructor-typed attr
+            typ = attr_types.get(fi.class_name, {}).get(parts[1])
+            if typ:
+                cands = [q for q in methods_by_qual
+                         if q.endswith(f".{typ}.{parts[2]}")]
+                return cands
+    return []
+
+
+def check_dvt002(contexts):
+    """Global pass: build the acquisition graph over every analyzed file,
+    then report each lock-order cycle once."""
+    attr_types = _attr_types(contexts)
+    facts = {}        # qualname -> (_FuncFacts, ctx, fi)
+    for ctx in contexts:
+        for fi in ctx.functions:
+            facts[fi.qualname] = (_collect_facts(ctx, fi), ctx, fi)
+    methods_by_qual = {q for q, (_, _, fi) in facts.items() if fi.class_name}
+    funcs_by_module = {q for q, (_, _, fi) in facts.items() if not fi.class_name}
+
+    resolved_calls = {}   # qualname -> [callee qualnames] (whole body)
+    for qual, (f, ctx, fi) in facts.items():
+        callees = []
+        for call in f.calls:
+            callees.extend(_resolve_call(call, ctx, fi, attr_types,
+                                         methods_by_qual, funcs_by_module))
+        resolved_calls[qual] = callees
+
+    # transitive lock acquisitions, to fixpoint (handles recursion)
+    trans = {qual: set(f.acquires) for qual, (f, _, _) in facts.items()}
+    changed = True
+    while changed:
+        changed = False
+        for qual, callees in resolved_calls.items():
+            before = len(trans[qual])
+            for c in callees:
+                trans[qual] |= trans.get(c, set())
+            if len(trans[qual]) != before:
+                changed = True
+
+    edges = {}   # (a, b) -> (rel, lineno, via)
+    for qual, (f, ctx, fi) in facts.items():
+        for a, b, ln in f.nested_edges:
+            edges.setdefault((a, b), (ctx.rel, ln, qual))
+        for held, call, ln in f.calls_under:
+            for callee in _resolve_call(call, ctx, fi, attr_types,
+                                        methods_by_qual, funcs_by_module):
+                for b in trans.get(callee, ()):
+                    edges.setdefault((held, b),
+                                     (ctx.rel, ln, f"{qual} -> {callee}"))
+
+    graph = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+
+    # cycle detection (includes self-loops: re-acquiring the same lock site)
+    out = []
+    seen_cycles = set()
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in set(graph) | {b for bs in graph.values() for b in bs}}
+    stack = []
+
+    def dfs(n):
+        color[n] = GRAY
+        stack.append(n)
+        for b in sorted(graph.get(n, ())):
+            if color.get(b, WHITE) == GRAY:
+                cyc = tuple(stack[stack.index(b):] + [b])
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    rel, ln, via = edges[(n, b)]
+                    out.append((
+                        Finding(
+                            "DVT002", rel, ln,
+                            "lock-order cycle: " + " -> ".join(cyc) +
+                            f" (edge via {via})",
+                        ),
+                        None, None,
+                    ))
+            elif color.get(b, WHITE) == WHITE:
+                dfs(b)
+        stack.pop()
+        color[n] = BLACK
+
+    for n in sorted(color):
+        if color[n] == WHITE:
+            dfs(n)
+    return out

@@ -1,0 +1,153 @@
+"""Runtime lock-order sanitizer — the dynamic half of dvtlint.
+
+``new_lock(name)`` is the seam every threaded serving module creates its
+locks through. Disabled (the default), it returns a plain
+``threading.Lock`` — the cost of the instrumentation is one module-level
+bool check at *construction* time and exactly nothing on the acquire/release
+hot path. Enabled (``DVT_LOCK_SANITIZER=1`` in the environment, or
+``enable(True)`` from a test fixture before the locks are constructed), it
+returns a ``SanitizedLock`` that records per-thread acquisition order into a
+global graph keyed by lock *name* — all instances of one lock site share a
+node, so the graph captures ordering between lock classes, which is what
+deadlocks care about.
+
+On acquiring B while holding A, the sanitizer adds the edge A -> B; if B can
+already reach A in the graph, two code paths take these locks in opposite
+orders — a real deadlock under the right interleaving — so it records a
+violation and raises ``LockOrderViolation`` *before* blocking (the test sees
+an exception, not a hang). Same-name edges (two instances of one site, e.g.
+two engine replicas) are skipped: instance ordering within a site is not
+statically knowable and the serving tier never nests same-class locks.
+
+Violations are also kept in a global list so a conftest fixture can assert
+cleanliness at teardown even when a worker thread swallowed the raise.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_ENABLED = os.environ.get("DVT_LOCK_SANITIZER", "") == "1"
+
+_graph_mu = threading.Lock()
+_edges: dict[str, set] = {}          # name -> names acquired while held
+_edge_site: dict[tuple, str] = {}    # (a, b) -> thread that first added it
+_violations: list[str] = []
+_tls = threading.local()
+
+
+class LockOrderViolation(RuntimeError):
+    """Acquiring this lock here inverts an already-observed lock order."""
+
+
+def enable(flag: bool = True) -> None:
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def reset() -> None:
+    """Clear the order graph and recorded violations (per-test isolation)."""
+    with _graph_mu:
+        _edges.clear()
+        _edge_site.clear()
+        _violations.clear()
+
+
+def violations() -> list:
+    with _graph_mu:
+        return list(_violations)
+
+
+def _held() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _reaches(src: str, dst: str) -> bool:
+    # caller holds _graph_mu
+    stack, seen = [src], {src}
+    while stack:
+        n = stack.pop()
+        if n == dst:
+            return True
+        for b in _edges.get(n, ()):
+            if b not in seen:
+                seen.add(b)
+                stack.append(b)
+    return False
+
+
+def _check_and_record(name: str) -> None:
+    held = _held()
+    if not held:
+        return
+    thread = threading.current_thread().name
+    with _graph_mu:
+        for a in held:
+            if a == name:
+                continue  # same lock site (another instance): no ordering
+            if _reaches(name, a):
+                chain = f"{name} -> ... -> {a}"
+                msg = (
+                    f"lock-order inversion: thread {thread!r} acquires "
+                    f"{name!r} while holding {a!r}, but the graph already "
+                    f"has {chain} (first seen in "
+                    f"{_edge_site.get((name, a), '?')!r})"
+                )
+                _violations.append(msg)
+                raise LockOrderViolation(msg)
+            if name not in _edges.setdefault(a, set()):
+                _edges[a].add(name)
+                _edge_site.setdefault((a, name), thread)
+
+
+class SanitizedLock:
+    """Drop-in for ``threading.Lock`` that sanity-checks acquisition order."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._inner = threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        _check_and_record(self.name)  # raises before we can deadlock
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _held().append(self.name)
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        held = _held()
+        # remove the most recent occurrence (locks may unwind out of order)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == self.name:
+                del held[i]
+                break
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def __repr__(self):
+        return f"<SanitizedLock {self.name!r} locked={self.locked()}>"
+
+
+def new_lock(name: str):
+    """The serving tier's lock constructor: plain Lock unless sanitizing."""
+    if _ENABLED:
+        return SanitizedLock(name)
+    return threading.Lock()

@@ -180,7 +180,7 @@ class AdversarialTrainer:
             if hasattr(train_data, "set_epoch"):
                 train_data.set_epoch(epoch)
             meter = ThroughputMeter()
-            t0 = time.time()
+            t0 = time.monotonic()
             if use_scan:
                 states, rng, step, aborted = self._epoch_scan(
                     train_data, states, rng, step, epoch, K, meter)
@@ -193,7 +193,7 @@ class AdversarialTrainer:
             # every update) so the epoch time is wall truth, not queue depth
             int(jax.device_get(next(iter(states.values())).step))
             self.scheduler.step(epoch, None)
-            print(f"Epoch {epoch} done in {time.time() - t0:.1f}s", flush=True)
+            print(f"Epoch {epoch} done in {time.monotonic() - t0:.1f}s", flush=True)
             self.logger.log("images_per_sec", step, meter.images_per_sec)
             if epoch % cfg.checkpoint_every_epochs == 0:
                 self.checkpointer.save_tree(

@@ -31,6 +31,6 @@ def enable_compile_cache(path: str | None = None) -> str | None:
         jax.config.update("jax_compilation_cache_dir", path)
         # only persist programs worth the disk round-trip
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-    except Exception:
+    except Exception:  # noqa: BLE001 — cache config unsupported on this jax: run uncached
         return None
     return path

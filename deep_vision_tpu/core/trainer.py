@@ -605,7 +605,7 @@ class Trainer:
                 opt_state=set_learning_rate(state.opt_state, lr))
             if hasattr(train_data, "set_epoch"):
                 train_data.set_epoch(epoch)
-            t0 = time.time()
+            t0 = time.monotonic()
             state = self.train_epoch(state, train_data, epoch)
             if self._preempted:
                 # mid-epoch save as epoch-1: resume re-runs this epoch
@@ -625,7 +625,7 @@ class Trainer:
                     metric_val = val_metrics.get(monitor)
                 print(f"Epoch {epoch} val "
                       + " ".join(f"{k}={v:.4f}" for k, v in val_metrics.items())
-                      + f" ({time.time() - t0:.1f}s)", flush=True)
+                      + f" ({time.monotonic() - t0:.1f}s)", flush=True)
             if self._preempted:
                 # SIGTERM during validation: save NOW — the preemption
                 # grace period is too short for best-ckpt/scheduler work

@@ -40,7 +40,7 @@ def _build() -> str | None:
             check=True, capture_output=True, timeout=120)
         os.replace(tmp, out)  # atomic: concurrent builders race safely
         return out
-    except Exception:
+    except Exception:  # noqa: BLE001 — no toolchain / failed compile: fall back to pure NumPy
         if os.path.exists(tmp):
             os.remove(tmp)
         return None

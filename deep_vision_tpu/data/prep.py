@@ -63,8 +63,8 @@ def sanitize_image(payload: bytes) -> tuple[bytes, str]:
             if im.format == "JPEG" and im.mode == "RGB":
                 im.load()  # full decode — catches truncation up front
                 return payload, "ok"
-    except Exception:
-        pass  # fall through to the salvage path
+    except Exception:  # noqa: BLE001 — any decode error falls through to the salvage path
+        pass
     old = ImageFile.LOAD_TRUNCATED_IMAGES
     ImageFile.LOAD_TRUNCATED_IMAGES = True
     try:
@@ -73,7 +73,7 @@ def sanitize_image(payload: bytes) -> tuple[bytes, str]:
         buf = io.BytesIO()
         rgb.save(buf, format="JPEG", quality=100)
         return buf.getvalue(), "reencoded"
-    except Exception:
+    except Exception:  # noqa: BLE001 — undecodable even with truncation allowed: drop the sample
         return b"", "bad"
     finally:
         ImageFile.LOAD_TRUNCATED_IMAGES = old
@@ -91,7 +91,7 @@ def decode_image_robust(payload: bytes) -> np.ndarray | None:
     try:
         with Image.open(io.BytesIO(payload)) as im:
             return np.asarray(im.convert("RGB"))
-    except Exception:
+    except Exception:  # noqa: BLE001 — undecodable payload maps to None by contract
         return None
     finally:
         ImageFile.LOAD_TRUNCATED_IMAGES = old

@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import threading
 
+from deep_vision_tpu.analysis.sanitizer import new_lock
+
 # peak dense bf16 TFLOP/s per chip by device kind (public spec sheets);
 # bench.py imports this table — one source of truth for both MFUs
 PEAK_BF16_TFLOPS = {
@@ -96,15 +98,15 @@ class MfuMeter:
     """
 
     def __init__(self, peak: float | None = None):
-        self._lock = threading.Lock()
+        self._lock = new_lock("obs.mfu.MfuMeter._lock")
         self._peak = peak
-        self._bucket_flops: dict[int, float | None] = {}
-        self._source: str | None = None
-        self.batches = 0
-        self.images = 0
-        self.compute_s = 0.0
-        self.flops = 0.0
-        self.unknown_flops_batches = 0
+        self._bucket_flops: dict[int, float | None] = {}  # guarded-by: _lock
+        self._source: str | None = None  # guarded-by: _lock
+        self.batches = 0  # guarded-by: _lock
+        self.images = 0  # guarded-by: _lock
+        self.compute_s = 0.0  # guarded-by: _lock
+        self.flops = 0.0  # guarded-by: _lock
+        self.unknown_flops_batches = 0  # guarded-by: _lock
 
     def set_bucket_flops(self, bucket: int, flops: float | None,
                          source: str | None = None):

@@ -37,6 +37,8 @@ from __future__ import annotations
 import collections
 import os
 import threading
+
+from deep_vision_tpu.analysis.sanitizer import new_lock
 import time
 import uuid
 
@@ -110,11 +112,11 @@ class Tracer:
         self.slow_ms = slow_ms
         self.ring: collections.deque[dict] = \
             collections.deque(maxlen=max(1, int(ring)))
-        self._lock = threading.Lock()
-        self.started = 0
-        self.finished = 0
-        self.slow_sampled = 0
-        self._stage_s: dict[str, list] = {}  # stage -> [total_s, samples]
+        self._lock = new_lock("obs.trace.Tracer._lock")
+        self.started = 0  # guarded-by: _lock
+        self.finished = 0  # guarded-by: _lock
+        self.slow_sampled = 0  # guarded-by: _lock
+        self._stage_s: dict[str, list] = {}  # stage -> [total_s, samples]; guarded-by: _lock
 
     def start(self, request_id: str | None = None,
               origin: str = "submit") -> Span | None:

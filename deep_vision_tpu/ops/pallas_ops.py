@@ -162,7 +162,7 @@ def pallas_parity_ok(batch: int = 2, n_pred: int = 600, n_gt: int = 100,
         if not ok:
             print(f"[pallas] parity check FAILED (max err {err:.2e}) — "
                   "falling back to the XLA ignore-mask path")
-    except Exception as e:  # compile/runtime failure → XLA fallback
+    except Exception as e:  # noqa: BLE001 — compile/runtime failure → XLA fallback
         print(f"[pallas] kernel unavailable ({type(e).__name__}: {e}) — "
               "falling back to the XLA ignore-mask path")
         ok = False

@@ -40,7 +40,7 @@ def serve_preprocess_kind(task: str, channels: int) -> str:
     return "unit"
 
 
-def serve_normalize(x, kind: str):
+def serve_normalize(x, kind: str):  # dvtlint: traced
     """uint8 wire batch → normalized float32, IDENTICAL math to the host
     preprocess for ``kind`` (scale first, then standardize — same op
     order as data/transforms.normalize and data/mnist.preprocess, so
@@ -68,7 +68,7 @@ def make_serve_preprocess(kind: str, wire_dtype, compute_dtype=jnp.float32):
     ``--infer-dtype bfloat16``, else float32)."""
     wire_is_int = jnp.issubdtype(jnp.dtype(wire_dtype), jnp.integer)
 
-    def fn(x):
+    def fn(x):  # dvtlint: traced
         if wire_is_int:
             x = serve_normalize(x, kind)
         return x.astype(compute_dtype)

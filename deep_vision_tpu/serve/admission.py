@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+
+from deep_vision_tpu.analysis.sanitizer import new_lock
 import time
 
 from deep_vision_tpu.obs.log import event, get_logger
@@ -62,14 +64,14 @@ class AdmissionController:
         # callable the ReplicatedEngine wires to its routing mask (DEAD
         # replicas drop out of the divisor as they drop out of routing)
         self._free_replicas = 1
-        self._lock = threading.Lock()
-        self.shed_queue_full = 0
-        self.shed_deadline = 0
+        self._lock = new_lock("serve.admission.AdmissionController._lock")
+        self.shed_queue_full = 0  # guarded-by: _lock
+        self.shed_deadline = 0  # guarded-by: _lock
         # edge-triggered overload logging: one line when queue_full
         # shedding STARTS, one when an admit clears it — never a line
         # per shed request (a saturated engine must not also saturate
         # its own log)
-        self._overloaded = False
+        self._overloaded = False  # guarded-by: _lock
 
     def observe_exec(self, seconds: float, bucket: int | None = None):
         """Feed one batch's execution time into the EWMAs (global + the

@@ -74,6 +74,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from deep_vision_tpu.analysis.sanitizer import new_lock
 from deep_vision_tpu.core.metrics import LatencyHistogram, ThroughputMeter
 from deep_vision_tpu.obs.log import event, get_logger
 from deep_vision_tpu.obs.mfu import MfuMeter
@@ -159,10 +160,10 @@ class StagingPool:
     def __init__(self, input_shape: tuple, dtype=np.float32):
         self._input_shape = tuple(input_shape)
         self.dtype = np.dtype(dtype)
-        self._free: dict[int, list[np.ndarray]] = {}
-        self._lock = threading.Lock()
-        self.allocated = 0
-        self.reused = 0
+        self._free: dict[int, list[np.ndarray]] = {}  # guarded-by: _lock
+        self._lock = new_lock("serve.engine.StagingPool._lock")
+        self.allocated = 0  # guarded-by: _lock
+        self.reused = 0  # guarded-by: _lock
 
     def acquire(self, bucket: int) -> np.ndarray:
         with self._lock:
@@ -282,7 +283,7 @@ class BatchingEngine:
         self._rescue = rescue
         self._queue: queue.Queue[_Request] = queue.Queue()
         self._executables: dict = {}
-        self._lock = threading.Lock()
+        self._lock = new_lock("serve.engine.BatchingEngine._lock")
         self._stop = threading.Event()
         self._accepting = False
         self._thread: threading.Thread | None = None
@@ -291,34 +292,34 @@ class BatchingEngine:
         # in-flight window: acquired at dispatch, released after drain
         self._inflight_sem = threading.BoundedSemaphore(self.pipeline_depth)
         self._inflight_q: queue.Queue[_Inflight | None] = queue.Queue()
-        self._inflight = 0
+        self._inflight = 0  # guarded-by: _lock
         self._forming = 0  # requests the batcher holds but hasn't dispatched
-        self._inflight_recs: list[_Inflight] = []  # watchdog visibility
-        self.max_inflight = 0
-        self.submitted = 0
-        self.served = 0
-        self.batches = 0
-        self.compiles = 0
-        self.padded_images = 0
-        self.bulk_transfers = 0
-        self.bulk_transfer_bytes = 0
+        self._inflight_recs: list[_Inflight] = []  # watchdog visibility; guarded-by: _lock
+        self.max_inflight = 0  # guarded-by: _lock
+        self.submitted = 0  # guarded-by: _lock
+        self.served = 0  # guarded-by: _lock
+        self.batches = 0  # guarded-by: _lock
+        self.compiles = 0  # guarded-by: _lock
+        self.padded_images = 0  # guarded-by: _lock
+        self.bulk_transfers = 0  # guarded-by: _lock
+        self.bulk_transfer_bytes = 0  # guarded-by: _lock
         # H2D accounting: bytes of staged wire-format batches shipped to
         # the device (the observable 4× win of the uint8 wire) — counted
         # at both the pipelined dispatch and the synchronous retry path
-        self.h2d_transfers = 0
-        self.h2d_bytes = 0
-        self.h2d_bytes_by_bucket: dict[int, int] = {}
+        self.h2d_transfers = 0  # guarded-by: _lock
+        self.h2d_bytes = 0  # guarded-by: _lock
+        self.h2d_bytes_by_bucket: dict[int, int] = {}  # guarded-by: _lock
         # fault-tolerance accounting
-        self.batch_failures = 0
-        self.retry_executions = 0
-        self.quarantined = 0
-        self.exec_timeouts = 0
-        self.shed_shutdown = 0
+        self.batch_failures = 0  # guarded-by: _lock
+        self.retry_executions = 0  # guarded-by: _lock
+        self.quarantined = 0  # guarded-by: _lock
+        self.exec_timeouts = 0  # guarded-by: _lock
+        self.shed_shutdown = 0  # guarded-by: _lock
         # device-idle accounting (host proxy: wall time with an EMPTY
         # in-flight window between the first dispatch and the last drain)
-        self._first_dispatch: float | None = None
-        self._last_done: float | None = None
-        self._idle_s = 0.0
+        self._first_dispatch: float | None = None  # guarded-by: _lock
+        self._last_done: float | None = None  # guarded-by: _lock
+        self._idle_s = 0.0  # guarded-by: _lock
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -454,7 +455,7 @@ class BatchingEngine:
 
     # -- batcher thread (stage + dispatch) ---------------------------------
 
-    def _loop(self):
+    def _loop(self):  # dvtlint: hot
         try:
             while not self._stop.is_set():
                 self.health.beat("batcher")
@@ -490,7 +491,7 @@ class BatchingEngine:
         except KillThread:
             return  # injected death: the watchdog notices and restarts
 
-    def dispatch_cohort(self, batch: list[_Request]):
+    def dispatch_cohort(self, batch: list[_Request]):  # dvtlint: hot
         """Dispatch an already-formed cohort into this engine's
         pipeline.  The internal batcher calls it after queue drain; in
         replica mode (``external_batcher=True``) the ReplicatedEngine's
@@ -501,7 +502,7 @@ class BatchingEngine:
         self._forming = max(self._forming, len(batch))
         try:
             self._dispatch(batch)
-        except Exception as e:  # deliver, don't kill the caller
+        except Exception as e:  # noqa: BLE001 — deliver the failure to waiters, don't kill the caller
             for req in batch:
                 if not req.future.done():
                     req.future.set_exception(e)
@@ -557,7 +558,7 @@ class BatchingEngine:
                 return True
         return False
 
-    def _dispatch(self, batch: list[_Request]):
+    def _dispatch(self, batch: list[_Request]):  # dvtlint: hot
         live = []
         for req in batch:
             expired = self.admission.expired(req.deadline)
@@ -601,8 +602,8 @@ class BatchingEngine:
             # drainer is done with the batch, so the transfer may read
             # it at its leisure
             out = fn(self._put(buf))
-        except Exception as e:
-            # dispatch-side batch failure: free the slot, then isolate
+        except Exception as e:  # noqa: BLE001 — dispatch-side batch failure: free the slot, then isolate
+
             self.staging.release(bucket, buf)
             self._inflight_sem.release()
             self._cohort_failed(live, e)
@@ -631,7 +632,7 @@ class BatchingEngine:
 
     # -- drainer thread (bulk D2H + scatter) -------------------------------
 
-    def _drain_loop(self):
+    def _drain_loop(self):  # dvtlint: hot
         try:
             while True:
                 self.health.beat("drainer")
@@ -652,7 +653,7 @@ class BatchingEngine:
     def _finish(self, rec: _Inflight):
         try:
             self._complete(rec)
-        except Exception as e:
+        except Exception as e:  # noqa: BLE001 — completion failure fails the cohort, not the drainer
             self._cohort_failed(rec.requests, e)
         finally:
             self.staging.release(rec.bucket, rec.buffer)
@@ -665,7 +666,7 @@ class BatchingEngine:
                 self._last_done = time.monotonic()
             self._inflight_sem.release()
 
-    def _complete(self, rec: _Inflight):
+    def _complete(self, rec: _Inflight):  # dvtlint: hot
         import jax
 
         mode = None
@@ -674,7 +675,7 @@ class BatchingEngine:
                                       cancel=rec.cancel)
         # ONE bulk D2H for the whole output pytree — not a device slice
         # + transfer per request per leaf
-        host = jax.device_get(rec.out)
+        host = jax.device_get(rec.out)  # dvtlint: disable=DVT003 — the single bulk D2H per batch
         if mode == "nan":
             # corrupt only FLOAT leaves: integer outputs (class ids,
             # valid masks) can't hold NaN and _check_outputs skips them
@@ -954,7 +955,7 @@ class BatchingEngine:
                 try:
                     if self._rescue(pending, err):
                         continue
-                except Exception:  # noqa: BLE001
+                except Exception:  # noqa: BLE001 — rescue is best-effort; fall through to deliver the error
                     pass
             for req in pending:
                 if not req.future.done():

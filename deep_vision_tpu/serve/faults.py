@@ -50,6 +50,8 @@ import dataclasses
 import os
 import random
 import threading
+
+from deep_vision_tpu.analysis.sanitizer import new_lock
 import time
 
 STAGES = ("decode", "batcher", "staging", "dispatch", "compute", "d2h")
@@ -154,8 +156,8 @@ class FaultPlane:
         self.faults = parse_faults(self.spec)
         self.enabled = bool(self.faults)
         self._rng = random.Random(self.seed)
-        self._lock = threading.Lock()
-        self._submits = 0
+        self._lock = new_lock("serve.faults.FaultPlane._lock")
+        self._submits = 0  # guarded-by: _lock
         #: set by the engine's watchdog / stop() to break injected hangs
         self.cancel = threading.Event()
 

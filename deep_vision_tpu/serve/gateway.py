@@ -73,6 +73,7 @@ from http.client import HTTPConnection, HTTPException
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
+from deep_vision_tpu.analysis.sanitizer import new_lock
 from deep_vision_tpu.core.metrics import LatencyHistogram
 from deep_vision_tpu.obs.log import event, get_logger
 from deep_vision_tpu.obs.mfu import round_mfu
@@ -120,25 +121,25 @@ class Backend:
         self.degraded_after = max(1, int(degraded_after))
         self.dead_after = max(self.degraded_after, int(dead_after))
         self._alpha = ewma_alpha
-        self._lock = threading.Lock()
-        self.state = OK
-        self.breaker = CLOSED
-        self.opened_at: float | None = None
-        self._trial_inflight = False
+        self._lock = new_lock("serve.gateway.Backend._lock")
+        self.state = OK  # guarded-by: _lock
+        self.breaker = CLOSED  # guarded-by: _lock
+        self.opened_at: float | None = None  # guarded-by: _lock
+        self._trial_inflight = False  # guarded-by: _lock
         # a 503 healthz: alive but can't serve (reason from its body)
-        self.unavailable: str | None = None
-        self.outstanding = 0
-        self.ewma_s: float | None = None
-        self.consecutive_failures = 0
-        self.failures = 0
-        self.successes = 0
-        self.sheds = 0
-        self.probes = 0
-        self.breaker_opens = 0
-        self.breaker_closes = 0
-        self.half_open_trials = 0
-        self.last_probe_at: float | None = None
-        self.last_error: str | None = None
+        self.unavailable: str | None = None  # guarded-by: _lock
+        self.outstanding = 0  # guarded-by: _lock
+        self.ewma_s: float | None = None  # guarded-by: _lock
+        self.consecutive_failures = 0  # guarded-by: _lock
+        self.failures = 0  # guarded-by: _lock
+        self.successes = 0  # guarded-by: _lock
+        self.sheds = 0  # guarded-by: _lock
+        self.probes = 0  # guarded-by: _lock
+        self.breaker_opens = 0  # guarded-by: _lock
+        self.breaker_closes = 0  # guarded-by: _lock
+        self.half_open_trials = 0  # guarded-by: _lock
+        self.last_probe_at: float | None = None  # guarded-by: _lock
+        self.last_error: str | None = None  # guarded-by: _lock
 
     # -- routing gate ------------------------------------------------------
 
@@ -338,18 +339,18 @@ class Gateway:
         self.hedge_min_history = hedge_min_history
         self.tracer = tracer or Tracer()
         self.latency = LatencyHistogram()
-        self._lock = threading.Lock()
+        self._lock = new_lock("serve.gateway.Gateway._lock")
         self._stop = threading.Event()
         self._prober: threading.Thread | None = None
-        self._pool: ThreadPoolExecutor | None = None
-        self._rr = 0  # rotating scan offset: idle ties round-robin
-        self.proxied = 0
-        self.retries = 0
-        self.failovers = 0
-        self.hedges = 0
-        self.hedge_wins = 0
-        self.exhausted = 0
-        self.no_backend = 0
+        self._pool: ThreadPoolExecutor | None = None  # guarded-by: _lock
+        self._rr = 0  # rotating scan offset: idle ties round-robin; guarded-by: _lock
+        self.proxied = 0  # guarded-by: _lock
+        self.retries = 0  # guarded-by: _lock
+        self.failovers = 0  # guarded-by: _lock
+        self.hedges = 0  # guarded-by: _lock
+        self.hedge_wins = 0  # guarded-by: _lock
+        self.exhausted = 0  # guarded-by: _lock
+        self.no_backend = 0  # guarded-by: _lock
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -368,9 +369,10 @@ class Gateway:
         if self._prober is not None:
             self._prober.join(timeout)
             self._prober = None
-        if self._pool is not None:
-            self._pool.shutdown(wait=False)
-            self._pool = None
+        with self._lock:
+            pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
 
     def __enter__(self):
         return self.start()
@@ -446,6 +448,7 @@ class Gateway:
         except (ValueError, TypeError):
             return payload  # not JSON: leave the body alone
 
+    # dvtlint: hot
     def _forward(self, path: str, body: bytes, rid: str, span
                  ) -> tuple[int, dict, bytes]:
         t0 = time.monotonic()
@@ -528,7 +531,7 @@ class Gateway:
         return {k: out.headers[k] for k in _PROXY_HEADERS
                 if k in out.headers}
 
-    def _pick(self, exclude: list) -> Backend | None:
+    def _pick(self, exclude: list) -> Backend | None:  # dvtlint: hot
         """Least outstanding work (outstanding × latency EWMA) over
         routable backends, scanning from a rotating offset with strict
         less-than — an idle fleet round-robins instead of piling onto

@@ -30,6 +30,8 @@ timeouts — they're batch-plumbing); the *verdict* lives here.
 from __future__ import annotations
 
 import threading
+
+from deep_vision_tpu.analysis.sanitizer import new_lock
 import time
 
 OK = "ok"
@@ -41,17 +43,17 @@ class EngineHealth:
     def __init__(self, degraded_after: int = 1, dead_after: int = 5):
         self.degraded_after = max(1, int(degraded_after))
         self.dead_after = max(self.degraded_after, int(dead_after))
-        self._lock = threading.Lock()
+        self._lock = new_lock("serve.health.EngineHealth._lock")
         self._beats: dict[str, float] = {}
-        self.state = OK
-        self.consecutive_failures = 0
-        self.failures = 0
-        self.successes = 0
-        self.watchdog_restarts = 0
-        self.last_success_at: float | None = None
-        self.last_failure_at: float | None = None
-        self.dead_reason: str | None = None
-        self._forced_dead = False
+        self.state = OK  # guarded-by: _lock
+        self.consecutive_failures = 0  # guarded-by: _lock
+        self.failures = 0  # guarded-by: _lock
+        self.successes = 0  # guarded-by: _lock
+        self.watchdog_restarts = 0  # guarded-by: _lock
+        self.last_success_at: float | None = None  # guarded-by: _lock
+        self.last_failure_at: float | None = None  # guarded-by: _lock
+        self.dead_reason: str | None = None  # guarded-by: _lock
+        self._forced_dead = False  # guarded-by: _lock
 
     # -- heartbeats --------------------------------------------------------
 

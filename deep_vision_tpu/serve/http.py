@@ -67,6 +67,8 @@ import io
 import json
 import math
 import threading
+
+from deep_vision_tpu.analysis.sanitizer import new_lock
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
@@ -432,7 +434,7 @@ class _Handler(BaseHTTPRequestHandler):
         body = self._body() if length > 0 else {}
         deadline = float(body.get("drain_deadline_s", 10.0))
         srv = self.server
-        with srv.drain_lock:  # type: ignore[attr-defined]
+        with srv.drain_lock:  # type: ignore[attr-defined]  # dvtlint: lock=serve.http.Server.drain_lock
             already = getattr(srv, "draining", False)
             srv.draining = True
             if not already:
@@ -495,7 +497,7 @@ class ServeServer:
         self.httpd.max_body_bytes = max_body_bytes
         self.httpd.socket_timeout_s = socket_timeout_s
         self.httpd.draining = False
-        self.httpd.drain_lock = threading.Lock()
+        self.httpd.drain_lock = new_lock("serve.http.Server.drain_lock")
         if tracer is None:
             # share the first engine's tracer so handler-created spans
             # land in the same ring /v1/traces reads

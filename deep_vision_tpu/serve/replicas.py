@@ -57,6 +57,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
+from deep_vision_tpu.analysis.sanitizer import new_lock
 from deep_vision_tpu.core.metrics import LatencyHistogram
 from deep_vision_tpu.obs.log import event, get_logger
 from deep_vision_tpu.obs.mfu import MfuMeter
@@ -153,7 +154,7 @@ class ReplicatedEngine:
         # of routing
         self.admission.set_free_replicas(self._free_replicas)
         self._queue: queue.Queue[_Request] = queue.Queue()
-        self._lock = threading.Lock()
+        self._lock = new_lock("serve.replicas.ReplicatedEngine._lock")
         self._stop = threading.Event()
         self._accepting = False
         self._forming = 0
@@ -161,12 +162,12 @@ class ReplicatedEngine:
         self._supervisor: threading.Thread | None = None
         self._rr = 0  # round-robin tie-break cursor
         self._evacuated = [False] * len(self.replicas)
-        self.submitted = 0
-        self.shed_shutdown = 0
-        self.routed_batches = [0] * len(self.replicas)
-        self.rescued_requests = 0
-        self.evacuations = 0
-        self.shed_all_dead = 0
+        self.submitted = 0  # guarded-by: _lock
+        self.shed_shutdown = 0  # guarded-by: _lock
+        self.routed_batches = [0] * len(self.replicas)  # guarded-by: _lock
+        self.rescued_requests = 0  # guarded-by: _lock
+        self.evacuations = 0  # guarded-by: _lock
+        self.shed_all_dead = 0  # guarded-by: _lock
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -284,7 +285,7 @@ class ReplicatedEngine:
 
     # -- shared batcher + router -------------------------------------------
 
-    def _route_loop(self):
+    def _route_loop(self):  # dvtlint: hot
         """Identical cohort formation to the single-engine batcher
         (engine._loop), then a routing decision instead of a local
         dispatch.  Dying here is survivable: the supervisor restarts
@@ -321,7 +322,7 @@ class ReplicatedEngine:
         except KillThread:
             return  # injected death: the supervisor restarts the router
 
-    def _route(self, batch: list[_Request]):
+    def _route(self, batch: list[_Request]):  # dvtlint: hot
         bucket = self.replicas[0]._bucket_for(len(batch))
         i = self._pick(bucket)
         if i is None:
@@ -340,7 +341,7 @@ class ReplicatedEngine:
         self.replicas[i].dispatch_cohort(batch)
         self.health.record_success()
 
-    def _pick(self, bucket: int) -> int | None:
+    def _pick(self, bucket: int) -> int | None:  # dvtlint: hot
         """Least outstanding work = (in-flight + forming batches) × the
         bucket's exec EWMA, over non-DEAD replicas.  Scores tie whenever
         the fleet is idle (everything × EWMA = 0), so scanning starts at
@@ -446,7 +447,7 @@ class ReplicatedEngine:
         Admitted work survives replica death; only an all-DEAD fleet
         fails futures."""
         rep = self.replicas[i]
-        with rep._lock:
+        with rep._lock:  # dvtlint: lock=serve.engine.BatchingEngine._lock
             recs = [r for r in rep._inflight_recs if not r.cancelled]
             for r in recs:
                 r.cancelled = True
@@ -569,7 +570,7 @@ class ReplicatedEngine:
         for r in self.replicas:
             for b, nbuf in r.staging.stats()["pooled"].items():
                 pooled[b] = pooled.get(b, 0) + nbuf
-            with r._lock:
+            with r._lock:  # dvtlint: lock=serve.engine.BatchingEngine._lock
                 for b, nb in r.h2d_bytes_by_bucket.items():
                     h2d_by_bucket[b] = h2d_by_bucket.get(b, 0) + nb
         out["pipeline"] = {

@@ -40,3 +40,33 @@ def host_devices():
     devs = jax.local_devices()
     assert len(devs) >= 8, f"expected 8 forced host devices, got {devs}"
     return devs
+
+
+# The dvtlint runtime half (docs/ANALYSIS.md): every chaos/gateway/replicas
+# test runs with DVT_LOCK_SANITIZER semantics on — serve/* locks become
+# SanitizedLocks recording acquisition order, and the test FAILS at teardown
+# if any thread observed a lock-order inversion (even one a worker thread
+# swallowed). Engines/gateways are constructed inside the tests, after this
+# fixture enables the seam, so every lock they create is instrumented.
+_SANITIZED_MARKERS = {"chaos", "gateway", "replicas"}
+
+
+@pytest.fixture(autouse=True)
+def _dvt_lock_sanitizer(request):
+    from deep_vision_tpu.analysis import sanitizer
+
+    if not (_SANITIZED_MARKERS
+            & {m.name for m in request.node.iter_markers()}):
+        yield
+        return
+    was = sanitizer.enabled()
+    sanitizer.enable(True)
+    sanitizer.reset()
+    try:
+        yield
+        violations = sanitizer.violations()
+        assert not violations, (
+            "lock-order violations during test:\n  " + "\n  ".join(violations))
+    finally:
+        sanitizer.reset()
+        sanitizer.enable(was)

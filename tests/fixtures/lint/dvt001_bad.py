@@ -1,0 +1,20 @@
+"""DVT001 positive fixture: guarded attribute written outside the lock."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.table = {}  # guarded-by: _lock
+
+    def bump(self):
+        self.hits += 1  # BAD: guarded write with no lock held
+
+    def store(self, k, v):
+        self.table[k] = v  # BAD: subscript store on a guarded attr
+
+    def ok(self):
+        with self._lock:
+            self.misses += 1

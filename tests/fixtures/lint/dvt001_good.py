@@ -1,0 +1,29 @@
+"""DVT001 negative fixture: every guarded write holds the lock (directly,
+via the *_locked convention, via holds=, or via an explicit disable)."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.resets = 0  # guarded-by: _lock
+        self.free = 0  # unguarded on purpose: single-writer thread
+
+    def bump(self):
+        with self._lock:
+            self.hits += 1
+            self._miss_locked()
+
+    def _miss_locked(self):
+        self.misses += 1  # ok: *_locked suffix means caller holds the lock
+
+    def reset(self):  # dvtlint: holds=_lock
+        self.resets += 1  # ok: annotated as called-with-lock-held
+
+    def racy_but_audited(self):
+        self.hits = 0  # dvtlint: disable=DVT001 — test-only reset, single-threaded
+
+    def single_writer(self):
+        self.free += 1  # ok: never declared guarded

@@ -1,0 +1,46 @@
+"""DVT002 positive fixture: two lock-order cycles — one through
+cross-class call edges, one through annotated nested withs."""
+import threading
+
+x_lock = threading.Lock()
+y_lock = threading.Lock()
+
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peer = B()
+
+    def one(self):
+        with self._lock:
+            self.peer.poke()  # acquires B._lock while A._lock held
+
+    def nab(self):
+        with self._lock:
+            pass
+
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peer = A()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+    def two(self):
+        with self._lock:
+            self.peer.nab()  # acquires A._lock while B._lock held -> cycle
+
+
+def left():
+    with x_lock:  # dvtlint: lock=fix.X.lock
+        with y_lock:  # dvtlint: lock=fix.Y.lock
+            pass
+
+
+def right():
+    with y_lock:  # dvtlint: lock=fix.Y.lock
+        with x_lock:  # dvtlint: lock=fix.X.lock -> cycle with left()
+            pass

@@ -1,0 +1,41 @@
+"""DVT002 negative fixture: nesting exists, but every path agrees on the
+order (A before B, X before Y) — a DAG, not a cycle."""
+import threading
+
+x_lock = threading.Lock()
+y_lock = threading.Lock()
+
+
+class A:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.peer = B()
+
+    def one(self):
+        with self._lock:
+            self.peer.poke()
+
+    def other(self):
+        with self._lock:
+            self.peer.poke()  # same direction: still A -> B
+
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def poke(self):
+        with self._lock:
+            pass
+
+
+def left():
+    with x_lock:  # dvtlint: lock=fix.X.lock
+        with y_lock:  # dvtlint: lock=fix.Y.lock
+            pass
+
+
+def also_left():
+    with x_lock:  # dvtlint: lock=fix.X.lock
+        with y_lock:  # dvtlint: lock=fix.Y.lock
+            pass

@@ -1,0 +1,15 @@
+"""DVT003 positive fixture: device->host syncs inside a hot function."""
+import jax
+import numpy as np
+
+
+class Engine:
+    def step(self, out):  # dvtlint: hot
+        fetched = jax.device_get(out)  # BAD: device_get always flags
+        out.block_until_ready()  # BAD: explicit sync barrier
+        return fetched
+
+    def score(self, dev):  # dvtlint: hot
+        a = np.asarray(dev)  # BAD: silently copies device -> host
+        b = dev.item()  # BAD: scalar sync
+        return float(dev) + a + b  # BAD: float() on a device value

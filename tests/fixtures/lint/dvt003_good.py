@@ -1,0 +1,15 @@
+"""DVT003 negative fixture: the whitelisted bulk fetch, host-derived
+values, and identical code outside any hot function."""
+import jax
+import numpy as np
+
+
+class Engine:
+    def drain(self, out):  # dvtlint: hot
+        host = jax.device_get(out)  # dvtlint: disable=DVT003 — the single bulk D2H
+        rows = [np.asarray(host)[i] for i in range(2)]  # ok: host memory already
+        total = float(host.sum())  # ok: host-derived statement
+        return rows, total
+
+    def offline_report(self, out):  # not hot: same calls are fine here
+        return float(np.asarray(jax.device_get(out)).mean())

@@ -1,0 +1,26 @@
+"""DVT004 positive fixture: side effects inside jit-traced functions."""
+import functools
+import time
+
+import jax
+import numpy as np
+
+
+def make_step():
+    def step(x):
+        t = time.time()  # BAD: trace-time constant, not a clock
+        np.random.seed(0)  # BAD: host randomness vanishes from the trace
+        print("tracing", x)  # BAD: I/O fires at trace time only
+        return x * t
+
+    return jax.jit(step)
+
+
+class Holder:
+    count = 0
+
+
+@functools.partial(jax.jit, static_argnames=())
+def bump(x):
+    Holder.count = 1  # BAD: Python mutation baked into (or lost from) trace
+    return x

@@ -1,0 +1,20 @@
+"""DVT004 negative fixture: pure traced code (explicit PRNG keys are
+fine), and side effects in plain host functions."""
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def make_step():
+    def step(x, key):
+        noise = jax.random.normal(key, x.shape)  # ok: explicit PRNG key
+        return jnp.tanh(x + noise)
+
+    return jax.jit(step)
+
+
+def host_timer():  # never traced: wall work is fine
+    t0 = time.monotonic()
+    print("host side")
+    return time.monotonic() - t0

@@ -1,0 +1,16 @@
+"""DVT005 positive fixture: intervals computed from the wall clock."""
+import time
+
+
+def elapsed(work):
+    t0 = time.time()
+    work()
+    return time.time() - t0  # BAD: NTP can step this negative
+
+
+class Meter:
+    def __init__(self):
+        self.start = time.time()
+
+    def age(self):
+        return time.time() - self.start  # BAD: wall-clock interval

@@ -1,0 +1,13 @@
+"""DVT005 negative fixture: monotonic intervals; wall clock only as a
+pass-through record timestamp."""
+import time
+
+
+def elapsed(work):
+    t0 = time.monotonic()
+    work()
+    return time.monotonic() - t0
+
+
+def log_record(name):
+    return {"ts": round(time.time(), 6), "event": name}  # ok: timestamp field

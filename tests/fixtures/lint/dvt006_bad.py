@@ -1,0 +1,22 @@
+"""DVT006 positive fixture: broad excepts without (full) justification."""
+
+
+def swallow(fn):
+    try:
+        return fn()
+    except Exception:
+        return None  # BAD: no justification at all
+
+
+def swallow_bare(fn):
+    try:
+        return fn()
+    except:
+        return None  # BAD: bare except
+
+
+def swallow_reasonless(fn):
+    try:
+        return fn()
+    except Exception:  # noqa: BLE001
+        return None  # BAD: noqa without the required reason

@@ -1,0 +1,15 @@
+"""DVT006 negative fixture: narrow excepts, or justified broad ones."""
+
+
+def narrow(fn):
+    try:
+        return fn()
+    except (ValueError, KeyError):
+        return None
+
+
+def justified(fn):
+    try:
+        return fn()
+    except Exception:  # noqa: BLE001 — plugin code may raise anything; fall back to default
+        return None

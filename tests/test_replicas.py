@@ -18,7 +18,7 @@ from deep_vision_tpu.serve.faults import Quarantined
 from deep_vision_tpu.serve.registry import ModelRegistry
 from deep_vision_tpu.serve.replicas import ReplicatedEngine, local_devices
 
-pytestmark = pytest.mark.serve
+pytestmark = [pytest.mark.serve, pytest.mark.replicas]
 
 
 @pytest.fixture(scope="module")
