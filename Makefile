@@ -32,14 +32,29 @@ lint-test:
 # issue one request, assert a 200 — once synchronous (pipeline_depth=1),
 # once pipelined (depth=2), once fault-injected, and once replicated over
 # 2 fake host devices (the cli.serve wiring, end to end; one bulk D2H
-# per batch throughout); then the gateway smoke (cross-host failover)
-# and the observability smoke (/metrics, spans, id propagation)
+# per batch throughout); then the multi-model plane smoke (weight
+# cache + hot reload under load), the gateway smoke (cross-host
+# failover) and the observability smoke (/metrics, spans, id propagation)
 # lint + lint-test gate the smoke: a serving-tier change that breaks the
 # machine-checked invariants fails here before any engine boots
 serve-smoke: lint lint-test
 	$(PY) tests/serve_smoke.py
+	$(PY) tests/model_smoke.py
 	$(PY) tests/gateway_smoke.py
 	$(PY) tests/obs_smoke.py
+
+# the multi-model control plane end to end: two models behind one plane
+# on a weight-cache budget that holds only one of them (evict -> spill
+# -> re-admit), a hot reload under live HTTP load (zero client errors,
+# v2 promoted through the canary gates), /v1/models + plane-shaped
+# /v1/stats, every /metrics line parsed (dvt_serve_model_up + cache)
+model-smoke:
+	$(PY) tests/model_smoke.py
+
+# the model-plane unit suite alone (cache LRU/bit-identity, reload
+# zero-loss, canary auto-rollback, shadow discard, lifecycle HTTP)
+model-test:
+	$(PY) -m pytest tests/test_models_plane.py -q -m models
 
 # the observability surface alone: Prometheus /metrics on backend and
 # gateway (every line parsed, counters monotonic between scrapes), a
@@ -139,4 +154,4 @@ list:
 .PHONY: test test-all bench bench-serve bench-serve-sync \
 	bench-serve-scaling bench-serve-wire bench-gateway serve-smoke \
 	serve-multi serve-chaos gateway-smoke gateway-test obs-smoke \
-	obs-test lint lint-test list
+	obs-test model-smoke model-test lint lint-test list

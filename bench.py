@@ -804,6 +804,189 @@ def bench_serve_obs(**kwargs) -> dict:
     return on
 
 
+def bench_serve_mix(models: tuple = ("lenet5", "yolov3_toy"),
+                    loads: tuple = (8,), duration_s: float = 2.0,
+                    max_batch: int = 8, max_wait_ms: float = 2.0,
+                    pipeline_depth: int = 2,
+                    hbm_budget_mb: float = 0.0,
+                    zipf_s: float = 1.1, **_ignored) -> dict:
+    """Multi-model serving mix (``bench.py --serve-mix``): every model
+    in ``models`` deployed behind one control plane
+    (serve/models.py) sharing a weight cache, closed-loop clients
+    picking a model per request from a Zipf-ish popularity
+    distribution (weight ∝ 1/rank^s in list order — the first model
+    is the hot one, the tail is the long tail that keeps getting
+    evicted).  The JSON reports per-model p50/p95/p99 + img/s per
+    load point and the cache's hit rate / eviction / spill counters,
+    so the latency tax of serving more models than the HBM budget
+    holds is a tracked number, not folklore (docs/SERVING.md "Model
+    lifecycle & weight cache").  ``hbm_budget_mb`` is the experiment
+    knob: 0 = uncapped (baseline), small enough to hold one model =
+    worst-case thrash."""
+    import sys
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from deep_vision_tpu.core.config import get_config
+    from deep_vision_tpu.core.restore import load_state
+    from deep_vision_tpu.serve.admission import (AdmissionController,
+                                                 Shed)
+    from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.faults import Quarantined
+    from deep_vision_tpu.serve.models import (ModelControlPlane,
+                                              WeightCache)
+    from deep_vision_tpu.serve.registry import (CheckpointServingModel,
+                                                ModelRegistry)
+
+    registry = ModelRegistry()
+    admissions: dict = {}
+
+    def admission_for(name):
+        if name not in admissions:
+            admissions[name] = AdmissionController(name=name)
+        return admissions[name]
+
+    def engine_factory(sm):
+        return BatchingEngine(sm, max_batch=max_batch,
+                              max_wait_ms=max_wait_ms,
+                              pipeline_depth=pipeline_depth,
+                              admission=admission_for(sm.name))
+
+    cache = WeightCache(int(float(hbm_budget_mb) * 2**20))
+    plane = ModelControlPlane(registry, engine_factory, cache=cache,
+                              admission_factory=admission_for)
+    imgs = {}
+    try:
+        for name in models:
+            cfg = get_config(name)
+            with tempfile.TemporaryDirectory() as td:
+                model, state = load_state(
+                    cfg, td, log=lambda m: print(m, file=sys.stderr))
+            sm = CheckpointServingModel(name, cfg, model, state)
+            plane.deploy(sm)
+            imgs[name] = np.random.RandomState(0).randn(
+                *sm.input_shape).astype(np.float32)
+        plane.warmup()  # compiles excluded from every load point
+
+        # Zipf-ish popularity: weight ∝ 1/rank^s in `models` order
+        weights = [1.0 / (r + 1) ** zipf_s for r in range(len(models))]
+        total_w = sum(weights)
+        cum, acc = [], 0.0
+        for w in weights:
+            acc += w / total_w
+            cum.append(acc)
+
+        def pick(rng):
+            u = rng.random()
+            for name, edge in zip(models, cum):
+                if u <= edge:
+                    return name
+            return models[-1]
+
+        points = []
+        for clients in loads:
+            per_model: dict = {name: [] for name in models}
+            errors = [0]
+            retries = [0]
+            lock = threading.Lock()
+            stop_at = time.perf_counter() + duration_s
+
+            def client(seed):
+                # same well-behaved closed-loop client as bench_serve:
+                # honor queue-full Retry-After hints with jittered
+                # bounded backoff before counting an error
+                rng = random.Random(seed)
+                local = {name: [] for name in models}
+                local_err, local_retry = 0, 0
+                while time.perf_counter() < stop_at:
+                    name = pick(rng)
+                    t0 = time.perf_counter()
+                    r = None
+                    try:
+                        for _ in range(3):  # 1 attempt + 2 retries
+                            r = plane.infer(name, imgs[name],
+                                            timeout=60)
+                            if not (isinstance(r, Shed)
+                                    and r.retry_after_s):
+                                break
+                            local_retry += 1
+                            time.sleep(min(r.retry_after_s, 0.25)
+                                       * (0.5 + rng.random()))
+                        if isinstance(r, (Shed, Quarantined)):
+                            local_err += 1
+                            continue
+                    except Exception:  # noqa: BLE001
+                        local_err += 1
+                        continue
+                    local[name].append(time.perf_counter() - t0)
+                with lock:
+                    for name in models:
+                        per_model[name].extend(local[name])
+                    errors[0] += local_err
+                    retries[0] += local_retry
+
+            threads = [threading.Thread(target=client, args=(k,))
+                       for k in range(clients)]
+            t_start = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t_start
+            total = sum(len(v) for v in per_model.values())
+            row = {"clients": clients, "requests": total,
+                   "errors": errors[0], "retries": retries[0],
+                   "img_per_sec": round(total / elapsed, 1),
+                   "models": {}}
+            for name in models:
+                lat = np.asarray(per_model[name]) * 1e3
+                if not len(lat):
+                    row["models"][name] = {"requests": 0}
+                    continue
+                row["models"][name] = {
+                    "requests": int(len(lat)),
+                    "share": round(len(lat) / max(1, total), 3),
+                    "p50_ms": round(float(np.percentile(lat, 50)), 2),
+                    "p95_ms": round(float(np.percentile(lat, 95)), 2),
+                    "p99_ms": round(float(np.percentile(lat, 99)), 2)}
+            points.append(row)
+        stats = plane.stats()
+    finally:
+        plane.stop()
+    cstats = stats["cache"]
+    lookups = cstats["hits"] + cstats["misses"]
+    out = {"metric": "serve_mix_img_per_sec",
+           "value": points[-1]["img_per_sec"], "unit": "img/s",
+           "models": list(models), "zipf_s": zipf_s,
+           "hbm_budget_mb": hbm_budget_mb,
+           "max_batch": max_batch, "max_wait_ms": max_wait_ms,
+           "pipeline_depth": pipeline_depth,
+           "loads": points,
+           "cache": {
+               "budget_bytes": cstats["budget_bytes"],
+               "resident_bytes": cstats["resident_bytes"],
+               "hits": cstats["hits"], "misses": cstats["misses"],
+               "hit_rate": round(cstats["hits"] / lookups, 3)
+               if lookups else None,
+               "evictions": cstats["evictions"],
+               "admits": cstats["admits"],
+               "over_budget": cstats["over_budget"],
+               "spilled_mib": round(
+                   cstats["spilled_bytes_total"] / 2**20, 3),
+               "models": cstats["models"]},
+           "plane": stats["plane"],
+           "engines": {
+               name: {"batches": m["engine"]["batches"],
+                      "compiles": m["engine"]["compiles"],
+                      "served": m["engine"]["served"],
+                      "admitted": m["engine"]["admission"]["admitted"]}
+               for name, m in stats["models"].items()},
+           "device_kind": jax.devices()[0].device_kind}
+    return out
+
+
 def bench_gateway(model_name: str = "lenet5", loads: tuple = (1, 8),
                   duration_s: float = 2.0, max_batch: int = 8,
                   max_wait_ms: float = 2.0, pipeline_depth: int = 2,
@@ -1370,6 +1553,21 @@ def main():
                    default="float32",
                    help="on-device compute dtype for a single --serve "
                         "run (outputs stay float32)")
+    p.add_argument("--serve-mix", action="store_true",
+                   help="multi-model mix bench: every --serve-mix-models "
+                        "config behind one control plane sharing a "
+                        "--hbm-budget-mb weight cache, Zipf-distributed "
+                        "model popularity; per-model p99 + cache hit "
+                        "rate per load point (docs/SERVING.md)")
+    p.add_argument("--serve-mix-models", default="lenet5,yolov3_toy",
+                   help="comma-separated configs for --serve-mix "
+                        "(list order = popularity rank)")
+    p.add_argument("--hbm-budget-mb", type=float, default=0.0,
+                   help="weight-cache device-byte budget for "
+                        "--serve-mix (0 = uncapped)")
+    p.add_argument("--zipf-s", type=float, default=1.1,
+                   help="Zipf exponent for --serve-mix model "
+                        "popularity (higher = hotter head)")
     p.add_argument("--gateway", action="store_true",
                    help="gateway failover bench: backend serve stacks "
                         "behind serve/gateway.py, HTTP clients through "
@@ -1418,6 +1616,15 @@ def main():
     if args.live_gan:
         print(json.dumps(bench_cyclegan_live(steps=args.steps or 20,
                                              batch=args.batch or 1)))
+        return
+    if args.serve_mix:
+        print(json.dumps(bench_serve_mix(
+            models=tuple(m.strip() for m in
+                         args.serve_mix_models.split(",") if m.strip()),
+            loads=tuple(int(c) for c in args.serve_loads.split(",")),
+            duration_s=args.serve_duration, max_batch=args.batch or 8,
+            pipeline_depth=args.serve_pipeline_depth,
+            hbm_budget_mb=args.hbm_budget_mb, zipf_s=args.zipf_s)))
         return
     if args.gateway:
         print(json.dumps(bench_gateway(

@@ -29,6 +29,13 @@
     python -m deep_vision_tpu.cli.serve -m resnet50 --workdir runs/r50 \\
         --shard-batches --max-batch 256
 
+    # multi-model: serve the zoo behind one process with the model
+    # control plane — per-model workdir subdirs, an HBM weight-cache
+    # budget, and hot-reload/canary lifecycle endpoints
+    # (docs/SERVING.md "Model lifecycle & weight cache")
+    python -m deep_vision_tpu.cli.serve --models lenet5,yolov3_toy \\
+        --workdir runs --hbm-budget-mb 512 --canary-frac 0.1
+
 Knobs and architecture: docs/SERVING.md.  Smoke: ``make serve-smoke``;
 chaos suite: ``make serve-chaos``.
 """
@@ -64,6 +71,14 @@ def build_server(args):
     # host-normalized contract (docs/SERVING.md "Wire format")
     wire_dtype = getattr(args, "wire_dtype", "uint8") or "uint8"
     infer_dtype = getattr(args, "infer_dtype", "float32") or "float32"
+    models_arg = getattr(args, "models", None)
+    if models_arg:
+        if args.stablehlo:
+            raise ValueError("--stablehlo serves one exported blob; "
+                             "multi-model serving (--models) is "
+                             "checkpoint-path only")
+        return _build_plane_server(args, registry, wire_dtype,
+                                   infer_dtype)
     if args.stablehlo:
         if infer_dtype != "float32":
             raise ValueError(
@@ -141,11 +156,131 @@ def build_server(args):
     return engine, server
 
 
+def _build_plane_server(args, registry, wire_dtype: str,
+                        infer_dtype: str):
+    """``--models a,b,c`` → (ModelControlPlane, ServeServer).
+
+    Per-model checkpoints restore from ``<workdir>/<name>`` subdirs
+    (the multi-model workdir layout); every model's engine is built by
+    one shared factory so hot-reloaded versions boot the same wiring as
+    the originals.  The returned plane exposes the engine surface
+    ``main()`` prints and stops through (``model``/``buckets``/
+    ``faults``/``stop``)."""
+    import os
+
+    from deep_vision_tpu.obs.trace import Tracer
+    from deep_vision_tpu.serve.admission import AdmissionController
+    from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.faults import FaultPlane
+    from deep_vision_tpu.serve.http import ServeServer
+    from deep_vision_tpu.serve.models import (
+        CanaryPolicy,
+        ModelControlPlane,
+        WeightCache,
+    )
+    from deep_vision_tpu.serve.replicas import (
+        ReplicatedEngine,
+        local_devices,
+    )
+
+    names = [s.strip() for s in args.models.split(",") if s.strip()]
+    if not names:
+        raise ValueError("--models needs at least one config name")
+    buckets = [int(b) for b in args.buckets.split(",")] if args.buckets \
+        else None
+    fault_spec = getattr(args, "faults", None)
+    faults = FaultPlane(fault_spec, getattr(args, "fault_seed", 0)) \
+        if fault_spec else None
+    serve_devices = int(getattr(args, "serve_devices", 1))
+    if getattr(args, "shard_batches", False):
+        raise ValueError("--shard-batches is single-model only; "
+                         "--models replicates per engine instead "
+                         "(--serve-devices N)")
+    devices = local_devices(serve_devices or None) \
+        if serve_devices != 1 else None
+    tracer = Tracer(ring=getattr(args, "trace_ring", 256),
+                    slow_ms=getattr(args, "slow_trace_ms", 250.0),
+                    enabled=not getattr(args, "no_trace", False))
+    engine_kwargs = dict(
+        max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        buckets=buckets, tracer=tracer,
+        pipeline_depth=getattr(args, "pipeline_depth", 2),
+        faults=faults,
+        watchdog_interval_s=getattr(args, "watchdog_interval_ms", 50.0)
+        / 1e3,
+        restart_budget=getattr(args, "restart_budget", 3),
+        exec_timeout_k=getattr(args, "exec_timeout_k", 10.0),
+        exec_timeout_min_s=getattr(args, "exec_timeout_min_s", 2.0),
+        retry_budget=getattr(args, "retry_budget", 16),
+        degraded_after=getattr(args, "degraded_after", 1),
+        dead_after=getattr(args, "dead_after", 5))
+
+    # one admission controller per model NAME, shared across its
+    # versions: the per-bucket exec EWMAs and queue accounting survive a
+    # hot reload instead of resetting with each new engine
+    admissions: dict = {}
+
+    def admission_for(name: str) -> AdmissionController:
+        adm = admissions.get(name)
+        if adm is None:
+            adm = admissions[name] = AdmissionController(
+                max_queue=args.max_queue,
+                max_wait_ms=args.max_wait_ms, name=name)
+        return adm
+
+    def engine_factory(model):
+        kwargs = dict(engine_kwargs,
+                      admission=admission_for(model.name))
+        if devices is not None and len(devices) > 1:
+            return ReplicatedEngine(model, devices=devices, **kwargs)
+        return BatchingEngine(model, **kwargs)
+
+    cache = WeightCache(
+        int(float(getattr(args, "hbm_budget_mb", 0) or 0) * 2**20))
+    policy = CanaryPolicy(
+        canary_frac=float(getattr(args, "canary_frac", 0.1)),
+        min_requests=int(getattr(args, "canary_min_requests", 20)),
+        max_error_rate=float(getattr(args, "canary_max_error_rate",
+                                     0.0)),
+        max_p99_ratio=float(getattr(args, "canary_max_p99_ratio", 3.0)),
+        shadow_frac=float(getattr(args, "shadow_frac", 0.0)),
+        phase_timeout_s=float(getattr(args, "phase_timeout_s", 30.0)))
+    plane = ModelControlPlane(registry, engine_factory, cache=cache,
+                              policy=policy,
+                              admission_factory=admission_for)
+    for name in names:
+        workdir = os.path.join(args.workdir, name)
+        sm = registry.load_checkpoint(name, workdir,
+                                      wire_dtype=wire_dtype,
+                                      infer_dtype=infer_dtype)
+        plane.deploy(sm, workdir=workdir)
+    if args.warmup:
+        for name, eng in plane.active_engines().items():
+            print(f"[serve] warming {name} {eng.buckets} ...")
+        plane.warmup()
+    socket_timeout_s = getattr(args, "socket_timeout_s", 30.0)
+    server = ServeServer(
+        registry, plane.active_engines(), host=args.host,
+        port=args.port, verbose=args.verbose,
+        max_body_bytes=int(getattr(args, "max_body_mb", 32) * 2**20),
+        socket_timeout_s=socket_timeout_s if socket_timeout_s > 0
+        else None,
+        tracer=tracer, plane=plane)
+    return plane, server
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="deep_vision_tpu dynamic-batching inference server")
-    p.add_argument("-m", "--model", required=True,
-                   help="config name (see cli.train --list)")
+    p.add_argument("-m", "--model", default=None,
+                   help="config name (see cli.train --list); required "
+                        "unless --models boots the multi-model plane")
+    p.add_argument("--models", default=None,
+                   help="comma-separated config names: serve several "
+                        "models behind one process via the model "
+                        "control plane (versioned table, weight cache, "
+                        "hot reload; docs/SERVING.md).  Checkpoints "
+                        "restore from <workdir>/<name> subdirs")
     p.add_argument("--workdir", required=True,
                    help="training workdir (checkpoint restore; also "
                         "supplies variables for --stablehlo)")
@@ -225,6 +360,34 @@ def main(argv=None):
                         "(healthz 503)")
     p.add_argument("--dead-after", type=int, default=5,
                    help="consecutive batch failures before DEAD")
+    # -- model control plane (docs/SERVING.md "Model lifecycle") --
+    p.add_argument("--hbm-budget-mb", type=float, default=0.0,
+                   help="device-memory byte budget for the weight "
+                        "cache: least-recently-served models spill "
+                        "their params to host RAM and re-admit on "
+                        "demand (0 = unbounded; --models only)")
+    p.add_argument("--canary-frac", type=float, default=0.1,
+                   help="fraction of live traffic a reloading version "
+                        "serves while in CANARY (deterministic every "
+                        "1/frac-th request)")
+    p.add_argument("--canary-min-requests", type=int, default=20,
+                   help="canary answers required before the promote "
+                        "gates are judged")
+    p.add_argument("--canary-max-error-rate", type=float, default=0.0,
+                   help="auto-rollback when the canary error rate "
+                        "(failures, quarantines, NaN outputs) exceeds "
+                        "this")
+    p.add_argument("--canary-max-p99-ratio", type=float, default=3.0,
+                   help="auto-rollback when canary p99 latency exceeds "
+                        "this multiple of the active version's")
+    p.add_argument("--shadow-frac", type=float, default=0.0,
+                   help="before CANARY, duplicate this fraction of live "
+                        "requests onto the candidate, compare top-1 "
+                        "agreement, and DISCARD the outputs (0 skips "
+                        "the shadow phase)")
+    p.add_argument("--phase-timeout-s", type=float, default=30.0,
+                   help="max seconds a shadow/canary phase may wait for "
+                        "its request quota before rolling back")
     p.add_argument("--drain-deadline", type=float, default=5.0,
                    help="shutdown grace: reject new submits immediately, "
                         "finish admitted work up to this many seconds")
@@ -251,6 +414,8 @@ def main(argv=None):
                         "(tracing costs ~one dict per request; this "
                         "removes even that)")
     args = p.parse_args(argv)
+    if not args.model and not args.models:
+        p.error("one of -m/--model or --models is required")
 
     from deep_vision_tpu.core.compile_cache import enable_compile_cache
     from deep_vision_tpu.obs.log import configure_logging
@@ -259,12 +424,22 @@ def main(argv=None):
     enable_compile_cache()
     engine, server = build_server(args)
     sm = engine.model
-    print(f"[serve] {args.model} listening on "
+    served = args.models or args.model
+    print(f"[serve] {served} listening on "
           f"http://{server.host}:{server.port} "
           f"(buckets={engine.buckets}, max_wait={args.max_wait_ms}ms, "
           f"max_queue={args.max_queue}, "
           f"pipeline_depth={engine.pipeline_depth}, "
           f"wire={sm.wire_dtype}, infer={sm.infer_dtype})")
+    if args.models:
+        budget = getattr(args, "hbm_budget_mb", 0.0)
+        print(f"[serve] model control plane: {served} "
+              f"(hbm_budget={budget or 'unbounded'}"
+              f"{'MB' if budget else ''}, "
+              f"canary_frac={args.canary_frac}, "
+              f"shadow_frac={args.shadow_frac}) — reload: curl -XPOST "
+              f"http://{server.host}:{server.port}"
+              f"/v1/models/<name>/reload")
     if hasattr(engine, "replicas"):
         print(f"[serve] {len(engine.replicas)} replicas: "
               + ", ".join(r.model.placement_desc() or "default"

@@ -13,12 +13,61 @@ steps newest-first and falls back to the previous step, logging which
 step was actually restored.  Callers that need the answer
 programmatically pass ``info={}`` and read ``info["step"]`` /
 ``info["fallback"]`` back (serve/registry.py surfaces it per model).
+
+The model control plane (serve/models.py) additionally needs to answer
+"did the trainer publish a new step?" WITHOUT paying a full restore:
+``checkpoint_fingerprint(workdir)`` walks the same directories and
+returns (newest step, source dir, dir mtime) from filesystem metadata
+alone, and ``load_state`` stamps ``info["mtime"]`` (checkpoint dir
+mtime) plus ``info["digest"]`` (a cheap tree-reduced byte hash of the
+restored params) so a version's identity survives into ``describe()``.
 """
 
 from __future__ import annotations
 
 import functools
 import os
+
+
+def params_digest(params) -> str:
+    """Cheap tree-reduced byte hash of a params pytree: leaf shapes +
+    raw bytes folded through one blake2b.  Deterministic for a given
+    tree (leaf order is the pytree flatten order), collision-safe
+    enough to answer "are these the same weights?" for reload
+    detection — NOT a cryptographic artifact signature."""
+    import hashlib
+
+    import jax
+    import numpy as np
+
+    h = hashlib.blake2b(digest_size=8)
+    for leaf in jax.tree_util.tree_leaves(params):
+        a = np.asarray(leaf)
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def checkpoint_fingerprint(workdir: str) -> dict:
+    """Filesystem-only "new step published?" probe: the newest retained
+    step under ``checkpoints_best``/``checkpoints`` (same preference
+    order as ``load_state``), its source dir, and that dir's mtime —
+    no checkpoint bytes are read, so the control plane can poll this
+    per reload request without touching the restore path.  Returns
+    ``{"step": None, "dir": None, "mtime": None}`` for a workdir with
+    no checkpoints (the random-init fixture path)."""
+    from deep_vision_tpu.core import checkpoint as ckpt_lib
+
+    for sub in ("checkpoints_best", "checkpoints"):
+        d = os.path.join(workdir, sub)
+        if not os.path.isdir(d):
+            continue
+        steps = ckpt_lib.Checkpointer(d).all_steps()
+        if steps:
+            return {"step": max(steps), "dir": d,
+                    "mtime": os.path.getmtime(d)}
+    return {"step": None, "dir": None, "mtime": None}
 
 
 def load_state(cfg, workdir, *, log=print, tag: str = "restore",
@@ -31,8 +80,10 @@ def load_state(cfg, workdir, *, log=print, tag: str = "restore",
     checkpoint fails to restore, and to a fresh random init (with a
     warning) when no restorable checkpoint exists — the synthetic /
     smoke-test path.  ``info`` (optional dict) receives ``step`` (the
-    step actually restored, None for random init), ``dir``, and
-    ``fallback`` (True when an earlier step than the newest was used).
+    step actually restored, None for random init), ``dir``, ``fallback``
+    (True when an earlier step than the newest was used), ``mtime``
+    (the checkpoint dir's mtime, None for random init), and ``digest``
+    (``params_digest`` of the restored weights).
     """
     import jax
     import jax.numpy as jnp
@@ -91,7 +142,9 @@ def load_state(cfg, workdir, *, log=print, tag: str = "restore",
                     f"falling back to the previous retained step")
                 continue
             fallback = step != steps[0]
-            info.update({"step": step, "dir": d, "fallback": fallback})
+            info.update({"step": step, "dir": d, "fallback": fallback,
+                         "mtime": os.path.getmtime(d),
+                         "digest": params_digest(state.params)})
             log(f"[{tag}] restored from {d} step {step}"
                 + (f" ({how})" if how else "")
                 + (" [FALLBACK: newer step was corrupt]" if fallback
@@ -101,7 +154,8 @@ def load_state(cfg, workdir, *, log=print, tag: str = "restore",
             log(f"[{tag}] WARNING: every retained checkpoint under {d} "
                 f"failed to restore; trying the next source")
     state = fresh_state()
-    info.update({"step": None, "dir": None, "fallback": False})
+    info.update({"step": None, "dir": None, "fallback": False,
+                 "mtime": None, "digest": params_digest(state.params)})
     log(f"[{tag}] WARNING: no restorable checkpoint found, "
         f"using random init")
     return model, state

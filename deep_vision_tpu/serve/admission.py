@@ -53,8 +53,15 @@ class Shed:
 
 
 class AdmissionController:
+    """``name`` tags the controller with the model it accounts for: the
+    control plane (serve/models.py) shares ONE controller across every
+    version of one model name, so the per-bucket EWMAs — and the
+    admitted/shed counters — survive a hot reload instead of resetting
+    with the new version's engine."""
+
     def __init__(self, max_queue: int = 256, max_wait_ms: float = 5.0,
-                 ewma_alpha: float = 0.2):
+                 ewma_alpha: float = 0.2, name: str | None = None):
+        self.name = name
         self.max_queue = max_queue
         self._max_wait_s = max_wait_ms / 1e3
         self._alpha = ewma_alpha
@@ -67,6 +74,7 @@ class AdmissionController:
         self._lock = new_lock("serve.admission.AdmissionController._lock")
         self.shed_queue_full = 0  # guarded-by: _lock
         self.shed_deadline = 0  # guarded-by: _lock
+        self.admitted = 0  # guarded-by: _lock
         # edge-triggered overload logging: one line when queue_full
         # shedding STARTS, one when an admit clears it — never a line
         # per shed request (a saturated engine must not also saturate
@@ -158,6 +166,16 @@ class AdmissionController:
                             f"deadline in {(deadline - now) * 1e3:.1f}ms")
         return None
 
+    def record_admit(self):
+        """Count one admitted request (called by the engine AFTER a None
+        verdict from ``admit`` — the controller can't count it itself
+        because ``admit`` doesn't know whether the caller enqueued).
+        Per-model queue accounting for the control plane: admitted −
+        served across every version of a name = requests the plane owes
+        an answer."""
+        with self._lock:
+            self.admitted += 1
+
     def expired(self, deadline: float | None,
                 now: float | None = None) -> Shed | None:
         """Batch-formation-time re-check: queued past its deadline?"""
@@ -175,11 +193,15 @@ class AdmissionController:
     def stats(self) -> dict:
         n = self._replica_divisor()  # outside the lock, see above
         with self._lock:
-            return {"shed_queue_full": self.shed_queue_full,
-                    "shed_deadline": self.shed_deadline,
-                    "exec_ewma_ms": (self._exec_ewma_s or 0.0) * 1e3,
-                    "exec_ewma_ms_by_bucket": {
-                        str(b): round(v * 1e3, 3)
-                        for b, v in sorted(self._bucket_ewma_s.items())},
-                    "free_replicas": n,
-                    "max_queue": self.max_queue}
+            out = {"shed_queue_full": self.shed_queue_full,
+                   "shed_deadline": self.shed_deadline,
+                   "admitted": self.admitted,
+                   "exec_ewma_ms": (self._exec_ewma_s or 0.0) * 1e3,
+                   "exec_ewma_ms_by_bucket": {
+                       str(b): round(v * 1e3, 3)
+                       for b, v in sorted(self._bucket_ewma_s.items())},
+                   "free_replicas": n,
+                   "max_queue": self.max_queue}
+        if self.name is not None:
+            out["name"] = self.name
+        return out

@@ -224,7 +224,8 @@ class BatchingEngine:
                  degraded_after: int = 1, dead_after: int = 5,
                  external_batcher: bool = False,
                  rescue=None,
-                 tracer: Tracer | None = None):
+                 tracer: Tracer | None = None,
+                 validate_outputs: bool | None = None):
         self.model = model
         if model.fixed_batch is not None:
             # a StableHLO blob serves exactly its traced shapes; an
@@ -270,8 +271,12 @@ class BatchingEngine:
         self.singleton_retries = singleton_retries
         self.retry_backoff_ms = retry_backoff_ms
         self.retry_backoff_max_ms = retry_backoff_max_ms
-        # NaN-output validation only costs when the fault plane is live
-        self._validate = self.faults.enabled
+        # NaN-output validation only costs when the fault plane is live;
+        # validate_outputs=False opts out even then (the control plane's
+        # canary gate wants a fault-injected "bad" version to SERVE its
+        # NaNs so the gate — not the engine — catches them)
+        self._validate = self.faults.enabled \
+            if validate_outputs is None else bool(validate_outputs)
         # replica mode (serve/replicas.py): the ReplicatedEngine owns
         # the queue + batch formation and feeds formed cohorts through
         # dispatch_cohort(); no batcher thread runs here and the
@@ -440,6 +445,7 @@ class BatchingEngine:
                 span.note("shed", shed.reason)
             fut.set_result(shed)
             return fut
+        self.admission.record_admit()
         poison = self.faults.mark_poison() if self.faults.enabled else False
         if span is not None:
             span.mark("admit")
@@ -1000,6 +1006,7 @@ class BatchingEngine:
                     self._last_done is not None:
                 span = self._last_done - self._first_dispatch
             out = {"model": self.model.name,
+                   "version": getattr(self.model, "serve_version", None),
                    "submitted": self.submitted,
                    "served": self.served,
                    "batches": self.batches,

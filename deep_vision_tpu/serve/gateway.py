@@ -140,8 +140,21 @@ class Backend:
         self.half_open_trials = 0  # guarded-by: _lock
         self.last_probe_at: float | None = None  # guarded-by: _lock
         self.last_error: str | None = None  # guarded-by: _lock
+        # model names this backend reports serving (from its healthz
+        # payload); empty until the first 200 probe — an empty list
+        # routes everything, so a pre-probe gateway still forwards
+        self.models: list[str] = []  # guarded-by: _lock
 
     # -- routing gate ------------------------------------------------------
+
+    def serves(self, model: str | None) -> bool:
+        """Does this backend serve ``model``?  None (no path param) and
+        an un-probed backend (empty list) both route — the backend
+        itself 404s a truly unknown model."""
+        if model is None:
+            return True
+        with self._lock:
+            return not self.models or model in self.models
 
     def routable(self, now: float | None = None) -> bool:
         """May the router send this backend a request right now?  OPEN →
@@ -227,11 +240,13 @@ class Backend:
             self._failure_locked(err, time.monotonic()
                                  if now is None else now)
 
-    def probe_ok(self, now: float):
+    def probe_ok(self, now: float, models: list[str] | None = None):
         with self._lock:
             self.probes += 1
             self.last_probe_at = now
             self.unavailable = None
+            if models is not None:
+                self.models = list(models)
             self.consecutive_failures = 0
             if self.breaker == CLOSED:
                 self.state = OK
@@ -280,7 +295,8 @@ class Backend:
                 "half_open_trials": self.half_open_trials,
                 "last_probe_age_s": round(now - self.last_probe_at, 4)
                 if self.last_probe_at is not None else None,
-                "last_error": self.last_error}
+                "last_error": self.last_error,
+                "models": list(self.models)}
 
 
 class _Outcome:
@@ -398,7 +414,14 @@ class Gateway:
                 b.probe_failure(f"probe: {type(e).__name__}: {e}", now)
                 continue
             if status == 200:
-                b.probe_ok(now)
+                models = None
+                try:
+                    doc = json.loads(payload)
+                    if isinstance(doc.get("models"), list):
+                        models = [str(m) for m in doc["models"]]
+                except (ValueError, AttributeError):
+                    pass
+                b.probe_ok(now, models=models)
             else:
                 reason = "unavailable"
                 try:
@@ -448,10 +471,20 @@ class Gateway:
         except (ValueError, TypeError):
             return payload  # not JSON: leave the body alone
 
+    @staticmethod
+    def _path_model(path: str) -> str | None:
+        """The model name a /v1/models/<name>/<verb> path routes on
+        (None for the classic un-named routes)."""
+        parts = path.partition("?")[0].split("/")
+        if len(parts) == 5 and parts[1] == "v1" and parts[2] == "models":
+            return parts[3]
+        return None
+
     # dvtlint: hot
     def _forward(self, path: str, body: bytes, rid: str, span
                  ) -> tuple[int, dict, bytes]:
         t0 = time.monotonic()
+        model = self._path_model(path)
         with self._lock:
             self.proxied += 1
         tried: list[Backend] = []
@@ -459,13 +492,13 @@ class Gateway:
         last_fail: _Outcome | None = None
         prev: Backend | None = None
         for attempt in range(1 + self.retry_budget):
-            b = self._pick(tried)
+            b = self._pick(tried, model)
             if b is None and tried:
                 # every routable backend failed this request once —
                 # clear the exclusions so the backoff'd retry may
                 # revisit (a transient blip shouldn't 502 the client)
                 tried = []
-                b = self._pick(tried)
+                b = self._pick(tried, model)
             if b is None:
                 break
             if attempt > 0:
@@ -501,7 +534,7 @@ class Gateway:
                 last_shed = out
                 if span is not None:
                     span.note("shed", out.backend.name)
-                if self._pick(tried) is None:
+                if self._pick(tried, model) is None:
                     break  # nobody with headroom: propagate the 429
             else:
                 last_fail = out
@@ -531,11 +564,14 @@ class Gateway:
         return {k: out.headers[k] for k in _PROXY_HEADERS
                 if k in out.headers}
 
-    def _pick(self, exclude: list) -> Backend | None:  # dvtlint: hot
+    def _pick(self, exclude: list,
+              model: str | None = None) -> Backend | None:  # dvtlint: hot
         """Least outstanding work (outstanding × latency EWMA) over
         routable backends, scanning from a rotating offset with strict
         less-than — an idle fleet round-robins instead of piling onto
-        backend 0 (same policy as serve/replicas.py)."""
+        backend 0 (same policy as serve/replicas.py).  ``model``
+        (from a /v1/models/<name>/... path) filters to backends whose
+        probed model list serves it."""
         now = time.monotonic()
         n = len(self.backends)
         with self._lock:
@@ -544,7 +580,8 @@ class Gateway:
         best = best_score = None
         for k in range(n):
             b = self.backends[(start + k) % n]
-            if b in exclude or not b.routable(now):
+            if b in exclude or not b.routable(now) \
+                    or not b.serves(model):
                 continue
             score = b.score()
             if best_score is None or score < best_score:
@@ -571,7 +608,7 @@ class Gateway:
         done, _ = wait([primary], timeout=delay_s)
         if done:
             return primary.result()
-        b2 = self._pick([b])
+        b2 = self._pick([b], self._path_model(path))
         if b2 is None:
             return primary.result()  # nobody to hedge to: just wait
         with self._lock:
@@ -714,7 +751,7 @@ class Gateway:
                 except (OSError, HTTPException, ValueError) as e:
                     agg[b.name] = {"error": f"{type(e).__name__}: {e}"}
             out["backends"] = agg
-            merged, mfu = self._aggregate_backends(agg)
+            merged, mfu, per_model = self._aggregate_backends(agg)
             # fleet-level latency DISTRIBUTION: per-backend histogram
             # states sum bin-wise (identical fixed edges), so the p99
             # here is the true fleet p99 — not an average of per-backend
@@ -724,25 +761,44 @@ class Gateway:
             out["gateway"]["backend_latency_hist"] = \
                 merged.state_dict() if merged is not None else None
             out["gateway"]["mfu"] = mfu
+            out["gateway"]["models"] = per_model
         return out
+
+    @staticmethod
+    def _iter_engine_stats(bstats: dict):
+        """Yield (model_name, engine_stats) from one backend's /v1/stats
+        body — BOTH shapes: the legacy flat {name: engine.stats()} dict
+        and the control-plane shape {"models": {name: {"engine": ...}},
+        "cache": ..., "plane": ...}."""
+        containers = bstats.get("models") \
+            if isinstance(bstats.get("models"), dict) else bstats
+        for name, mstats in containers.items():
+            if not isinstance(mstats, dict):
+                continue
+            es = mstats.get("engine") \
+                if isinstance(mstats.get("engine"), dict) else mstats
+            if isinstance(es, dict) and "latency_hist" in es:
+                yield name, es
 
     @staticmethod
     def _aggregate_backends(agg: dict):
         """Fold fetched backend /v1/stats into fleet-level views: one
-        merged ``LatencyHistogram`` and one MFU report (FLOPs and
-        compute seconds sum across backends, MFU recomputes from the
-        sums — a throughput-weighted aggregate by construction)."""
+        merged ``LatencyHistogram``, one MFU report (FLOPs and compute
+        seconds sum across backends, MFU recomputes from the sums — a
+        throughput-weighted aggregate by construction), and a per-model
+        cross-backend table (served counts, merged-latency percentiles,
+        which backends serve it)."""
         merged: LatencyHistogram | None = None
         flops = secs = 0.0
         batches = images = 0
         peak = None
         source = None
-        for bstats in agg.values():
+        per_model: dict = {}
+        model_hists: dict = {}
+        for bname, bstats in agg.items():
             if not isinstance(bstats, dict) or "error" in bstats:
                 continue
-            for mstats in bstats.values():
-                if not isinstance(mstats, dict):
-                    continue
+            for name, mstats in Gateway._iter_engine_stats(bstats):
                 hist = mstats.get("latency_hist")
                 if hist:
                     try:
@@ -751,8 +807,19 @@ class Gateway:
                             merged.load_state_dict(hist)
                         else:
                             merged.merge(hist)
+                        mh = model_hists.get(name)
+                        if mh is None:
+                            mh = model_hists[name] = LatencyHistogram()
+                            mh.load_state_dict(hist)
+                        else:
+                            mh.merge(hist)
                     except (KeyError, ValueError, TypeError):
                         pass  # malformed or mismatched bins: skip
+                ent = per_model.setdefault(
+                    name, {"served": 0, "submitted": 0, "backends": []})
+                ent["served"] += int(mstats.get("served") or 0)
+                ent["submitted"] += int(mstats.get("submitted") or 0)
+                ent["backends"].append(bname)
                 m = mstats.get("mfu") or {}
                 flops += float(m.get("flops_total") or 0.0)
                 secs += float(m.get("compute_s") or 0.0)
@@ -762,13 +829,15 @@ class Gateway:
                     peak = m.get("peak_flops_per_s")
                 if source is None:
                     source = m.get("flops_source")
+        for name, mh in model_hists.items():
+            per_model[name]["latency"] = mh.percentiles()
         mfu_val = flops / secs / peak \
             if secs > 0 and flops > 0 and peak else None
         mfu = {"serving_mfu": round_mfu(mfu_val),
                "flops_total": flops, "compute_s": round(secs, 6),
                "batches": batches, "images": images,
                "peak_flops_per_s": peak, "flops_source": source}
-        return merged, mfu
+        return merged, mfu, per_model
 
 
 def render_gateway_metrics(gw: Gateway) -> str:
@@ -905,7 +974,19 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         self._rid = self.headers.get(REQUEST_ID_HEADER) \
             or new_request_id()
         try:
-            if path not in ("/v1/classify", "/v1/detect"):
+            # /v1/models/<name>/classify|detect route on the path's
+            # model (the gateway filters to backends probing that
+            # name); lifecycle verbs forward to EVERY backend serving
+            # it — a reload must reach the whole fleet, not one member
+            parts = path.split("/")
+            model_route = (len(parts) == 5 and parts[1] == "v1"
+                           and parts[2] == "models")
+            if model_route and parts[4] in ("reload", "promote",
+                                            "rollback"):
+                self._lifecycle_fanout(gw, parts[3], parts[4])
+                return
+            if path not in ("/v1/classify", "/v1/detect") and not (
+                    model_route and parts[4] in ("classify", "detect")):
                 self._reply(404, {"error": f"no route {self.path}"})
                 return
             length = int(self.headers.get("Content-Length") or 0)
@@ -931,6 +1012,39 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             self._reply(500, {"error": f"{type(e).__name__}: {e}"})
         finally:
             self._rid = None
+
+    def _lifecycle_fanout(self, gw: Gateway, name: str, verb: str):
+        """POST /v1/models/<name>/<verb> to every routable backend that
+        serves ``name``; the per-backend verdicts come back keyed by
+        backend.  200 when at least one backend accepted."""
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self.rfile.read(length) if length > 0 else b"{}"
+        now = time.monotonic()
+        results: dict = {}
+        any_ok = False
+        for b in gw.backends:
+            if not b.routable(now) or not b.serves(name):
+                continue
+            try:
+                status, _, payload = gw._call(
+                    b, "POST", f"/v1/models/{name}/{verb}", body,
+                    gw.request_timeout_s)
+                try:
+                    doc = json.loads(payload)
+                except ValueError:
+                    doc = {"raw": payload.decode(errors="replace")}
+                results[b.name] = {"status": status, **(
+                    doc if isinstance(doc, dict) else {"body": doc})}
+                any_ok = any_ok or status == 200
+            except (OSError, HTTPException) as e:
+                results[b.name] = {"status": None,
+                                   "error": f"{type(e).__name__}: {e}"}
+        if not results:
+            self._reply(503, {"error": f"no routable backend serves "
+                                       f"'{name}'"})
+            return
+        self._reply(200 if any_ok else 502,
+                    {"model": name, "verb": verb, "backends": results})
 
 
 class GatewayServer:

@@ -26,6 +26,20 @@ Routes (JSON in, JSON out):
     POST /v1/classify  {"pixels": [[...]] | "image_b64": "...",
                         "model"?, "deadline_ms"?, "top_k"?}
     POST /v1/detect    same inputs + "score_threshold"?; YOLO models
+    POST /v1/models/{name}/classify | /detect
+                       same bodies with the model named in the PATH —
+                       the multi-model route (a body "model" key must
+                       match the path or 400)
+    GET  /v1/models    the model table: per name the active version +
+                       full version history (step/digest/state) — the
+                       control-plane listing when ``cli.serve --models``
+                       booted a plane, a flat describe() map otherwise
+    POST /v1/models/{name}/reload | /promote | /rollback
+                       lifecycle endpoints (control plane required, 503
+                       otherwise): reload kicks the background
+                       load → shadow → canary walk (body: {"force"?,
+                       "wait"?}); promote/rollback override the gates on
+                       the in-flight candidate (docs/SERVING.md runbook)
     POST /v1/drain     zero-downtime shutdown hook: healthz flips to
                        503 ``draining`` IMMEDIATELY (so a gateway or
                        load balancer stops routing here), new requests
@@ -159,7 +173,13 @@ def _decode_pixels(body: dict, model):
 
 
 def render_serve_metrics(stats: dict) -> str:
-    """Render per-model ``engine.stats()`` dicts as Prometheus text.
+    """Render serve stats as Prometheus text — both shapes.
+
+    Legacy shape: {model_name: engine.stats()}.  Control-plane shape
+    (serve/models.py ``ModelControlPlane.stats()``): {"models": {name:
+    {"engine": ..., "versions": [...]}}, "cache": ..., "plane": ...} —
+    the plane shape additionally emits ``dvt_serve_model_up`` per
+    version and the ``dvt_serve_weight_cache_*`` series.
 
     No parallel metric registry: the stats dicts stay the single source
     of truth and this snapshots them through ``core.metrics.PromText``
@@ -168,85 +188,145 @@ def render_serve_metrics(stats: dict) -> str:
     from deep_vision_tpu.core.metrics import PromText
 
     p = PromText()
+    if isinstance(stats.get("models"), dict):
+        for name, entry in stats["models"].items():
+            if isinstance(entry.get("engine"), dict):
+                _render_engine_metrics(p, name, entry["engine"])
+            for v in entry.get("versions", []):
+                p.gauge("dvt_serve_model_up",
+                        1 if v.get("state") in ("active", "canary",
+                                                "shadow") else 0,
+                        {"model": name,
+                         "version": str(v.get("version")),
+                         "state": str(v.get("state"))},
+                        help="1 while this model version takes traffic")
+        cache = stats.get("cache")
+        if isinstance(cache, dict):
+            p.gauge("dvt_serve_weight_cache_budget_bytes",
+                    cache.get("budget_bytes"), {},
+                    help="HBM byte budget (0 = unbounded)")
+            p.gauge("dvt_serve_weight_cache_resident_bytes",
+                    cache.get("resident_bytes"), {},
+                    help="Bytes of model weights resident on device")
+            p.counter("dvt_serve_weight_cache_hits_total",
+                      cache.get("hits"), {},
+                      help="Batch dispatches finding weights resident")
+            p.counter("dvt_serve_weight_cache_misses_total",
+                      cache.get("misses"), {},
+                      help="Dispatches that had to re-admit weights")
+            p.counter("dvt_serve_weight_cache_evictions_total",
+                      cache.get("evictions"), {},
+                      help="LRU evictions (weights spilled to host)")
+            p.counter("dvt_serve_weight_cache_admits_total",
+                      cache.get("admits"), {},
+                      help="Host→device weight re-admissions")
+            p.counter("dvt_serve_weight_cache_spilled_bytes_total",
+                      cache.get("spilled_bytes_total"), {},
+                      help="Bytes D2H-copied at first eviction")
+            for mname, ent in (cache.get("models") or {}).items():
+                p.gauge("dvt_serve_weight_cache_resident",
+                        1 if ent.get("resident") else 0,
+                        {"model": mname},
+                        help="1 while this model's weights are on device")
+        plane = stats.get("plane")
+        if isinstance(plane, dict):
+            p.counter("dvt_serve_reloads_total", plane.get("reloads"),
+                      {}, help="Reload lifecycles started")
+            p.counter("dvt_serve_promotions_total",
+                      plane.get("promotions"), {},
+                      help="Versions auto- or operator-promoted")
+            p.counter("dvt_serve_rollbacks_total",
+                      plane.get("rollbacks"), {},
+                      help="Versions rolled back by gates or operator")
+            p.counter("dvt_serve_reload_resubmitted_total",
+                      plane.get("resubmitted"), {},
+                      help="Requests transparently resubmitted across "
+                           "a version swap")
+        return p.render()
     for name, s in stats.items():
-        lab = {"model": name}
-        p.counter("dvt_serve_requests_submitted_total", s["submitted"],
-                  lab, help="Requests entering submit (incl. shed)")
-        p.counter("dvt_serve_requests_served_total", s["served"], lab,
-                  help="Requests served a model output")
-        p.counter("dvt_serve_batches_total", s["batches"], lab,
-                  help="Executed batches (incl. retry executions)")
-        p.counter("dvt_serve_compiles_total", s["compiles"], lab,
-                  help="Bucket program compiles")
-        p.counter("dvt_serve_padded_images_total", s["padded_images"],
-                  lab, help="Pad rows executed beyond live requests")
-        p.gauge("dvt_serve_queue_depth", s["queue_depth"], lab,
-                help="Requests queued awaiting batch formation")
-        adm = s.get("admission", {})
-        h = s.get("health", {})
-        p.counter("dvt_serve_shed_total", adm.get("shed_queue_full"),
-                  {**lab, "reason": "queue_full"},
-                  help="Requests shed at admission or formation")
-        p.counter("dvt_serve_shed_total", adm.get("shed_deadline"),
-                  {**lab, "reason": "deadline"})
-        p.counter("dvt_serve_shed_total", h.get("shed_shutdown"),
-                  {**lab, "reason": "shutdown"})
-        p.counter("dvt_serve_batch_failures_total",
-                  h.get("batch_failures"), lab,
-                  help="Dispatched/drained cohorts that raised")
-        p.counter("dvt_serve_retry_executions_total",
-                  h.get("retry_executions"), lab,
-                  help="Bisect-retry sub-cohort executions")
-        p.counter("dvt_serve_quarantined_total", h.get("quarantined"),
-                  lab, help="Requests isolated as poison")
-        p.counter("dvt_serve_exec_timeouts_total",
-                  h.get("exec_timeouts"), lab,
-                  help="In-flight windows fast-failed by the watchdog")
-        p.counter("dvt_serve_watchdog_restarts_total",
-                  h.get("watchdog_restarts"), lab,
-                  help="Worker-thread restarts by supervision")
-        p.gauge("dvt_serve_up",
-                1 if h.get("can_serve") else 0, lab,
-                help="1 while this engine can serve (healthz 200)")
-        pipe = s.get("pipeline", {})
-        p.gauge("dvt_serve_inflight", pipe.get("inflight"), lab,
-                help="Dispatched-but-undrained batches")
-        p.counter("dvt_serve_h2d_transfers_total",
-                  pipe.get("h2d_transfers"), lab,
-                  help="Staged-batch host-to-device transfers")
-        p.counter("dvt_serve_h2d_bytes_total", pipe.get("h2d_bytes"),
-                  lab, help="Wire-format bytes shipped to the device")
-        for b, ms in (adm.get("exec_ewma_ms_by_bucket") or {}).items():
-            p.gauge("dvt_serve_exec_ewma_seconds", ms / 1e3,
-                    {**lab, "bucket": b},
-                    help="Per-bucket batch execution EWMA")
-        p.gauge("dvt_serve_img_per_sec", s.get("img_per_sec"), lab,
-                help="Served images per second (post-warmup)")
-        if "latency_hist" in s:
-            p.histogram("dvt_serve_request_latency_seconds",
-                        s["latency_hist"], lab,
-                        help="Submit-to-result latency")
-        mfu = s.get("mfu") or {}
-        p.gauge("dvt_serve_mfu", mfu.get("serving_mfu"), lab,
-                help="Model FLOPs utilization of the compute stage "
-                     "(analytic FLOPs / measured compute time / peak)")
-        p.counter("dvt_serve_compute_seconds_total",
-                  mfu.get("compute_s"), lab,
-                  help="Measured device-occupancy seconds")
-        p.counter("dvt_serve_flops_total", mfu.get("flops_total"), lab,
-                  help="Analytic FLOPs executed")
-        tr = s.get("trace") or {}
-        p.counter("dvt_serve_traces_started_total", tr.get("started"),
-                  lab, help="Spans started")
-        p.counter("dvt_serve_traces_finished_total", tr.get("finished"),
-                  lab, help="Spans sealed into the ring")
-        p.counter("dvt_serve_slow_traces_total", tr.get("slow_sampled"),
-                  lab, help="Traces over the slow-request threshold")
-        for stage, secs in (tr.get("stage_s_total") or {}).items():
-            p.counter("dvt_serve_stage_seconds_total", secs,
-                      {**lab, "stage": stage},
-                      help="Cumulative per-stage span time")
+        _render_engine_metrics(p, name, s)
     return p.render()
+
+
+def _render_engine_metrics(p, name: str, s: dict) -> None:
+    """Emit one engine's dvt_serve_* series (shared by both shapes)."""
+    lab = {"model": name}
+    p.counter("dvt_serve_requests_submitted_total", s["submitted"],
+              lab, help="Requests entering submit (incl. shed)")
+    p.counter("dvt_serve_requests_served_total", s["served"], lab,
+              help="Requests served a model output")
+    p.counter("dvt_serve_batches_total", s["batches"], lab,
+              help="Executed batches (incl. retry executions)")
+    p.counter("dvt_serve_compiles_total", s["compiles"], lab,
+              help="Bucket program compiles")
+    p.counter("dvt_serve_padded_images_total", s["padded_images"],
+              lab, help="Pad rows executed beyond live requests")
+    p.gauge("dvt_serve_queue_depth", s["queue_depth"], lab,
+            help="Requests queued awaiting batch formation")
+    adm = s.get("admission", {})
+    h = s.get("health", {})
+    p.counter("dvt_serve_shed_total", adm.get("shed_queue_full"),
+              {**lab, "reason": "queue_full"},
+              help="Requests shed at admission or formation")
+    p.counter("dvt_serve_shed_total", adm.get("shed_deadline"),
+              {**lab, "reason": "deadline"})
+    p.counter("dvt_serve_shed_total", h.get("shed_shutdown"),
+              {**lab, "reason": "shutdown"})
+    p.counter("dvt_serve_batch_failures_total",
+              h.get("batch_failures"), lab,
+              help="Dispatched/drained cohorts that raised")
+    p.counter("dvt_serve_retry_executions_total",
+              h.get("retry_executions"), lab,
+              help="Bisect-retry sub-cohort executions")
+    p.counter("dvt_serve_quarantined_total", h.get("quarantined"),
+              lab, help="Requests isolated as poison")
+    p.counter("dvt_serve_exec_timeouts_total",
+              h.get("exec_timeouts"), lab,
+              help="In-flight windows fast-failed by the watchdog")
+    p.counter("dvt_serve_watchdog_restarts_total",
+              h.get("watchdog_restarts"), lab,
+              help="Worker-thread restarts by supervision")
+    p.gauge("dvt_serve_up",
+            1 if h.get("can_serve") else 0, lab,
+            help="1 while this engine can serve (healthz 200)")
+    pipe = s.get("pipeline", {})
+    p.gauge("dvt_serve_inflight", pipe.get("inflight"), lab,
+            help="Dispatched-but-undrained batches")
+    p.counter("dvt_serve_h2d_transfers_total",
+              pipe.get("h2d_transfers"), lab,
+              help="Staged-batch host-to-device transfers")
+    p.counter("dvt_serve_h2d_bytes_total", pipe.get("h2d_bytes"),
+              lab, help="Wire-format bytes shipped to the device")
+    for b, ms in (adm.get("exec_ewma_ms_by_bucket") or {}).items():
+        p.gauge("dvt_serve_exec_ewma_seconds", ms / 1e3,
+                {**lab, "bucket": b},
+                help="Per-bucket batch execution EWMA")
+    p.gauge("dvt_serve_img_per_sec", s.get("img_per_sec"), lab,
+            help="Served images per second (post-warmup)")
+    if "latency_hist" in s:
+        p.histogram("dvt_serve_request_latency_seconds",
+                    s["latency_hist"], lab,
+                    help="Submit-to-result latency")
+    mfu = s.get("mfu") or {}
+    p.gauge("dvt_serve_mfu", mfu.get("serving_mfu"), lab,
+            help="Model FLOPs utilization of the compute stage "
+                 "(analytic FLOPs / measured compute time / peak)")
+    p.counter("dvt_serve_compute_seconds_total",
+              mfu.get("compute_s"), lab,
+              help="Measured device-occupancy seconds")
+    p.counter("dvt_serve_flops_total", mfu.get("flops_total"), lab,
+              help="Analytic FLOPs executed")
+    tr = s.get("trace") or {}
+    p.counter("dvt_serve_traces_started_total", tr.get("started"),
+              lab, help="Spans started")
+    p.counter("dvt_serve_traces_finished_total", tr.get("finished"),
+              lab, help="Spans sealed into the ring")
+    p.counter("dvt_serve_slow_traces_total", tr.get("slow_sampled"),
+              lab, help="Traces over the slow-request threshold")
+    for stage, secs in (tr.get("stage_s_total") or {}).items():
+        p.counter("dvt_serve_stage_seconds_total", secs,
+                  {**lab, "stage": stage},
+                  help="Cumulative per-stage span time")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -303,23 +383,48 @@ class _Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as e:
             raise ServeError(400, f"bad JSON: {e}") from e
 
-    def _engine(self, body: dict):
+    def _engine(self, body: dict, path_model: str | None = None):
+        """Resolve the target model: the PATH param wins (a body
+        "model" key must agree or 400); the control plane's routing
+        table answers when one is wired, the flat registry otherwise.
+        KeyError text passes through as the 404 body — ``e.args[0]``,
+        not ``str(e)``, because KeyError's str() wraps the message in
+        repr quotes."""
+        name = body.get("model")
+        if path_model is not None:
+            if name is not None and name != path_model:
+                raise ServeError(
+                    400, f"body model '{name}' contradicts path model "
+                         f"'{path_model}'")
+            name = path_model
+        plane = getattr(self.server, "plane", None)
         try:
-            model = self.server.registry.get(body.get("model"))
+            if plane is not None:
+                model = plane.resolve(name)
+                return model, plane.active_engine(model.name)
+            model = self.server.registry.get(name)
         except KeyError as e:
-            raise ServeError(404, str(e)) from e
+            raise ServeError(404, e.args[0]) from e
         return model, self.server.engines[model.name]
 
-    def _infer_row(self, body: dict):
+    def _infer_row(self, body: dict, path_model: str | None = None):
         """Shared classify/detect request path: decode → engine → row."""
-        model, engine = self._engine(body)
+        model, engine = self._engine(body, path_model)
         if engine.faults.enabled:
             engine.faults.inject("decode")
         x = _decode_pixels(body, model)
         if self._span is not None:
             self._span.mark("decode")
-        result = engine.infer(x, deadline_ms=body.get("deadline_ms"),
-                              span=self._span)
+        plane = getattr(self.server, "plane", None)
+        if plane is not None:
+            # plane routing: canary/shadow splits + cross-version
+            # resubmission happen behind this call, not per-engine
+            result = plane.infer(model.name, x,
+                                 deadline_ms=body.get("deadline_ms"),
+                                 span=self._span)
+        else:
+            result = engine.infer(x, deadline_ms=body.get("deadline_ms"),
+                                  span=self._span)
         from deep_vision_tpu.serve.admission import Shed
         from deep_vision_tpu.serve.faults import Quarantined
 
@@ -337,10 +442,20 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- routes ------------------------------------------------------------
 
+    def _live_engines(self) -> dict:
+        """name → the engine taking that model's traffic right now:
+        the plane's ACTIVE versions when one is wired (a mid-reload
+        candidate never answers healthz), the static dict otherwise."""
+        plane = getattr(self.server, "plane", None)
+        if plane is not None:
+            return plane.active_engines()
+        return self.server.engines
+
     def do_GET(self):
         path, _, query = self.path.partition("?")
+        plane = getattr(self.server, "plane", None)
         if path == "/v1/healthz":
-            engines = self.server.engines
+            engines = self._live_engines()
             if getattr(self.server, "draining", False):
                 # draining outranks engine health: traffic must move
                 # away BEFORE the engines finish their in-flight work
@@ -359,12 +474,25 @@ class _Handler(BaseHTTPRequestHandler):
                          "models": self.server.registry.names(),
                          "engines": reports})
         elif path == "/v1/stats":
+            if plane is not None:
+                self._reply(200, plane.stats())
+                return
             self._reply(200, {name: eng.stats()
                               for name, eng in self.server.engines.items()})
+        elif path == "/v1/models":
+            if plane is not None:
+                self._reply(200, {"models": plane.models()})
+                return
+            self._reply(200, {"models": {
+                name: {"model": self.server.registry.get(name).describe()}
+                for name in self.server.registry.names()}})
         elif path == "/metrics":
-            text = render_serve_metrics(
-                {name: eng.stats()
-                 for name, eng in self.server.engines.items()})
+            if plane is not None:
+                stats = plane.stats()
+            else:
+                stats = {name: eng.stats()
+                         for name, eng in self.server.engines.items()}
+            text = render_serve_metrics(stats)
             self._reply_raw(
                 200, text.encode(),
                 "text/plain; version=0.0.4; charset=utf-8")
@@ -393,11 +521,23 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/v1/drain":
                 self._reply(200, self._drain())
                 return
+            path_model = None
+            parts = path.split("/")
+            # /v1/models/<name>/<verb>: the multi-model and lifecycle
+            # routes (the name segment never contains "/")
+            if len(parts) == 5 and parts[1] == "v1" \
+                    and parts[2] == "models":
+                path_model, verb = parts[3], parts[4]
+                if verb in ("reload", "promote", "rollback"):
+                    self._reply(*self._lifecycle(path_model, verb))
+                    return
+                if verb in ("classify", "detect"):
+                    path = f"/v1/{verb}"
             body = self._body()
             if path == "/v1/classify":
-                payload = self._classify(body)
+                payload = self._classify(body, path_model)
             elif path == "/v1/detect":
-                payload = self._detect(body)
+                payload = self._detect(body, path_model)
             else:
                 self._reply(404, {"error": f"no route {self.path}"})
                 return
@@ -438,15 +578,46 @@ class _Handler(BaseHTTPRequestHandler):
             already = getattr(srv, "draining", False)
             srv.draining = True
             if not already:
-                for eng in srv.engines.values():
-                    eng.stop(drain_deadline=deadline)
+                plane = getattr(srv, "plane", None)
+                if plane is not None:
+                    # the plane drains every version (and joins any
+                    # in-flight reload worker) — not just the actives
+                    plane.stop(drain_deadline=deadline)
+                else:
+                    for eng in srv.engines.values():
+                        eng.stop(drain_deadline=deadline)
         return {"status": "draining", "already_draining": already,
                 "drain_deadline_s": deadline}
 
-    def _classify(self, body: dict) -> dict:
+    def _lifecycle(self, name: str, verb: str) -> tuple:
+        """POST /v1/models/<name>/reload|promote|rollback → (status,
+        payload).  Control-plane-only routes: a plain engine dict has
+        no version table to act on."""
+        plane = getattr(self.server, "plane", None)
+        if plane is None:
+            return 503, {"error": f"/v1/models/{name}/{verb} needs the "
+                                  f"model control plane (cli.serve "
+                                  f"--models ...)"}
+        length = int(self.headers.get("Content-Length") or 0)
+        body = self._body() if length > 0 else {}
+        try:
+            if verb == "reload":
+                out = plane.reload(name,
+                                   force=bool(body.get("force", False)),
+                                   wait=bool(body.get("wait", False)))
+            elif verb == "promote":
+                out = plane.promote(name)
+            else:
+                out = plane.rollback(name)
+        except KeyError as e:
+            return 404, {"error": e.args[0]}
+        return (409 if out.get("status") in ("refused", "in_progress")
+                else 200), out
+
+    def _classify(self, body: dict, path_model: str | None = None) -> dict:
         import numpy as np
 
-        model, row = self._infer_row(body)
+        model, row = self._infer_row(body, path_model)
         if model.task != "classification":
             raise ServeError(400, f"'{model.name}' is a {model.task} "
                                   f"model; use /v1/detect")
@@ -459,11 +630,11 @@ class _Handler(BaseHTTPRequestHandler):
                 "top": [{"class": int(c), "prob": float(probs[c]),
                          "logit": float(logits[c])} for c in top]}
 
-    def _detect(self, body: dict) -> dict:
+    def _detect(self, body: dict, path_model: str | None = None) -> dict:
         import jax
         import numpy as np
 
-        model, row = self._infer_row(body)
+        model, row = self._infer_row(body, path_model)
         if model.task != "detection":
             raise ServeError(400, f"'{model.name}' is a {model.task} "
                                   f"model; use /v1/classify")
@@ -489,10 +660,14 @@ class ServeServer:
                  port: int = 0, verbose: bool = False,
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
                  socket_timeout_s: float | None = 30.0,
-                 tracer=None):
+                 tracer=None, plane=None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.registry = registry
         self.httpd.engines = engines
+        # model control plane (serve/models.py): when wired, routing /
+        # stats / lifecycle endpoints go through it; None keeps the
+        # original single-version behaviour byte-for-byte
+        self.httpd.plane = plane
         self.httpd.verbose = verbose
         self.httpd.max_body_bytes = max_body_bytes
         self.httpd.socket_timeout_s = socket_timeout_s

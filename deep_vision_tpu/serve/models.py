@@ -1,0 +1,977 @@
+"""Model control plane: versioned model table, HBM weight cache,
+zero-downtime hot-reload, and canary rollout.
+
+The serving tier below this module (engine/replicas/http/gateway)
+drives exactly one frozen checkpoint per model name, loaded once at
+boot.  This module layers the deployment half on top — the parts that
+let one process serve the whole zoo and take new trainer checkpoints
+without a restart:
+
+  versioned table    每 model name owns an ordered list of
+                     ``ModelVersion``s, each wrapping a ServingModel +
+                     its own engine + checkpoint identity (step, params
+                     digest, checkpoint-dir mtime, load time) and a
+                     lifecycle state;
+  weight cache       ``WeightCache`` — an LRU over device (HBM) bytes
+                     with a configurable budget.  Evicted models spill
+                     their params to host RAM and are ``device_put``
+                     back on demand; per-model bucket AOT programs are
+                     RETAINED across eviction (the engine's executable
+                     dict survives, and registry.compile_bucket late-
+                     binds its variables), so a cache re-admit costs
+                     one H2D transfer, never a recompile;
+  lifecycle          LOADING → SHADOW → CANARY(frac) → ACTIVE →
+                     DRAINING → RETIRED per version.  ``reload()``
+                     re-walks the workdir via core/restore.py in a
+                     background thread, optionally shadows (a sampled
+                     fraction of live requests is duplicated onto the
+                     candidate, top-1 agreement + latency deltas are
+                     recorded, outputs are DISCARDED), then routes a
+                     ``canary_frac`` slice of real traffic to the
+                     candidate and auto-promotes or auto-rolls-back on
+                     the ``CanaryPolicy`` gates (error rate, p99 ratio,
+                     shadow agreement);
+  zero downtime      the old version serves until the new one is
+                     ACTIVE; promote swaps the routing table first and
+                     only then drains the old engine
+                     (``stop(drain_deadline=)`` finishes admitted
+                     work), so in-flight cohorts complete on the
+                     version that admitted them.  A request that races
+                     the swap (admitted-version engine stopped before
+                     its cohort formed) is transparently resubmitted to
+                     the new active — a reload under load loses zero
+                     admitted requests.
+
+Observability: ``stats()`` returns ``{"models": ..., "cache": ...,
+"plane": ...}`` (serve/http.py renders ``dvt_serve_model_up`` and the
+``dvt_serve_weight_cache_*`` series from it); every lock here is a
+``sanitizer.new_lock`` so the chaos suite's lock-order sanitizer covers
+the plane.  Lock order: plane._lock and cache._lock are LEAF locks —
+never held across an engine call (engine submits, stops, and stats all
+happen outside them).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+
+from deep_vision_tpu.analysis.sanitizer import new_lock
+from deep_vision_tpu.obs.log import event, get_logger
+from deep_vision_tpu.serve.admission import Shed
+from deep_vision_tpu.serve.faults import Quarantined
+
+_log = get_logger("dvt.serve.models")
+
+# -- lifecycle states ------------------------------------------------------
+
+LOADING = "loading"
+SHADOW = "shadow"
+CANARY = "canary"
+ACTIVE = "active"
+DRAINING = "draining"
+RETIRED = "retired"
+FAILED = "failed"  # load/warmup raised before the version could serve
+
+#: states in which a version's engine receives live traffic
+_ROUTABLE = (SHADOW, CANARY, ACTIVE)
+
+
+class WeightCache:
+    """LRU over device (HBM) bytes for registered serving models.
+
+    A registered model's variables live in one of two places: resident
+    on device, or spilled to a host-RAM numpy copy.  ``variables_for``
+    is the single hot-path entry (called once per dispatched batch from
+    the bucket program's late-binding closure, registry.py): a resident
+    model is a hit (LRU touch); a spilled one is a miss that admits it
+    — evicting least-recently-used residents until the byte budget
+    holds — via one ``device_put`` of the host copy.  Eviction is safe
+    against in-flight batches: a dispatched program holds Python refs
+    to the variables it was called with, so evicted buffers die only
+    after the last batch using them drains.
+
+    A single model larger than the whole budget still serves: the
+    admit proceeds over budget (counted in ``over_budget``) rather than
+    failing — the budget shapes steady-state residency, it is not an
+    allocation guarantee.  ``budget_bytes <= 0`` means unbounded
+    (residency tracking + counters without eviction).
+    """
+
+    def __init__(self, budget_bytes: int = 0):
+        self.budget_bytes = int(budget_bytes)
+        # name → entry dict; insertion order IS recency order (oldest
+        # first), maintained by _touch
+        self._entries: dict[int, dict] = {}  # guarded-by: _lock
+        self._lock = new_lock("serve.models.WeightCache._lock")
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
+        self.evictions = 0  # guarded-by: _lock
+        self.admits = 0  # guarded-by: _lock
+        self.over_budget = 0  # guarded-by: _lock
+        self.spilled_bytes_total = 0  # guarded-by: _lock
+
+    def register(self, model) -> None:
+        """Put ``model`` (a CheckpointServingModel) under residency
+        management.  Its current variables count as resident; admitting
+        them may evict others immediately when the budget is already
+        full."""
+        import jax
+
+        nbytes = int(sum(a.nbytes for a in
+                         jax.tree_util.tree_leaves(model._variables)))
+        with self._lock:
+            self._entries[id(model)] = {
+                "model": model, "nbytes": nbytes, "resident": True,
+                "host_copy": None}
+            self._evict_for_locked(id(model))
+        model._cache = self
+        event(_log, "cache_register", model=model.name,
+              bytes=nbytes, budget=self.budget_bytes)
+
+    def drop(self, model) -> None:
+        """Retire ``model`` from management (version retired/rolled
+        back): its entry — resident bytes included — leaves the table."""
+        model._cache = None
+        with self._lock:
+            self._entries.pop(id(model), None)
+
+    def variables_for(self, model):
+        """Hot path: the variables ``model``'s bucket programs run with.
+        None = not under management (caller falls back to its own)."""
+        with self._lock:
+            entry = self._entries.get(id(model))
+            if entry is None:
+                return None
+            if entry["resident"]:
+                self.hits += 1
+                self._touch_locked(id(model))
+                return entry["model"]._variables
+            # miss: admit the spilled copy, evicting LRU residents
+            # until the budget holds (device_put under the cache lock
+            # is deliberate — two threads admitting the same model must
+            # not both transfer; this lock is a leaf, nothing else is
+            # ever acquired under it)
+            self.misses += 1
+            self._admit_locked(entry)
+            self._touch_locked(id(model))
+            return entry["model"]._variables
+
+    # -- internals (all under _lock) ---------------------------------------
+
+    def _touch_locked(self, key: int):
+        entry = self._entries.pop(key)
+        self._entries[key] = entry  # re-insert at the recent end
+
+    def _resident_bytes_locked(self) -> int:
+        return sum(e["nbytes"] for e in self._entries.values()
+                   if e["resident"])
+
+    def _admit_locked(self, entry: dict):
+        import jax
+
+        self.admits += 1
+        self._evict_for_locked(id(entry["model"]), entry["nbytes"])
+        model = entry["model"]
+        model._variables = jax.device_put(entry["host_copy"],
+                                          model._var_sharding)
+        entry["resident"] = True
+        event(_log, "cache_admit", model=model.name,
+              bytes=entry["nbytes"],
+              resident_bytes=self._resident_bytes_locked())
+
+    def _evict_for_locked(self, keep_key: int, incoming: int = 0):
+        """Evict LRU residents (never ``keep_key``) until the budget
+        holds the resident set + ``incoming`` bytes."""
+        if self.budget_bytes <= 0:
+            return
+        while self._resident_bytes_locked() + incoming \
+                > self.budget_bytes:
+            victim_key = next(
+                (k for k, e in self._entries.items()
+                 if e["resident"] and k != keep_key), None)
+            if victim_key is None:
+                # only the incoming/kept model remains: allow the
+                # overrun (a model bigger than the budget still serves)
+                self.over_budget += 1
+                return
+            self._evict_locked(victim_key)
+
+    def _evict_locked(self, key: int):
+        import jax
+
+        entry = self._entries[key]
+        model = entry["model"]
+        if entry["host_copy"] is None:
+            # first eviction pays the D2H spill; the host copy is kept
+            # afterwards so later evictions are pure ref-drops
+            entry["host_copy"] = jax.tree_util.tree_map(
+                np.asarray, jax.device_get(model._variables))
+            self.spilled_bytes_total += entry["nbytes"]
+        # swap the model onto its host copy: the device buffers die as
+        # soon as in-flight batches holding them drain
+        model._variables = entry["host_copy"]
+        entry["resident"] = False
+        self.evictions += 1
+        event(_log, "cache_evict", model=model.name,
+              bytes=entry["nbytes"])
+
+    # -- observability -----------------------------------------------------
+
+    def resident_models(self) -> list[str]:
+        with self._lock:
+            return [e["model"].name for e in self._entries.values()
+                    if e["resident"]]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "budget_bytes": self.budget_bytes,
+                "resident_bytes": self._resident_bytes_locked(),
+                "hits": self.hits, "misses": self.misses,
+                "evictions": self.evictions, "admits": self.admits,
+                "over_budget": self.over_budget,
+                "spilled_bytes_total": self.spilled_bytes_total,
+                "models": {
+                    e["model"].name: {
+                        "bytes": e["nbytes"],
+                        "resident": e["resident"],
+                        "spilled": e["host_copy"] is not None}
+                    for e in self._entries.values()}}
+
+
+class CanaryPolicy:
+    """Gates + pacing for the SHADOW/CANARY phases of a reload.
+
+    ``canary_frac`` of live traffic routes to the candidate once it
+    reaches CANARY; auto-promote requires ``min_requests`` canary
+    answers with an error rate ≤ ``max_error_rate`` AND (when both
+    sides have latency history) canary p99 ≤ active p99 ×
+    ``max_p99_ratio``.  ``shadow_frac > 0`` first duplicates that
+    fraction of live requests onto the candidate (outputs discarded)
+    and requires ``min_agreement`` top-1 agreement over
+    ``shadow_min_compared`` comparisons.  A phase that can't reach its
+    quota within ``phase_timeout_s`` rolls back (timeouts are a
+    failure, not a pass)."""
+
+    def __init__(self, *, canary_frac: float = 0.1,
+                 min_requests: int = 20,
+                 max_error_rate: float = 0.0,
+                 max_p99_ratio: float | None = 3.0,
+                 shadow_frac: float = 0.0,
+                 shadow_min_compared: int = 10,
+                 min_agreement: float = 0.8,
+                 phase_timeout_s: float = 30.0):
+        if not 0.0 < canary_frac <= 1.0:
+            raise ValueError(f"canary_frac {canary_frac}: need (0, 1]")
+        if not 0.0 <= shadow_frac <= 1.0:
+            raise ValueError(f"shadow_frac {shadow_frac}: need [0, 1]")
+        self.canary_frac = canary_frac
+        self.min_requests = int(min_requests)
+        self.max_error_rate = float(max_error_rate)
+        self.max_p99_ratio = max_p99_ratio
+        self.shadow_frac = shadow_frac
+        self.shadow_min_compared = int(shadow_min_compared)
+        self.min_agreement = float(min_agreement)
+        self.phase_timeout_s = float(phase_timeout_s)
+
+    def describe(self) -> dict:
+        return {"canary_frac": self.canary_frac,
+                "min_requests": self.min_requests,
+                "max_error_rate": self.max_error_rate,
+                "max_p99_ratio": self.max_p99_ratio,
+                "shadow_frac": self.shadow_frac,
+                "shadow_min_compared": self.shadow_min_compared,
+                "min_agreement": self.min_agreement,
+                "phase_timeout_s": self.phase_timeout_s}
+
+
+class ModelVersion:
+    """One deployable version of one model: ServingModel + engine +
+    checkpoint identity + lifecycle state.  Mutable fields are guarded
+    by the owning plane's lock."""
+
+    def __init__(self, version: int, model, engine, *,
+                 workdir: str | None = None):
+        self.version = version
+        self.model = model
+        self.engine = engine
+        self.workdir = workdir
+        self.state = LOADING
+        self.loaded_at = time.monotonic()
+        self.state_reason: str | None = None
+        # canary accounting (filled by the plane's done-callbacks)
+        self.canary_requests = 0
+        self.canary_errors = 0
+        # shadow accounting
+        self.shadow_compared = 0
+        self.shadow_agreed = 0
+        self.shadow_discarded = 0
+
+    def describe(self) -> dict:
+        d = {"version": self.version, "state": self.state,
+             "state_reason": self.state_reason,
+             "step": self.model.restored_step,
+             "digest": getattr(self.model, "params_digest", None),
+             "mtime": getattr(self.model, "restored_mtime", None),
+             "loaded_age_s": round(time.monotonic() - self.loaded_at, 3)}
+        if self.canary_requests or self.canary_errors:
+            d["canary"] = {"requests": self.canary_requests,
+                           "errors": self.canary_errors}
+        if self.shadow_compared or self.shadow_discarded:
+            d["shadow"] = {"compared": self.shadow_compared,
+                           "agreed": self.shadow_agreed,
+                           "discarded": self.shadow_discarded}
+        return d
+
+
+class ModelControlPlane:
+    """Versioned model table + reload/canary lifecycle over N engines.
+
+    ``engine_factory(model)`` builds (and does NOT start) an engine for
+    a ServingModel — cli.serve wires the production BatchingEngine /
+    ReplicatedEngine construction through it, tests inject small ones.
+    One ``AdmissionController`` per model NAME is shared across that
+    model's versions (pass ``admission_factory`` to customize), so the
+    per-bucket exec EWMAs — and the per-model queue accounting — carry
+    over a reload instead of resetting with each new engine.
+
+    Drop-in engine surface for ``cli.serve``'s boot prints and
+    shutdown: ``buckets``/``pipeline_depth``/``faults`` proxy the first
+    deployed engine; ``stop(drain_deadline=)`` drains every routable
+    version.
+    """
+
+    def __init__(self, registry, engine_factory, *,
+                 cache: WeightCache | None = None,
+                 policy: CanaryPolicy | None = None,
+                 admission_factory=None,
+                 retain_retired: int = 5):
+        self.registry = registry
+        self.engine_factory = engine_factory
+        self.cache = cache
+        self.policy = policy or CanaryPolicy()
+        self.admission_factory = admission_factory
+        self.retain_retired = int(retain_retired)
+        # name → ordered list of ModelVersions (oldest first); the
+        # versioned model table
+        self._table: dict[str, list[ModelVersion]] = {}  # guarded-by: _lock
+        # name → the version currently answering the default route
+        self._active: dict[str, ModelVersion] = {}  # guarded-by: _lock
+        # name → (candidate, period) canary routing: every period-th
+        # submit goes to the candidate (deterministic, not sampled — a
+        # 10% canary is exactly every 10th request)
+        self._canary: dict[str, tuple] = {}  # guarded-by: _lock
+        # name → (candidate, period) shadow duplication
+        self._shadow: dict[str, tuple] = {}  # guarded-by: _lock
+        self._counter: dict[str, int] = {}  # guarded-by: _lock
+        self._reloading: dict[str, threading.Thread] = {}  # guarded-by: _lock
+        self._admissions: dict = {}  # name → controller; guarded-by: _lock
+        self._lock = new_lock("serve.models.ModelControlPlane._lock")
+        self._stopping = threading.Event()
+        self.reloads = 0  # guarded-by: _lock
+        self.promotions = 0  # guarded-by: _lock
+        self.rollbacks = 0  # guarded-by: _lock
+        self.resubmitted = 0  # guarded-by: _lock
+
+    # -- deployment --------------------------------------------------------
+
+    def admission_for(self, name: str):
+        """The model's shared admission controller (created on first
+        use via ``admission_factory``; None factory = the engine builds
+        its own and per-model EWMA continuity is off)."""
+        if self.admission_factory is None:
+            return None
+        with self._lock:
+            adm = self._admissions.get(name)
+            if adm is None:
+                adm = self._admissions[name] = \
+                    self.admission_factory(name)
+            return adm
+
+    def deploy(self, model, *, workdir: str | None = None,
+               start: bool = True) -> ModelVersion:
+        """Install ``model`` as the next version of its name and make
+        it ACTIVE immediately (the boot path; ``reload`` is the
+        gradual-rollout path).  Builds + starts its engine, registers
+        its weights with the cache, and publishes it in the registry."""
+        engine = self.engine_factory(model)
+        with self._lock:
+            versions = self._table.setdefault(model.name, [])
+            v = (versions[-1].version + 1) if versions else 1
+        model.serve_version = v
+        mv = ModelVersion(v, model, engine, workdir=workdir)
+        if self.cache is not None and hasattr(model, "_live_variables"):
+            self.cache.register(model)
+        if start:
+            engine.start()
+        self.registry.add(model, version=v)
+        with self._lock:
+            versions.append(mv)
+            old = self._active.get(model.name)
+            self._active[model.name] = mv
+            mv.state = ACTIVE
+        if old is not None:
+            self._retire(old, reason="replaced by deploy")
+        event(_log, "deploy", model=model.name, version=v,
+              step=model.restored_step)
+        return mv
+
+    # -- request path ------------------------------------------------------
+
+    def resolve(self, name: str | None):
+        """Routing-table model lookup for the HTTP layer: the ACTIVE
+        version's ServingModel (KeyError lists the served names, same
+        contract as ``ModelRegistry.get``)."""
+        with self._lock:
+            names = sorted(self._active)
+            if name is None:
+                if len(self._active) != 1:
+                    raise KeyError(f"model name required "
+                                   f"(serving {names})")
+                return next(iter(self._active.values())).model
+            mv = self._active.get(name)
+        if mv is None:
+            raise KeyError(f"unknown model '{name}'; serving {names}")
+        return mv.model
+
+    def active_engine(self, name: str):
+        with self._lock:
+            mv = self._active.get(name)
+        if mv is None:
+            raise KeyError(f"unknown model '{name}'; "
+                           f"serving {sorted(self._active)}")
+        return mv.engine
+
+    def active_engines(self) -> dict:
+        """name → active engine snapshot (the healthz/metrics view)."""
+        with self._lock:
+            return {name: mv.engine
+                    for name, mv in sorted(self._active.items())}
+
+    def submit(self, name: str, image, deadline_ms: float | None = None,
+               span=None) -> Future:
+        """Route one request: the ACTIVE version, or — every canary
+        period — the CANARY candidate; an optional SHADOW duplicate
+        rides along with its output discarded.  The returned future
+        resolves exactly like an engine's.  If the admitting version
+        was drained out from under the request mid-reload (its engine
+        answered ``Shed("shutdown")`` while a newer version is active),
+        the request transparently resubmits to the current active —
+        the zero-lost-requests half of zero-downtime."""
+        fut: Future = Future()
+        self._submit_once(name, image, deadline_ms, span, fut, retries=3)
+        return fut
+
+    def infer(self, name: str, image, deadline_ms: float | None = None,
+              timeout: float | None = 30.0, span=None):
+        return self.submit(name, image, deadline_ms,
+                           span=span).result(timeout)
+
+    def _submit_once(self, name, image, deadline_ms, span, fut: Future,
+                     retries: int):
+        with self._lock:
+            mv = self._active.get(name)
+            if mv is None:
+                names = sorted(self._active)
+                err: Exception = KeyError(
+                    f"unknown model '{name}'; serving {names}")
+                mv = None
+            else:
+                err = None
+                self._counter[name] = self._counter.get(name, 0) + 1
+                tick = self._counter[name]
+                canary = self._canary.get(name)
+                shadow = self._shadow.get(name)
+                if canary is not None and tick % canary[1] == 0:
+                    mv = canary[0]  # this request IS canary traffic
+                    canary = None
+        if err is not None:
+            fut.set_exception(err)
+            return
+        is_canary = mv.state == CANARY
+        inner = mv.engine.submit(image, deadline_ms, span=span)
+        inner.add_done_callback(
+            lambda f: self._request_done(f, name, mv, image,
+                                         deadline_ms, span, fut,
+                                         retries, is_canary))
+        # shadow duplication: same image onto the candidate, result
+        # compared against the primary then discarded — the candidate
+        # never answers a client while shadowing
+        if shadow is not None and tick % shadow[1] == 0:
+            self._shadow_submit(shadow[0], image, inner)
+
+    def _request_done(self, inner: Future, name, mv, image, deadline_ms,
+                      span, fut: Future, retries: int, is_canary: bool):
+        """Done-callback on the engine future: transfer the result out,
+        count canary outcomes, and resubmit shutdown-shed requests that
+        raced a version swap.  Runs on an engine worker thread — must
+        never block."""
+        try:
+            result = inner.result()
+        except Exception as e:  # noqa: BLE001 — the engine failed the future; propagate (after canary accounting)
+            if is_canary:
+                self._count_canary(mv, error=True)
+            fut.set_exception(e)
+            return
+        if is_canary:
+            self._count_canary(mv, error=self._is_bad(result))
+        if isinstance(result, Shed) and result.reason == "shutdown" \
+                and retries > 0 and not self._stopping.is_set():
+            with self._lock:
+                active = self._active.get(name)
+            if active is not None and active is not mv:
+                # the admitting version was drained mid-reload: the
+                # new active owns this request now
+                with self._lock:
+                    self.resubmitted += 1
+                self._submit_once(name, image, deadline_ms, span, fut,
+                                  retries - 1)
+                return
+        fut.set_result(result)
+
+    @staticmethod
+    def _is_bad(result) -> bool:
+        """Is this served result an error for canary gating?  Failed
+        futures and Quarantined are; NaN float output is (a bad
+        checkpoint's signature — serve/faults.py nan mode); sheds are
+        capacity, not version quality."""
+        if isinstance(result, Quarantined):
+            return True
+        if isinstance(result, Shed):
+            return False
+        import jax
+
+        for leaf in jax.tree_util.tree_leaves(result):
+            arr = np.asarray(leaf)
+            if arr.dtype.kind == "f" and np.isnan(arr).any():
+                return True
+        return False
+
+    def _count_canary(self, mv: ModelVersion, *, error: bool):
+        with self._lock:
+            mv.canary_requests += 1
+            if error:
+                mv.canary_errors += 1
+
+    # -- shadow ------------------------------------------------------------
+
+    def _shadow_submit(self, mv: ModelVersion, image, primary: Future):
+        sfut = mv.engine.submit(image)
+        holder: dict = {}
+
+        def arrived(which, f):
+            with self._lock:
+                holder[which] = f
+                ready = len(holder) == 2 and not holder.get("_done")
+                if ready:
+                    holder["_done"] = True
+                p, s = holder.get("p"), holder.get("s")
+            if ready:
+                self._compare_shadow(mv, p, s)
+
+        primary.add_done_callback(lambda f: arrived("p", f))
+        sfut.add_done_callback(lambda f: arrived("s", f))
+
+    def _compare_shadow(self, mv: ModelVersion, p: Future, s: Future):
+        """Both sides answered: record top-1 agreement, then DISCARD
+        the shadow output (it never reaches a client)."""
+        try:
+            pr, sr = p.result(), s.result()
+        except Exception:  # noqa: BLE001 — either side failed: nothing to compare
+            with self._lock:
+                mv.shadow_discarded += 1
+            return
+        comparable = (isinstance(pr, np.ndarray)
+                      and isinstance(sr, np.ndarray)
+                      and pr.shape == sr.shape and pr.ndim >= 1)
+        with self._lock:
+            mv.shadow_discarded += 1
+            if not comparable:
+                return
+            mv.shadow_compared += 1
+            if int(np.argmax(pr)) == int(np.argmax(sr)):
+                mv.shadow_agreed += 1
+
+    # -- reload lifecycle --------------------------------------------------
+
+    def reload(self, name: str, *, force: bool = False,
+               wait: bool = False, _loader=None) -> dict:
+        """Kick a background reload of ``name`` from its workdir: load
+        the newest checkpoint, shadow/canary per the policy, then
+        auto-promote or auto-roll-back.  Returns immediately with the
+        accepted/refused verdict (``wait=True`` blocks until the
+        lifecycle completes — the test/CLI convenience).  One reload
+        per model at a time (a second request answers ``in_progress``).
+        ``_loader()`` (test seam) overrides the checkpoint walk and
+        must return a ready ServingModel."""
+        with self._lock:
+            mv = self._active.get(name)
+            if mv is None:
+                raise KeyError(f"unknown model '{name}'; "
+                               f"serving {sorted(self._active)}")
+            t = self._reloading.get(name)
+            if t is not None and t.is_alive():
+                return {"status": "in_progress", "model": name}
+        if _loader is None and mv.workdir is None:
+            return {"status": "refused", "model": name,
+                    "reason": "no workdir to reload from"}
+        if not force and _loader is None:
+            from deep_vision_tpu.core.restore import \
+                checkpoint_fingerprint
+
+            fp = checkpoint_fingerprint(mv.workdir)
+            if fp["step"] == mv.model.restored_step and \
+                    fp["step"] is not None:
+                return {"status": "no_new_step", "model": name,
+                        "step": fp["step"]}
+        worker = threading.Thread(
+            target=self._reload_worker, args=(name, mv, _loader),
+            name=f"reload-{name}", daemon=True)
+        with self._lock:
+            self._reloading[name] = worker
+            self.reloads += 1
+        worker.start()
+        if wait:
+            worker.join()
+            with self._lock:
+                versions = list(self._table.get(name, []))
+            last = versions[-1].describe() if versions else None
+            return {"status": "done", "model": name, "version": last}
+        return {"status": "reloading", "model": name}
+
+    def _load_model(self, mv: ModelVersion):
+        """Default loader: same restore path as registry.load_checkpoint
+        but into a FRESH ServingModel (the old version keeps serving its
+        weights untouched)."""
+        from deep_vision_tpu.core.restore import load_state
+        from deep_vision_tpu.serve.registry import CheckpointServingModel
+
+        old = mv.model
+        cfg = old.cfg
+        info: dict = {}
+        model, state = load_state(cfg, mv.workdir, tag="reload",
+                                  info=info)
+        sm = CheckpointServingModel(
+            old.name, cfg, model, state,
+            wire_dtype=str(old.wire_dtype),
+            infer_dtype=old.infer_dtype)
+        sm.restored_step = info.get("step")
+        sm.restore_fallback = bool(info.get("fallback"))
+        sm.restored_mtime = info.get("mtime")
+        sm.params_digest = info.get("digest")
+        return sm
+
+    def _reload_worker(self, name: str, old_mv: ModelVersion, _loader):
+        try:
+            sm = _loader() if _loader is not None \
+                else self._load_model(old_mv)
+        except Exception as e:  # noqa: BLE001 — a bad checkpoint must not kill the plane
+            event(_log, "reload_failed", model=name,
+                  error=f"{type(e).__name__}: {e}")
+            return
+        engine = self.engine_factory(sm)
+        with self._lock:
+            versions = self._table.setdefault(name, [])
+            v = (versions[-1].version + 1) if versions else 1
+        sm.serve_version = v
+        mv = ModelVersion(v, sm, engine, workdir=old_mv.workdir)
+        with self._lock:
+            versions.append(mv)
+        try:
+            if self.cache is not None and \
+                    hasattr(sm, "_live_variables"):
+                self.cache.register(sm)
+            engine.start()
+            # warm the smallest bucket so the first canary request
+            # doesn't pay the compile
+            engine.warmup([engine.buckets[0]])
+        except Exception as e:  # noqa: BLE001 — version never served; mark and bail
+            with self._lock:
+                mv.state = FAILED
+                mv.state_reason = f"{type(e).__name__}: {e}"
+            engine.stop()
+            if self.cache is not None:
+                self.cache.drop(sm)
+            event(_log, "reload_failed", model=name, version=v,
+                  error=mv.state_reason)
+            return
+        event(_log, "reload_loaded", model=name, version=v,
+              step=sm.restored_step, digest=sm.params_digest)
+        if self.policy.shadow_frac > 0:
+            if not self._run_shadow(name, mv):
+                self._rollback(name, mv, "shadow gate failed")
+                return
+        if not self._run_canary(name, mv):
+            self._rollback(name, mv, "canary gate failed")
+            return
+        self._promote(name, mv)
+
+    def _phase_wait(self, done, timeout_s: float) -> bool:
+        """Poll ``done()`` until true or the phase times out (timeouts
+        fail the phase — an idle service can't validate a candidate)."""
+        t_end = time.monotonic() + timeout_s
+        while time.monotonic() < t_end:
+            if done():
+                return True
+            if self._stopping.wait(0.005):
+                return False
+        return done()
+
+    def _run_shadow(self, name: str, mv: ModelVersion) -> bool:
+        period = max(1, round(1.0 / self.policy.shadow_frac))
+        with self._lock:
+            mv.state = SHADOW
+            self._shadow[name] = (mv, period)
+        event(_log, "shadow_start", model=name, version=mv.version,
+              period=period)
+        try:
+            ok = self._phase_wait(
+                lambda: mv.shadow_compared
+                >= self.policy.shadow_min_compared,
+                self.policy.phase_timeout_s)
+        finally:
+            with self._lock:
+                self._shadow.pop(name, None)
+        with self._lock:
+            compared, agreed = mv.shadow_compared, mv.shadow_agreed
+        if not ok:
+            mv.state_reason = (f"shadow timeout: {compared}/"
+                               f"{self.policy.shadow_min_compared} "
+                               f"compared")
+            return False
+        agreement = agreed / compared if compared else 0.0
+        event(_log, "shadow_done", model=name, version=mv.version,
+              compared=compared, agreed=agreed,
+              agreement=round(agreement, 4))
+        if agreement < self.policy.min_agreement:
+            mv.state_reason = (f"shadow agreement {agreement:.2f} < "
+                               f"{self.policy.min_agreement}")
+            return False
+        return True
+
+    def _run_canary(self, name: str, mv: ModelVersion) -> bool:
+        period = max(1, round(1.0 / self.policy.canary_frac))
+        with self._lock:
+            mv.state = CANARY
+            self._canary[name] = (mv, period)
+        event(_log, "canary_start", model=name, version=mv.version,
+              period=period)
+        try:
+            ok = self._phase_wait(
+                lambda: mv.canary_requests >= self.policy.min_requests,
+                self.policy.phase_timeout_s)
+            with self._lock:
+                requests, errors = mv.canary_requests, mv.canary_errors
+            if not ok:
+                mv.state_reason = (f"canary timeout: {requests}/"
+                                   f"{self.policy.min_requests} "
+                                   f"requests")
+                return False
+            error_rate = errors / requests if requests else 1.0
+            if error_rate > self.policy.max_error_rate:
+                mv.state_reason = (f"canary error rate "
+                                   f"{error_rate:.3f} > "
+                                   f"{self.policy.max_error_rate}")
+                return False
+            # p99 regression gate: the candidate engine's own latency
+            # distribution vs the active's (same histogram edges)
+            if self.policy.max_p99_ratio is not None:
+                with self._lock:
+                    active = self._active.get(name)
+                cp = mv.engine.stats()["latency"]
+                ap = active.engine.stats()["latency"] \
+                    if active is not None else {}
+                if cp.get("count") and ap.get("count") and \
+                        ap.get("p99_ms"):
+                    ratio = cp["p99_ms"] / ap["p99_ms"]
+                    if ratio > self.policy.max_p99_ratio:
+                        mv.state_reason = (
+                            f"canary p99 {cp['p99_ms']:.1f}ms is "
+                            f"{ratio:.2f}x active "
+                            f"{ap['p99_ms']:.1f}ms > "
+                            f"{self.policy.max_p99_ratio}x")
+                        return False
+            event(_log, "canary_done", model=name, version=mv.version,
+                  requests=requests, errors=errors)
+            return True
+        finally:
+            with self._lock:
+                self._canary.pop(name, None)
+
+    def _promote(self, name: str, mv: ModelVersion):
+        """Swap the routing table to ``mv`` FIRST, then drain the old
+        version — no instant exists where neither serves."""
+        with self._lock:
+            old = self._active.get(name)
+            self._active[name] = mv
+            mv.state = ACTIVE
+            self.promotions += 1
+        self.registry.add(mv.model, version=mv.version)
+        event(_log, "promote", model=name, version=mv.version,
+              step=mv.model.restored_step)
+        if old is not None:
+            self._retire(old, reason=f"superseded by v{mv.version}")
+
+    def _rollback(self, name: str, mv: ModelVersion, why: str):
+        with self._lock:
+            self.rollbacks += 1
+            reason = mv.state_reason or why
+        event(_log, "rollback", model=name, version=mv.version,
+              reason=reason)
+        self._retire(mv, reason=reason or why, rolled_back=True)
+
+    def _retire(self, mv: ModelVersion, *, reason: str,
+                rolled_back: bool = False):
+        """DRAINING → RETIRED: admitted work finishes on the version
+        that admitted it, then the engine stops and the weights leave
+        the cache."""
+        with self._lock:
+            mv.state = DRAINING
+            if rolled_back or mv.state_reason is None:
+                mv.state_reason = reason
+        mv.engine.stop(drain_deadline=5.0)
+        if self.cache is not None:
+            self.cache.drop(mv.model)
+        with self._lock:
+            mv.state = RETIRED
+            versions = self._table.get(mv.model.name, [])
+            retired = [x for x in versions
+                       if x.state in (RETIRED, FAILED)]
+            for stale in retired[:-self.retain_retired] \
+                    if self.retain_retired > 0 else []:
+                versions.remove(stale)
+        event(_log, "retired", model=mv.model.name, version=mv.version,
+              reason=reason)
+
+    def promote(self, name: str) -> dict:
+        """Operator override: promote the in-flight CANARY/SHADOW
+        candidate immediately, skipping the remaining gates."""
+        with self._lock:
+            pair = self._canary.get(name) or self._shadow.get(name)
+        if pair is None:
+            return {"status": "refused", "model": name,
+                    "reason": "no candidate in canary/shadow"}
+        self._promote(name, pair[0])
+        return {"status": "promoted", "model": name,
+                "version": pair[0].version}
+
+    def rollback(self, name: str) -> dict:
+        """Operator override: retire the in-flight candidate now."""
+        with self._lock:
+            pair = self._canary.get(name) or self._shadow.get(name)
+            if pair is not None:
+                self._canary.pop(name, None)
+                self._shadow.pop(name, None)
+        if pair is None:
+            return {"status": "refused", "model": name,
+                    "reason": "no candidate in canary/shadow"}
+        self._rollback(name, pair[0], "operator rollback")
+        return {"status": "rolled_back", "model": name,
+                "version": pair[0].version}
+
+    # -- lifecycle / engine-surface compatibility --------------------------
+
+    @property
+    def faults(self):
+        with self._lock:
+            mv = next(iter(self._active.values()), None)
+        return mv.engine.faults if mv is not None else _NO_FAULTS
+
+    @property
+    def buckets(self):
+        with self._lock:
+            mv = next(iter(self._active.values()), None)
+        return mv.engine.buckets if mv is not None else []
+
+    @property
+    def pipeline_depth(self):
+        with self._lock:
+            mv = next(iter(self._active.values()), None)
+        return mv.engine.pipeline_depth if mv is not None else 1
+
+    @property
+    def model(self):
+        with self._lock:
+            mv = next(iter(self._active.values()), None)
+        return mv.model if mv is not None else None
+
+    def warmup(self, buckets=None):
+        for eng in self.active_engines().values():
+            eng.warmup(buckets)
+
+    def stop(self, timeout: float = 5.0,
+             drain_deadline: float | None = None):
+        """Stop every version's engine (reload workers bail at the next
+        phase poll)."""
+        self._stopping.set()
+        with self._lock:
+            workers = list(self._reloading.values())
+            versions = [mv for vs in self._table.values() for mv in vs]
+        for w in workers:
+            w.join(timeout)
+        for mv in versions:
+            if mv.state in _ROUTABLE or mv.state == LOADING:
+                mv.engine.stop(timeout, drain_deadline=drain_deadline)
+
+    # -- observability -----------------------------------------------------
+
+    def models(self) -> dict:
+        """The /v1/models listing: per name, the version table + which
+        one is active + the gate policy."""
+        with self._lock:
+            names = {name: (list(vs), self._active.get(name))
+                     for name, vs in self._table.items()}
+        out = {}
+        for name, (versions, active) in sorted(names.items()):
+            out[name] = {
+                "active_version": active.version
+                if active is not None else None,
+                "model": (active.model.describe()
+                          if active is not None else None),
+                "versions": [mv.describe() for mv in versions]}
+        return out
+
+    def stats(self) -> dict:
+        """The plane-shaped /v1/stats body: ``models`` (per name: the
+        active engine's full stats + the version table), ``cache``, and
+        ``plane`` counters.  serve/http.py renders /metrics from it."""
+        with self._lock:
+            snapshot = {name: (self._active.get(name),
+                               list(self._table.get(name, [])))
+                        for name in self._table}
+            plane = {"reloads": self.reloads,
+                     "promotions": self.promotions,
+                     "rollbacks": self.rollbacks,
+                     "resubmitted": self.resubmitted,
+                     "policy": self.policy.describe()}
+        models = {}
+        for name, (active, versions) in sorted(snapshot.items()):
+            entry = {
+                "active_version": active.version
+                if active is not None else None,
+                "versions": [mv.describe() for mv in versions]}
+            if active is not None:
+                entry["engine"] = active.engine.stats()
+            # a routable non-active candidate's engine stats ride along
+            # so canary latency/error progress is observable mid-rollout
+            for mv in versions:
+                if mv is not active and mv.state in _ROUTABLE:
+                    entry["candidate_engine"] = mv.engine.stats()
+            models[name] = entry
+        out = {"models": models, "plane": plane}
+        if self.cache is not None:
+            out["cache"] = self.cache.stats()
+        return out
+
+
+class _NoFaults:
+    enabled = False
+    spec = ""
+    seed = 0
+
+
+_NO_FAULTS = _NoFaults()

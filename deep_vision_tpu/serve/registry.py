@@ -98,6 +98,14 @@ class ServingModel:
         # — set by the registry loaders, surfaced in describe()
         self.restored_step: int | None = None
         self.restore_fallback = False
+        # checkpoint-dir mtime + params byte digest (core/restore.py):
+        # the control plane's "same weights?" identity for reload
+        # detection, surfaced in describe() alongside the step
+        self.restored_mtime: float | None = None
+        self.params_digest: str | None = None
+        # version number under the control plane's versioned model
+        # table (serve/models.py); None outside plane-managed serving
+        self.serve_version: int | None = None
 
     def compile_bucket(self, batch: int):
         raise NotImplementedError
@@ -124,7 +132,10 @@ class ServingModel:
                 "infer_dtype": self.infer_dtype,
                 "placement": self.placement_desc(),
                 "restored_step": self.restored_step,
-                "restore_fallback": self.restore_fallback}
+                "restore_fallback": self.restore_fallback,
+                "restored_mtime": self.restored_mtime,
+                "params_digest": self.params_digest,
+                "version": self.serve_version}
 
 
 class CheckpointServingModel(ServingModel):
@@ -171,6 +182,34 @@ class CheckpointServingModel(ServingModel):
         # mesh, pinned on a single device); None = wherever restore left
         # them
         self._var_sharding = None
+        # HBM residency manager (serve/models.py WeightCache) — when
+        # registered, bucket programs resolve their variables through
+        # the cache at CALL time (late binding), so an evicted model's
+        # weights can spill to host RAM and be device_put back on demand
+        # without recompiling any retained AOT executable
+        self._cache = None
+
+    def _live_variables(self):
+        """The variables a bucket program should run with RIGHT NOW:
+        the cache's resident copy when this model is under residency
+        management (which may trigger an evict→re-admit cycle), else
+        the load-time device arrays.  Called once per dispatched batch
+        — never per request."""
+        cache = self._cache
+        if cache is not None:
+            managed = cache.variables_for(self)
+            if managed is not None:
+                return managed
+        return self._variables
+
+    def param_bytes(self) -> int:
+        """Total bytes of the variable tree (the weight cache's HBM
+        accounting unit for this model)."""
+        import jax
+
+        # .nbytes is metadata on both jax and numpy arrays — no D2H
+        return int(sum(a.nbytes for a in
+                       jax.tree_util.tree_leaves(self._variables)))
 
     def for_device(self, device) -> "CheckpointServingModel":
         """Per-device replica view: SAME host restore, its OWN device
@@ -261,12 +300,15 @@ class CheckpointServingModel(ServingModel):
                 "ignore", message="Some donated buffers were not usable")
             compiled = jax.jit(apply, donate_argnums=(1,)).lower(
                 v_spec, x_spec).compile()
-        variables = self._variables
+        model = self  # late-bind variables: the weight cache may have
+        # spilled + re-admitted them since this program compiled, and
+        # the AOT executable must not pin the evicted device buffers
 
         placement = self.placement
         wire_np = self.wire_dtype
 
         def call(x):
+            variables = model._live_variables()
             # keep donation meaningful for direct numpy callers too:
             # transfer first, hand the committed device buffer over —
             # honoring the view's placement (replica device / mesh)
@@ -352,9 +394,19 @@ class ExportedServingModel(ServingModel):
 class ModelRegistry:
     def __init__(self):
         self._models: dict[str, ServingModel] = {}
+        # name → version → ServingModel: the control plane
+        # (serve/models.py) publishes each promoted version here so
+        # ``get(name, version=N)`` can answer for any retained version;
+        # plain single-version serving never populates it
+        self._versions: dict[str, dict[int, ServingModel]] = {}
 
-    def add(self, model: ServingModel) -> ServingModel:
+    def add(self, model: ServingModel,
+            version: int | None = None) -> ServingModel:
         self._models[model.name] = model
+        if version is None:
+            version = model.serve_version
+        if version is not None:
+            self._versions.setdefault(model.name, {})[int(version)] = model
         return model
 
     def load_checkpoint(self, config_name: str, workdir: str,
@@ -379,6 +431,8 @@ class ModelRegistry:
                                     infer_dtype=infer_dtype)
         sm.restored_step = info.get("step")
         sm.restore_fallback = bool(info.get("fallback"))
+        sm.restored_mtime = info.get("mtime")
+        sm.params_digest = info.get("digest")
         return self.add(sm)
 
     def load_exported(self, config_name: str, blob_path: str, workdir: str,
@@ -407,17 +461,29 @@ class ModelRegistry:
             name or config_name, cfg, call, variables, fixed_batch)
         sm.restored_step = info.get("step")
         sm.restore_fallback = bool(info.get("fallback"))
+        sm.restored_mtime = info.get("mtime")
+        sm.params_digest = info.get("digest")
         return self.add(sm)
 
-    def get(self, name: str | None = None) -> ServingModel:
+    def get(self, name: str | None = None,
+            version: int | None = None) -> ServingModel:
         if name is None:
             if len(self._models) != 1:
                 raise KeyError(
                     f"model name required (serving {sorted(self._models)})")
+            if version is not None:
+                return self.get(next(iter(self._models)), version)
             return next(iter(self._models.values()))
         if name not in self._models:
             raise KeyError(f"unknown model '{name}'; "
                            f"serving {sorted(self._models)}")
+        if version is not None:
+            table = self._versions.get(name, {})
+            if int(version) not in table:
+                raise KeyError(
+                    f"model '{name}' has no version {version}; "
+                    f"versions {sorted(table)}")
+            return table[int(version)]
         return self._models[name]
 
     def names(self) -> list[str]:

@@ -272,6 +272,7 @@ class ReplicatedEngine:
                 span.note("shed", shed.reason)
             fut.set_result(shed)
             return fut
+        self.admission.record_admit()
         poison = self.faults.mark_poison() if self.faults.enabled else False
         if span is not None:
             span.mark("admit")
@@ -543,6 +544,7 @@ class ReplicatedEngine:
                 "compiles": rep.compiles})
         with self._lock:
             out = {"model": self.model.name,
+                   "version": getattr(self.model, "serve_version", None),
                    "submitted": self.submitted,
                    "served": sum(r.served for r in self.replicas),
                    "batches": sum(r.batches for r in self.replicas),

@@ -1,0 +1,457 @@
+"""Model control plane contract (CPU, tier-1 fast): the weight cache
+evicts/spills/re-admits without changing a single output bit or paying
+a recompile, the LRU order is the touch order, a hot reload under live
+load loses zero admitted requests, the canary gates auto-roll-back a
+fault-injected bad version, and shadow traffic is compared then
+discarded — it never answers a client.
+
+Uses LeNet (and the toy YOLO config where a second model is needed) at
+random init: lifecycle correctness is about routing and residency, not
+learned weights.  Runs with the lock-order sanitizer enabled (conftest
+fixture keyed on the ``models`` marker), so every plane/cache lock
+acquisition is order-checked.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.serve.admission import AdmissionController, Shed
+from deep_vision_tpu.serve.engine import BatchingEngine
+from deep_vision_tpu.serve.faults import FaultPlane, Quarantined
+from deep_vision_tpu.serve.models import (ACTIVE, RETIRED, CanaryPolicy,
+                                          ModelControlPlane, WeightCache)
+from deep_vision_tpu.serve.registry import (CheckpointServingModel,
+                                            ModelRegistry)
+
+pytestmark = pytest.mark.models
+
+
+def _engine_factory(model):
+    """Small test engine; a model tagged ``_test_faults`` gets that
+    fault spec with output validation OFF, so an injected-NaN "bad
+    checkpoint" SERVES its NaNs for the canary gate to catch (the
+    engine-level quarantine would otherwise eat them first)."""
+    spec = getattr(model, "_test_faults", "")
+    return BatchingEngine(model, buckets=[4], max_wait_ms=2,
+                          faults=FaultPlane(spec),
+                          validate_outputs=False if spec else None)
+
+
+def _fresh_sm(sm):
+    """A new ServingModel over the same weights — the reload loader
+    seam's 'new checkpoint' stand-in (same cfg, fresh AOT dict)."""
+    import types
+
+    state = types.SimpleNamespace(
+        params=sm._variables["params"],
+        batch_stats=sm._variables.get("batch_stats"))
+    new = CheckpointServingModel(sm.name, sm.cfg, sm._model, state)
+    new.restored_step = (sm.restored_step or 0) + 1
+    return new
+
+
+@pytest.fixture()
+def lenet_plane(tmp_path):
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", str(tmp_path / "lenet_workdir"))
+    cache = WeightCache(budget_bytes=0)
+    plane = ModelControlPlane(
+        reg, _engine_factory, cache=cache,
+        policy=CanaryPolicy(canary_frac=0.5, min_requests=3,
+                            max_p99_ratio=None, phase_timeout_s=15.0),
+        admission_factory=lambda name: AdmissionController(name=name))
+    plane.deploy(sm, workdir=str(tmp_path / "lenet_workdir"))
+    yield reg, sm, plane, cache
+    plane.stop()
+
+
+def _img(shape=(32, 32, 1), seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+class _LoadThread(threading.Thread):
+    """Continuous closed-loop client against one model name; collects
+    every error (exception / Shed / Quarantined / NaN output) so reload
+    tests can assert the zero-lost-requests contract."""
+
+    def __init__(self, plane, name, img):
+        super().__init__(daemon=True)
+        self.plane, self.name, self.img = plane, name, img
+        self.stop_flag = threading.Event()
+        self.served = 0
+        self.errors: list = []
+        self.nan_outputs = 0
+
+    def run(self):
+        while not self.stop_flag.is_set():
+            try:
+                r = self.plane.infer(self.name, self.img, timeout=30)
+            except Exception as e:  # noqa: BLE001 — every failure is a lost request
+                self.errors.append(repr(e))
+                continue
+            if isinstance(r, (Shed, Quarantined)):
+                self.errors.append(repr(r))
+                continue
+            if np.isnan(np.asarray(r)).any():
+                self.nan_outputs += 1
+            self.served += 1
+
+    def finish(self):
+        self.stop_flag.set()
+        self.join(30)
+
+
+# -- weight cache ----------------------------------------------------------
+
+
+def test_evict_readmit_bit_identical_no_recompile(tmp_path):
+    """A 1-byte budget forces every model switch through
+    evict→spill→re-admit; outputs must stay bit-identical and the
+    retained AOT programs must make re-admit compile-free."""
+    reg = ModelRegistry()
+    lenet = reg.load_checkpoint("lenet5", str(tmp_path / "l"))
+    yolo = reg.load_checkpoint("yolov3_toy", str(tmp_path / "y"))
+    cache = WeightCache(budget_bytes=1)  # nothing fits: max thrash
+    plane = ModelControlPlane(reg, _engine_factory, cache=cache)
+    plane.deploy(lenet)
+    plane.deploy(yolo)
+    try:
+        img = _img()
+        first = np.asarray(plane.infer("lenet5", img, timeout=30))
+        compiles = plane.active_engine("lenet5").compiles
+        # serving yolo evicts lenet (budget holds neither; LRU loses)
+        assert plane.infer(
+            "yolov3_toy", _img((64, 64, 3)), timeout=30) is not None
+        assert "lenet5" not in cache.resident_models()
+        again = np.asarray(plane.infer("lenet5", img, timeout=30))
+        assert np.array_equal(first, again)  # bit-identical round trip
+        assert plane.active_engine("lenet5").compiles == compiles
+        st = cache.stats()
+        assert st["evictions"] >= 2 and st["admits"] >= 1
+        assert st["spilled_bytes_total"] > 0
+        assert st["models"]["lenet5"]["spilled"]
+    finally:
+        plane.stop()
+
+
+def test_lru_order_is_touch_order():
+    """3 models, budget = 2: residency follows recency, not insertion."""
+    import jax
+
+    class _Fake:
+        def __init__(self, name):
+            self.name = name
+            self._variables = {"w": jax.device_put(
+                np.full(256, 1.0, np.float32))}
+            self._var_sharding = None
+            self._cache = None
+
+    a, b, c = _Fake("a"), _Fake("b"), _Fake("c")
+    nbytes = 256 * 4
+    cache = WeightCache(budget_bytes=2 * nbytes)
+    for m in (a, b, c):
+        cache.register(m)  # admitting c evicts a (the LRU resident)
+    assert sorted(cache.resident_models()) == ["b", "c"]
+    assert cache.variables_for(b) is not None   # touch b: order is c,b
+    assert cache.variables_for(a) is not None   # admit a → evict c
+    assert sorted(cache.resident_models()) == ["a", "b"]
+    st = cache.stats()
+    assert st["evictions"] == 2 and st["hits"] == 1 and st["misses"] == 1
+    # dropped models leave the table entirely, bytes included
+    cache.drop(a)
+    assert "a" not in cache.stats()["models"]
+
+
+def test_oversized_model_still_serves_over_budget(tmp_path):
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", str(tmp_path / "l"))
+    cache = WeightCache(budget_bytes=1)
+    plane = ModelControlPlane(reg, _engine_factory, cache=cache)
+    plane.deploy(sm)
+    try:
+        assert plane.infer("lenet5", _img(), timeout=30) is not None
+        assert cache.stats()["over_budget"] >= 1
+    finally:
+        plane.stop()
+
+
+# -- hot reload ------------------------------------------------------------
+
+
+@pytest.mark.chaos
+def test_hot_reload_under_load_loses_zero_requests(lenet_plane):
+    """The zero-downtime contract: a reload (load → canary → promote →
+    drain old) under continuous live load answers every request — no
+    shutdown sheds leak to clients (raced requests resubmit), no
+    errors, and the new version ends ACTIVE."""
+    _, sm, plane, _ = lenet_plane
+    load = _LoadThread(plane, "lenet5", _img())
+    load.start()
+    while load.served < 5:  # engine warm + traffic flowing
+        time.sleep(0.01)
+    out = plane.reload("lenet5", wait=True,
+                       _loader=lambda: _fresh_sm(sm))
+    load.finish()
+    assert out["status"] == "done"
+    assert out["version"]["state"] == ACTIVE
+    assert out["version"]["version"] == 2
+    assert load.errors == []  # ZERO lost requests
+    assert load.nan_outputs == 0
+    assert load.served > 0
+    st = plane.stats()
+    assert st["plane"]["promotions"] == 1
+    assert st["models"]["lenet5"]["active_version"] == 2
+    # the old version drained and retired; its cohort finished on it
+    states = [v["state"] for v in st["models"]["lenet5"]["versions"]]
+    assert states == [RETIRED, ACTIVE]
+
+
+@pytest.mark.chaos
+def test_canary_rolls_back_nan_bad_version(lenet_plane):
+    """A fault-injected bad candidate (d2h:nan — the bad-checkpoint
+    signature) fails the canary error-rate gate and auto-rolls-back;
+    v1 keeps serving and post-rollback outputs are NaN-free."""
+    _, sm, plane, _ = lenet_plane
+
+    def bad_loader():
+        new = _fresh_sm(sm)
+        new._test_faults = "d2h:nan"  # engine factory serves the NaNs
+        return new
+
+    load = _LoadThread(plane, "lenet5", _img())
+    load.start()
+    while load.served < 5:
+        time.sleep(0.01)
+    out = plane.reload("lenet5", wait=True, _loader=bad_loader)
+    load.finish()
+    assert out["status"] == "done"
+    assert out["version"]["version"] == 2
+    assert out["version"]["state"] == RETIRED
+    assert "canary error rate" in out["version"]["state_reason"]
+    st = plane.stats()
+    assert st["plane"]["rollbacks"] == 1
+    assert st["plane"]["promotions"] == 0
+    assert st["models"]["lenet5"]["active_version"] == 1  # v1 survived
+    r = np.asarray(plane.infer("lenet5", _img(), timeout=30))
+    assert not np.isnan(r).any()
+
+
+@pytest.mark.chaos
+def test_canary_p99_gate_rolls_back_slow_version(tmp_path):
+    """A candidate 100x slower than the active (injected d2h latency)
+    trips a rollback gate even though its answers are correct."""
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", str(tmp_path / "l"))
+    plane = ModelControlPlane(
+        reg, _engine_factory,
+        policy=CanaryPolicy(canary_frac=0.5, min_requests=3,
+                            max_error_rate=1.0, max_p99_ratio=3.0,
+                            phase_timeout_s=20.0))
+    plane.deploy(sm)
+    plane.warmup()  # keep the compile out of the active's p99 history
+
+    def slow_loader():
+        new = _fresh_sm(sm)
+        new._test_faults = "d2h:latency:delay_ms=300"
+        return new
+
+    load = _LoadThread(plane, "lenet5", _img())
+    load.start()
+    try:
+        while load.served < 10:  # active builds latency history
+            time.sleep(0.01)
+        out = plane.reload("lenet5", wait=True, _loader=slow_loader)
+        assert out["status"] == "done"
+        assert out["version"]["state"] == RETIRED
+        assert plane.stats()["plane"]["rollbacks"] == 1
+        assert plane.stats()["models"]["lenet5"]["active_version"] == 1
+    finally:
+        load.finish()
+        plane.stop()
+
+
+def test_shadow_compares_then_discards(lenet_plane):
+    """Shadow phase: the candidate sees duplicated live traffic, top-1
+    agreement is recorded, and every shadow output is discarded — each
+    client request resolves exactly once, from the primary."""
+    _, sm, plane, _ = lenet_plane
+    plane.policy = CanaryPolicy(canary_frac=0.5, min_requests=3,
+                                shadow_frac=1.0, shadow_min_compared=3,
+                                min_agreement=0.8, max_p99_ratio=None,
+                                phase_timeout_s=15.0)
+    load = _LoadThread(plane, "lenet5", _img())
+    load.start()
+    while load.served < 5:
+        time.sleep(0.01)
+    out = plane.reload("lenet5", wait=True,
+                       _loader=lambda: _fresh_sm(sm))
+    load.finish()
+    assert out["status"] == "done"
+    assert out["version"]["state"] == ACTIVE  # identical weights agree
+    shadow = out["version"]["shadow"]
+    assert shadow["compared"] >= 3
+    assert shadow["agreed"] == shadow["compared"]  # same weights
+    assert shadow["discarded"] >= shadow["compared"]
+    assert load.errors == []  # duplication never double-answers
+
+
+def test_reload_refused_without_workdir_and_while_in_progress(tmp_path):
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", str(tmp_path / "l"))
+    plane = ModelControlPlane(reg, _engine_factory)
+    plane.deploy(sm)  # no workdir
+    try:
+        out = plane.reload("lenet5")
+        assert out["status"] == "refused"
+        assert "workdir" in out["reason"]
+        with pytest.raises(KeyError):
+            plane.reload("nope")
+    finally:
+        plane.stop()
+
+
+# -- satellites ------------------------------------------------------------
+
+
+def test_registry_get_requires_name_with_multiple_models(tmp_path):
+    reg = ModelRegistry()
+    reg.load_checkpoint("lenet5", str(tmp_path / "l"))
+    reg.load_checkpoint("yolov3_toy", str(tmp_path / "y"))
+    with pytest.raises(KeyError) as exc:
+        reg.get(None)
+    msg = exc.value.args[0]  # args[0], NOT str(): no doubled quotes
+    assert msg.startswith("model name required")
+    assert "lenet5" in msg and "yolov3_toy" in msg
+    assert not msg.startswith('"')
+
+
+def test_registry_versioned_get(tmp_path):
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", str(tmp_path / "l"))
+    sm.serve_version = 1
+    reg.add(sm, version=1)
+    assert reg.get("lenet5", version=1) is sm
+    with pytest.raises(KeyError) as exc:
+        reg.get("lenet5", version=99)
+    assert "no version 99" in exc.value.args[0]
+
+
+def test_restore_stamps_mtime_and_digest(tmp_path):
+    from deep_vision_tpu.core.config import get_config
+    from deep_vision_tpu.core.restore import (checkpoint_fingerprint,
+                                              load_state)
+
+    fp = checkpoint_fingerprint(str(tmp_path))  # no checkpoints yet
+    assert fp["step"] is None
+    info: dict = {}
+    load_state(get_config("lenet5"), str(tmp_path), info=info)
+    assert info["digest"] is not None  # digest even for random init
+    assert "mtime" in info
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", str(tmp_path))
+    d = sm.describe()
+    assert d["params_digest"] == sm.params_digest is not None
+    assert "restored_mtime" in d
+
+
+def test_admitted_counter_and_named_admission(tmp_path):
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", str(tmp_path / "l"))
+    adm = AdmissionController(name="lenet5")
+    with BatchingEngine(sm, buckets=[4], max_wait_ms=2,
+                        admission=adm) as eng:
+        for _ in range(3):
+            assert eng.infer(_img(), timeout=30) is not None
+        st = eng.stats()["admission"]
+    assert st["admitted"] == 3
+    assert st["name"] == "lenet5"
+
+
+def test_http_models_lifecycle_and_metrics(lenet_plane):
+    """/v1/models listing, lifecycle endpoints (404 uses the KeyError
+    payload unquoted, no-candidate promote answers 409), plane-shaped
+    /v1/stats, and the model/cache Prometheus series."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm, plane, _ = lenet_plane
+    srv = ServeServer(reg, plane.active_engines(), port=0,
+                      plane=plane).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(base + "/v1/models") as r:
+            listing = json.loads(r.read())["models"]
+        assert listing["lenet5"]["active_version"] == 1
+        assert listing["lenet5"]["versions"][0]["state"] == ACTIVE
+        with urllib.request.urlopen(base + "/v1/stats") as r:
+            stats = json.loads(r.read())
+        assert set(stats) >= {"models", "cache", "plane"}
+        body = json.dumps({"pixels": np.zeros((32, 32, 1)).tolist()})
+        req = urllib.request.Request(
+            base + "/v1/models/lenet5/classify", data=body.encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            assert len(json.loads(r.read())["top"]) == 5
+        # unknown model on the path: 404, message straight from
+        # KeyError.args[0] — no doubled quotes from str(KeyError)
+        req = urllib.request.Request(
+            base + "/v1/models/nope/reload", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 404
+        err = json.loads(exc.value.read())["error"]
+        assert err.startswith("unknown model")
+        assert not err.startswith('"')
+        # promote with no candidate in flight: 409, not 200
+        req = urllib.request.Request(
+            base + "/v1/models/lenet5/promote", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 409
+        with urllib.request.urlopen(base + "/metrics") as r:
+            text = r.read().decode()
+        assert 'dvt_serve_model_up{model="lenet5"' in text
+        assert "dvt_serve_weight_cache_hits_total" in text
+        assert "dvt_serve_reloads_total" in text
+    finally:
+        srv.shutdown()
+
+
+def test_http_reload_endpoint_full_cycle(lenet_plane):
+    """POST /v1/models/lenet5/reload {force, wait} under live load:
+    200 with the promoted version in the body."""
+    import json
+    import urllib.request
+
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm, plane, _ = lenet_plane
+    srv = ServeServer(reg, plane.active_engines(), port=0,
+                      plane=plane).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    load = _LoadThread(plane, "lenet5", _img())
+    load.start()
+    try:
+        while load.served < 5:
+            time.sleep(0.01)
+        req = urllib.request.Request(
+            base + "/v1/models/lenet5/reload",
+            data=json.dumps({"force": True, "wait": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            out = json.loads(r.read())
+        assert out["status"] == "done"
+        assert out["version"]["version"] == 2
+        assert out["version"]["state"] == ACTIVE
+        assert load.errors == []
+    finally:
+        load.finish()
+        srv.shutdown()
