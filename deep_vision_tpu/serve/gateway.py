@@ -1016,12 +1016,15 @@ class _GatewayHandler(BaseHTTPRequestHandler):
     def _lifecycle_fanout(self, gw: Gateway, name: str, verb: str):
         """POST /v1/models/<name>/<verb> to every routable backend that
         serves ``name``; the per-backend verdicts come back keyed by
-        backend.  200 when at least one backend accepted."""
+        backend.  200 when at least one backend accepted; 409 when none
+        accepted but at least one answered 409 (reload already in
+        progress / nothing to promote — the fleet is busy, not broken);
+        502 only when every backend actually failed the call."""
         length = int(self.headers.get("Content-Length") or 0)
         body = self.rfile.read(length) if length > 0 else b"{}"
         now = time.monotonic()
         results: dict = {}
-        any_ok = False
+        any_ok = any_busy = False
         for b in gw.backends:
             if not b.routable(now) or not b.serves(name):
                 continue
@@ -1033,17 +1036,21 @@ class _GatewayHandler(BaseHTTPRequestHandler):
                     doc = json.loads(payload)
                 except ValueError:
                     doc = {"raw": payload.decode(errors="replace")}
-                results[b.name] = {"status": status, **(
+                # the HTTP code gets its own key: the backend's body
+                # carries a "status" verdict string (reloading/refused/
+                # in_progress) that must not mask it
+                results[b.name] = {"http_status": status, **(
                     doc if isinstance(doc, dict) else {"body": doc})}
                 any_ok = any_ok or status == 200
+                any_busy = any_busy or status == 409
             except (OSError, HTTPException) as e:
-                results[b.name] = {"status": None,
+                results[b.name] = {"http_status": None,
                                    "error": f"{type(e).__name__}: {e}"}
         if not results:
             self._reply(503, {"error": f"no routable backend serves "
                                        f"'{name}'"})
             return
-        self._reply(200 if any_ok else 502,
+        self._reply(200 if any_ok else (409 if any_busy else 502),
                     {"model": name, "verb": verb, "backends": results})
 
 

@@ -399,24 +399,33 @@ class ModelControlPlane:
         gradual-rollout path).  Builds + starts its engine, registers
         its weights with the cache, and publishes it in the registry."""
         engine = self.engine_factory(model)
+        mv = ModelVersion(0, model, engine, workdir=workdir)
+        # allocate the version number and publish the table entry in ONE
+        # critical section — two concurrent deploys (or a deploy racing
+        # a reload) must never mint the same number
         with self._lock:
             versions = self._table.setdefault(model.name, [])
-            v = (versions[-1].version + 1) if versions else 1
-        model.serve_version = v
-        mv = ModelVersion(v, model, engine, workdir=workdir)
-        if self.cache is not None and hasattr(model, "_live_variables"):
-            self.cache.register(model)
-        if start:
-            engine.start()
-        self.registry.add(model, version=v)
-        with self._lock:
+            mv.version = (versions[-1].version + 1) if versions else 1
+            model.serve_version = mv.version
             versions.append(mv)
+        try:
+            if self.cache is not None and \
+                    hasattr(model, "_live_variables"):
+                self.cache.register(model)
+            if start:
+                engine.start()
+        except Exception:  # noqa: BLE001 — cleanup only; re-raised to the boot caller
+            with self._lock:
+                versions.remove(mv)  # failed boot leaves no table entry
+            raise
+        self.registry.add(model, version=mv.version)
+        with self._lock:
             old = self._active.get(model.name)
             self._active[model.name] = mv
             mv.state = ACTIVE
         if old is not None:
             self._retire(old, reason="replaced by deploy")
-        event(_log, "deploy", model=model.name, version=v,
+        event(_log, "deploy", model=model.name, version=mv.version,
               step=model.restored_step)
         return mv
 
@@ -674,21 +683,25 @@ class ModelControlPlane:
                   error=f"{type(e).__name__}: {e}")
             return
         engine = self.engine_factory(sm)
+        mv = ModelVersion(0, sm, engine, workdir=old_mv.workdir)
+        # same single-critical-section allocation as deploy(): the
+        # version number and the table entry are minted atomically
         with self._lock:
             versions = self._table.setdefault(name, [])
-            v = (versions[-1].version + 1) if versions else 1
-        sm.serve_version = v
-        mv = ModelVersion(v, sm, engine, workdir=old_mv.workdir)
-        with self._lock:
+            mv.version = (versions[-1].version + 1) if versions else 1
+            sm.serve_version = mv.version
             versions.append(mv)
+        v = mv.version
         try:
             if self.cache is not None and \
                     hasattr(sm, "_live_variables"):
                 self.cache.register(sm)
             engine.start()
-            # warm the smallest bucket so the first canary request
-            # doesn't pay the compile
-            engine.warmup([engine.buckets[0]])
+            # warm EVERY bucket before entering shadow/canary: a canary
+            # request landing on a cold bucket would pay the compile,
+            # inflating the candidate's p99 and tripping the
+            # max_p99_ratio gate on a healthy version
+            engine.warmup()
         except Exception as e:  # noqa: BLE001 — version never served; mark and bail
             with self._lock:
                 mv.state = FAILED
@@ -696,16 +709,27 @@ class ModelControlPlane:
             engine.stop()
             if self.cache is not None:
                 self.cache.drop(sm)
+            self._release_weights(mv)
             event(_log, "reload_failed", model=name, version=v,
                   error=mv.state_reason)
             return
         event(_log, "reload_loaded", model=name, version=v,
               step=sm.restored_step, digest=sm.params_digest)
+        # each phase answers True (gates passed), False (gates failed),
+        # or None (the operator promoted/rolled back the candidate out
+        # from under the phase — the worker's verdict is moot and the
+        # guarded transitions below would no-op anyway)
         if self.policy.shadow_frac > 0:
-            if not self._run_shadow(name, mv):
+            ok = self._run_shadow(name, mv)
+            if ok is None:
+                return
+            if not ok:
                 self._rollback(name, mv, "shadow gate failed")
                 return
-        if not self._run_canary(name, mv):
+        ok = self._run_canary(name, mv)
+        if ok is None:
+            return
+        if not ok:
             self._rollback(name, mv, "canary gate failed")
             return
         self._promote(name, mv)
@@ -721,7 +745,7 @@ class ModelControlPlane:
                 return False
         return done()
 
-    def _run_shadow(self, name: str, mv: ModelVersion) -> bool:
+    def _run_shadow(self, name: str, mv: ModelVersion) -> bool | None:
         period = max(1, round(1.0 / self.policy.shadow_frac))
         with self._lock:
             mv.state = SHADOW
@@ -729,14 +753,21 @@ class ModelControlPlane:
         event(_log, "shadow_start", model=name, version=mv.version,
               period=period)
         try:
+            # an operator promote/rollback moves the candidate out of
+            # SHADOW under the lock — that ends the phase immediately
             ok = self._phase_wait(
-                lambda: mv.shadow_compared
+                lambda: mv.state != SHADOW
+                or mv.shadow_compared
                 >= self.policy.shadow_min_compared,
                 self.policy.phase_timeout_s)
         finally:
             with self._lock:
-                self._shadow.pop(name, None)
+                pair = self._shadow.get(name)
+                if pair is not None and pair[0] is mv:
+                    self._shadow.pop(name)
         with self._lock:
+            if mv.state != SHADOW:
+                return None  # operator decided mid-phase
             compared, agreed = mv.shadow_compared, mv.shadow_agreed
         if not ok:
             mv.state_reason = (f"shadow timeout: {compared}/"
@@ -753,7 +784,7 @@ class ModelControlPlane:
             return False
         return True
 
-    def _run_canary(self, name: str, mv: ModelVersion) -> bool:
+    def _run_canary(self, name: str, mv: ModelVersion) -> bool | None:
         period = max(1, round(1.0 / self.policy.canary_frac))
         with self._lock:
             mv.state = CANARY
@@ -762,9 +793,12 @@ class ModelControlPlane:
               period=period)
         try:
             ok = self._phase_wait(
-                lambda: mv.canary_requests >= self.policy.min_requests,
+                lambda: mv.state != CANARY
+                or mv.canary_requests >= self.policy.min_requests,
                 self.policy.phase_timeout_s)
             with self._lock:
+                if mv.state != CANARY:
+                    return None  # operator decided mid-phase
                 requests, errors = mv.canary_requests, mv.canary_errors
             if not ok:
                 mv.state_reason = (f"canary timeout: {requests}/"
@@ -800,42 +834,83 @@ class ModelControlPlane:
             return True
         finally:
             with self._lock:
-                self._canary.pop(name, None)
+                pair = self._canary.get(name)
+                if pair is not None and pair[0] is mv:
+                    self._canary.pop(name)
 
-    def _promote(self, name: str, mv: ModelVersion):
+    def _promote(self, name: str, mv: ModelVersion) -> bool:
         """Swap the routing table to ``mv`` FIRST, then drain the old
-        version — no instant exists where neither serves."""
+        version — no instant exists where neither serves.  The swap is
+        a guarded transition: both the reload worker and the operator
+        override land here, and only a candidate still in its rollout
+        (LOADING/SHADOW/CANARY) can win — a candidate the other side
+        already promoted or retired is left alone (returns False)."""
         with self._lock:
+            if mv.state not in (LOADING, SHADOW, CANARY):
+                return False
             old = self._active.get(name)
             self._active[name] = mv
             mv.state = ACTIVE
             self.promotions += 1
+            # the candidate stops being canary/shadow traffic the same
+            # instant it becomes the default route
+            for routes in (self._canary, self._shadow):
+                pair = routes.get(name)
+                if pair is not None and pair[0] is mv:
+                    routes.pop(name)
         self.registry.add(mv.model, version=mv.version)
         event(_log, "promote", model=name, version=mv.version,
               step=mv.model.restored_step)
-        if old is not None:
+        if old is not None and old is not mv:
             self._retire(old, reason=f"superseded by v{mv.version}")
+        return True
 
-    def _rollback(self, name: str, mv: ModelVersion, why: str):
+    @staticmethod
+    def _release_weights(mv: ModelVersion):
+        """Free a drained version's device weight copies: the model's
+        own variables AND every replica view's (for_device copies own
+        their device buffers — a ReplicatedEngine keeps one per chip)."""
+        mv.model.release_device_weights()
+        for rep in getattr(mv.engine, "replicas", None) or []:
+            view = getattr(rep, "model", None)
+            if view is not None and view is not mv.model:
+                view.release_device_weights()
+
+    def _rollback(self, name: str, mv: ModelVersion, why: str) -> bool:
+        """Guarded like ``_promote``: only a candidate still in its
+        rollout can be rolled back, so the worker's gate verdict can
+        never retire a version the operator just made ACTIVE."""
         with self._lock:
+            if mv.state not in (LOADING, SHADOW, CANARY):
+                return False
             self.rollbacks += 1
             reason = mv.state_reason or why
+            for routes in (self._canary, self._shadow):
+                pair = routes.get(name)
+                if pair is not None and pair[0] is mv:
+                    routes.pop(name)
         event(_log, "rollback", model=name, version=mv.version,
               reason=reason)
         self._retire(mv, reason=reason or why, rolled_back=True)
+        return True
 
     def _retire(self, mv: ModelVersion, *, reason: str,
                 rolled_back: bool = False):
         """DRAINING → RETIRED: admitted work finishes on the version
-        that admitted it, then the engine stops and the weights leave
-        the cache."""
+        that admitted it, then the engine stops, the weights leave the
+        cache, and the version's device weight copy is released (host
+        spill) — a retained-for-observability retired version costs
+        host RAM, never HBM."""
         with self._lock:
+            if mv.state in (DRAINING, RETIRED, FAILED):
+                return  # another thread is already retiring it
             mv.state = DRAINING
             if rolled_back or mv.state_reason is None:
                 mv.state_reason = reason
         mv.engine.stop(drain_deadline=5.0)
         if self.cache is not None:
             self.cache.drop(mv.model)
+        self._release_weights(mv)
         with self._lock:
             mv.state = RETIRED
             versions = self._table.get(mv.model.name, [])
@@ -844,32 +919,41 @@ class ModelControlPlane:
             for stale in retired[:-self.retain_retired] \
                     if self.retain_retired > 0 else []:
                 versions.remove(stale)
+                # the registry's version table must not outlive the
+                # retain window, or its refs pin the pruned weights
+                self.registry.remove_version(mv.model.name,
+                                             stale.version)
         event(_log, "retired", model=mv.model.name, version=mv.version,
               reason=reason)
 
     def promote(self, name: str) -> dict:
         """Operator override: promote the in-flight CANARY/SHADOW
-        candidate immediately, skipping the remaining gates."""
+        candidate immediately, skipping the remaining gates.  Decided
+        through the same guarded transition the reload worker uses, so
+        whichever side moves first wins and the other's verdict is a
+        no-op (the worker re-checks the candidate's state and bails)."""
         with self._lock:
             pair = self._canary.get(name) or self._shadow.get(name)
         if pair is None:
             return {"status": "refused", "model": name,
                     "reason": "no candidate in canary/shadow"}
-        self._promote(name, pair[0])
+        if not self._promote(name, pair[0]):
+            return {"status": "refused", "model": name,
+                    "reason": f"v{pair[0].version} already decided"}
         return {"status": "promoted", "model": name,
                 "version": pair[0].version}
 
     def rollback(self, name: str) -> dict:
-        """Operator override: retire the in-flight candidate now."""
+        """Operator override: retire the in-flight candidate now (same
+        guarded transition as ``promote``)."""
         with self._lock:
             pair = self._canary.get(name) or self._shadow.get(name)
-            if pair is not None:
-                self._canary.pop(name, None)
-                self._shadow.pop(name, None)
         if pair is None:
             return {"status": "refused", "model": name,
                     "reason": "no candidate in canary/shadow"}
-        self._rollback(name, pair[0], "operator rollback")
+        if not self._rollback(name, pair[0], "operator rollback"):
+            return {"status": "refused", "model": name,
+                    "reason": f"v{pair[0].version} already decided"}
         return {"status": "rolled_back", "model": name,
                 "version": pair[0].version}
 

@@ -110,6 +110,21 @@ class ServingModel:
     def compile_bucket(self, batch: int):
         raise NotImplementedError
 
+    def release_device_weights(self) -> None:
+        """Move this model's variables to host numpy, freeing their
+        device (HBM) copy.  The control plane calls this once a retired
+        version has drained, so versions retained for observability (or
+        versioned ``registry.get``) cost host RAM, never HBM.  A later
+        call still works — jax re-transfers host arrays on use — it is
+        just no longer resident."""
+        variables = getattr(self, "_variables", None)
+        if variables is None:
+            return
+        import jax
+
+        self._variables = jax.tree_util.tree_map(
+            np.asarray, jax.device_get(variables))
+
     def placement_desc(self) -> str | None:
         """Human-readable placement for stats/health (None = default)."""
         import jax
@@ -408,6 +423,17 @@ class ModelRegistry:
         if version is not None:
             self._versions.setdefault(model.name, {})[int(version)] = model
         return model
+
+    def remove_version(self, name: str, version: int) -> None:
+        """Forget one retained version (the control plane prunes
+        retired versions past its retain window here, so the registry's
+        refs don't pin pruned weights forever).  The default unversioned
+        ``_models`` entry is untouched."""
+        table = self._versions.get(name)
+        if table is not None:
+            table.pop(int(version), None)
+            if not table:
+                self._versions.pop(name, None)
 
     def load_checkpoint(self, config_name: str, workdir: str,
                         name: str | None = None,

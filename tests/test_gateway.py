@@ -37,7 +37,7 @@ class StubBackend:
 
     def __init__(self, tag: str):
         self.tag = tag
-        self.mode = "ok"            # ok | fail | shed
+        self.mode = "ok"            # ok | fail | shed | busy
         self.delay_s = 0.0
         self.healthz_status = 200
         self.retry_after = 2
@@ -82,6 +82,10 @@ class StubBackend:
                 elif stub.mode == "shed":
                     self._reply(429, {"error": "shed: queue_full"},
                                 {"Retry-After": stub.retry_after})
+                elif stub.mode == "busy":
+                    # a lifecycle verb the backend refuses: reload
+                    # already running / no candidate to promote
+                    self._reply(409, {"status": "in_progress"})
                 else:
                     self._reply(200, {"stub": stub.tag})
 
@@ -199,6 +203,49 @@ def test_kill_one_backend_loses_zero_requests():
         srv.shutdown()
         gw.stop()
         stubs[1].kill()
+
+
+def test_lifecycle_fanout_distinguishes_busy_fleet_from_failed():
+    """A fleet that uniformly answers 409 to a lifecycle verb (reload
+    already in progress everywhere) comes back as 409 — busy, not the
+    502 a genuinely failed fan-out earns; one accepting backend flips
+    the verdict to 200."""
+    stubs = [StubBackend("a"), StubBackend("b")]
+    for s in stubs:
+        s.mode = "busy"
+    gw = Gateway([s.url for s in stubs], probe_interval_s=60).start()
+    srv = GatewayServer(gw, port=0).start_background()
+    url = (f"http://127.0.0.1:{srv.port}"
+           f"/v1/models/lenet5/reload")
+    try:
+        req = urllib.request.Request(
+            url, data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 409
+        body = json.loads(exc.value.read())
+        assert all(v["http_status"] == 409
+                   for v in body["backends"].values())
+        assert all(v["status"] == "in_progress"
+                   for v in body["backends"].values())
+        stubs[0].mode = "ok"
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+            body = json.loads(r.read())
+        assert body["backends"][stubs[0].url]["http_status"] == 200
+        assert body["backends"][stubs[1].url]["http_status"] == 409
+        # a fleet that actually fails the call still reads as 502
+        for s in stubs:
+            s.mode = "fail"
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req, timeout=10)
+        assert exc.value.code == 502
+    finally:
+        srv.shutdown()
+        gw.stop()
+        for s in stubs:
+            s.kill()
 
 
 def test_probe_opens_breaker_without_traffic():

@@ -298,6 +298,172 @@ def test_shadow_compares_then_discards(lenet_plane):
     assert load.errors == []  # duplication never double-answers
 
 
+def _wait_for_state(plane, name, version, state, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for v in plane.models()[name]["versions"]:
+            if v["version"] == version and v["state"] == state:
+                return True
+        time.sleep(0.01)
+    return False
+
+
+def _join_reload(plane, name, timeout=20.0):
+    t = plane._reloading.get(name)  # the worker thread (test-only peek)
+    if t is not None:
+        t.join(timeout)
+        assert not t.is_alive()
+
+
+@pytest.mark.chaos
+def test_operator_promote_wins_over_worker_rollback(tmp_path):
+    """An operator promote mid-CANARY is final: the background worker —
+    whose phase would otherwise time out and roll back — must stand
+    down, NOT retire the now-ACTIVE version.  (The regression this
+    pins: the worker's rollback used to retire the promoted version,
+    leaving _active pointing at a stopped engine.)"""
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", str(tmp_path / "l"))
+    plane = ModelControlPlane(
+        reg, _engine_factory,
+        policy=CanaryPolicy(canary_frac=0.5, min_requests=10**6,
+                            max_p99_ratio=None, phase_timeout_s=30.0))
+    plane.deploy(sm)
+    try:
+        out = plane.reload("lenet5", _loader=lambda: _fresh_sm(sm))
+        assert out["status"] == "reloading"
+        assert _wait_for_state(plane, "lenet5", 2, "canary")
+        res = plane.promote("lenet5")
+        assert res == {"status": "promoted", "model": "lenet5",
+                       "version": 2}
+        _join_reload(plane, "lenet5")
+        st = plane.stats()
+        assert st["models"]["lenet5"]["active_version"] == 2
+        assert st["plane"]["promotions"] == 1
+        assert st["plane"]["rollbacks"] == 0  # worker stood down
+        states = {v["version"]: v["state"]
+                  for v in st["models"]["lenet5"]["versions"]}
+        assert states == {1: RETIRED, 2: ACTIVE}
+        # the promoted version actually answers — its engine never
+        # stopped, and v2 is queryable through the registry
+        r = plane.infer("lenet5", _img(), timeout=30)
+        assert not isinstance(r, (Shed, Quarantined))
+        assert reg.get("lenet5", version=2) is not None
+        # a second promote finds nothing in flight
+        assert plane.promote("lenet5")["status"] == "refused"
+    finally:
+        plane.stop()
+
+
+@pytest.mark.chaos
+def test_operator_rollback_wins_over_worker_promote(tmp_path):
+    """The symmetric race: after an operator rollback mid-SHADOW the
+    worker must not promote the retired candidate (a stopped engine
+    must never become the active route)."""
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", str(tmp_path / "l"))
+    plane = ModelControlPlane(
+        reg, _engine_factory,
+        policy=CanaryPolicy(canary_frac=0.5, min_requests=1,
+                            shadow_frac=1.0,
+                            shadow_min_compared=10**6,
+                            max_p99_ratio=None, phase_timeout_s=30.0))
+    plane.deploy(sm)
+    try:
+        out = plane.reload("lenet5", _loader=lambda: _fresh_sm(sm))
+        assert out["status"] == "reloading"
+        assert _wait_for_state(plane, "lenet5", 2, "shadow")
+        res = plane.rollback("lenet5")
+        assert res == {"status": "rolled_back", "model": "lenet5",
+                       "version": 2}
+        _join_reload(plane, "lenet5")
+        st = plane.stats()
+        assert st["models"]["lenet5"]["active_version"] == 1
+        assert st["plane"]["promotions"] == 0  # worker did NOT promote
+        assert st["plane"]["rollbacks"] == 1
+        states = {v["version"]: v["state"]
+                  for v in st["models"]["lenet5"]["versions"]}
+        assert states == {1: ACTIVE, 2: RETIRED}
+        assert st["models"]["lenet5"]["versions"][-1]["state_reason"] \
+            == "operator rollback"
+        r = plane.infer("lenet5", _img(), timeout=30)
+        assert not isinstance(r, (Shed, Quarantined))
+    finally:
+        plane.stop()
+
+
+def test_retired_version_releases_weights_and_prunes_registry(tmp_path):
+    """Repeated reloads must not pin one HBM weight copy per retired
+    version: a retired version's variables move to host numpy, and
+    versions pruned past ``retain_retired`` also leave the registry's
+    version table."""
+    import jax
+
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", str(tmp_path / "l"))
+    plane = ModelControlPlane(
+        reg, _engine_factory,
+        policy=CanaryPolicy(canary_frac=0.5, min_requests=1,
+                            max_p99_ratio=None, phase_timeout_s=15.0),
+        retain_retired=1)
+    plane.deploy(sm)
+    load = _LoadThread(plane, "lenet5", _img())
+    load.start()
+    try:
+        while load.served < 3:
+            time.sleep(0.01)
+        sm2 = _fresh_sm(sm)
+        out = plane.reload("lenet5", wait=True, _loader=lambda: sm2)
+        assert out["version"]["state"] == ACTIVE
+        # retired v1 spilled to host: no leaf is a device array
+        leaves = jax.tree_util.tree_leaves(sm._variables)
+        assert leaves
+        assert all(isinstance(a, np.ndarray) for a in leaves)
+        # ...while the active v2 stays device-backed and serving
+        assert any(isinstance(a, jax.Array) for a in
+                   jax.tree_util.tree_leaves(sm2._variables))
+        out = plane.reload("lenet5", wait=True,
+                           _loader=lambda: _fresh_sm(sm2))
+        assert out["version"]["version"] == 3
+        assert out["version"]["state"] == ACTIVE
+        # retain_retired=1 keeps only v2's corpse: v1 left the table
+        # AND the registry's version index
+        versions = [v["version"] for v in
+                    plane.models()["lenet5"]["versions"]]
+        assert 1 not in versions and versions[-1] == 3
+        with pytest.raises(KeyError):
+            reg.get("lenet5", version=1)
+        assert reg.get("lenet5", version=2) is sm2
+        assert load.errors == []
+    finally:
+        load.finish()
+        plane.stop()
+
+
+def test_deploy_failure_leaves_no_table_entry(tmp_path):
+    """A deploy whose engine fails to start must not leak a LOADING
+    version into the table (and the next deploy reuses the number)."""
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", str(tmp_path / "l"))
+
+    class _BoomEngine:
+        def start(self):
+            raise RuntimeError("boom")
+
+    plane = ModelControlPlane(reg, lambda m: _BoomEngine())
+    with pytest.raises(RuntimeError):
+        plane.deploy(sm)
+    listing = plane.models().get("lenet5", {})
+    assert listing.get("versions", []) == []
+    assert listing.get("active_version") is None
+    plane2 = ModelControlPlane(reg, _engine_factory)
+    mv = plane2.deploy(sm)
+    try:
+        assert mv.version == 1
+    finally:
+        plane2.stop()
+
+
 def test_reload_refused_without_workdir_and_while_in_progress(tmp_path):
     reg = ModelRegistry()
     sm = reg.load_checkpoint("lenet5", str(tmp_path / "l"))
