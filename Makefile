@@ -39,9 +39,23 @@ lint-test:
 # machine-checked invariants fails here before any engine boots
 serve-smoke: lint lint-test
 	$(PY) tests/serve_smoke.py
+	$(PY) tests/quant_smoke.py
 	$(PY) tests/model_smoke.py
 	$(PY) tests/gateway_smoke.py
 	$(PY) tests/obs_smoke.py
+
+# the int8 quantization path end to end: calibrate at load, serve
+# int8-resident weights over real HTTP next to an f32 lane on the same
+# weights, gate on top-1 agreement, the describe() quant block, and
+# weight HBM <= 0.27x f32 (docs/SERVING.md "Int8 inference")
+quant-smoke:
+	$(PY) tests/quant_smoke.py
+
+# the quantization unit/parity suite alone (per-channel roundtrip,
+# calibration determinism, Pallas-vs-XLA ingest parity, weight-cache
+# density, StableHLO rejection)
+quant-test:
+	$(PY) -m pytest tests/test_quant.py -q -m serve
 
 # the multi-model control plane end to end: two models behind one plane
 # on a weight-cache budget that holds only one of them (evict -> spill
@@ -109,10 +123,11 @@ bench-serve-sync:
 bench-serve-scaling:
 	$(PY) bench.py --serve --serve-devices 8
 
-# wire-format comparison: {float32, uint8} wire x {float32, bfloat16}
-# compute — p50/p95/p99, img/s, and H2D bytes/batch per cell
-# (docs/PERF.md "Wire format & inference dtype"); the uint8 wire must
-# show exactly 4x fewer H2D bytes than float32
+# wire-format comparison: {float32, uint8} wire x {float32, bfloat16,
+# int8} compute — p50/p95/p99, img/s, H2D bytes/batch, and resident
+# weight bytes per cell (docs/PERF.md "Wire format & inference
+# dtype"); the uint8 wire must show exactly 4x fewer H2D bytes than
+# float32 and the int8 cells <= 0.27x the f32 weight HBM
 bench-serve-wire:
 	$(PY) bench.py --serve --serve-wire
 
@@ -154,4 +169,5 @@ list:
 .PHONY: test test-all bench bench-serve bench-serve-sync \
 	bench-serve-scaling bench-serve-wire bench-gateway serve-smoke \
 	serve-multi serve-chaos gateway-smoke gateway-test obs-smoke \
-	obs-test model-smoke model-test lint lint-test list
+	obs-test model-smoke model-test quant-smoke quant-test lint \
+	lint-test list

@@ -509,6 +509,7 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                 serve_devices: int = 1,
                 wire_dtype: str = "float32",
                 infer_dtype: str = "float32",
+                calib_batches: int = 2,
                 trace: bool = True) -> dict:
     """Closed-loop load generator against the dynamic-batching engine
     (``deep_vision_tpu/serve``): C client threads each submit one image,
@@ -538,10 +539,12 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
 
     ``wire_dtype``/``infer_dtype`` select the serving wire format and
     on-device compute dtype (docs/SERVING.md); the JSON records both
-    plus the ``h2d`` block (transfers, MiB, per-bucket bytes) so
-    BENCH_* trajectories track transfer volume alongside latency —
-    ``bench.py --serve --serve-wire`` runs the full 4-cell comparison
-    (``bench_serve_wire``).
+    plus the ``h2d`` block (transfers, MiB, per-bucket bytes) and the
+    resident ``weight_hbm_bytes`` so BENCH_* trajectories track
+    transfer volume and weight footprint alongside latency —
+    ``bench.py --serve --serve-wire`` runs the full 6-cell comparison
+    (``bench_serve_wire``); ``infer_dtype="int8"`` calibrates with
+    ``calib_batches`` synthetic batches (serve/quant.py).
 
     ``trace`` toggles per-request span collection (obs/trace.py): the
     JSON gains ``serving_mfu``/``mfu`` (analytic-FLOPs utilization,
@@ -570,7 +573,8 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                                   log=lambda m: print(m, file=sys.stderr))
     sm = CheckpointServingModel(model_name, cfg, model, state,
                                 wire_dtype=wire_dtype,
-                                infer_dtype=infer_dtype)
+                                infer_dtype=infer_dtype,
+                                calib_batches=calib_batches)
     if sm.wire_dtype == np.uint8:
         img = np.random.RandomState(0).randint(
             0, 256, size=sm.input_shape, dtype=np.uint8)
@@ -661,6 +665,9 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
             "pipeline_depth": pipeline_depth,
             "wire_dtype": stats["wire_dtype"],
             "infer_dtype": stats["infer_dtype"],
+            "weight_hbm_bytes": stats.get("weight_hbm_bytes"),
+            "calib_batches": (calib_batches
+                              if infer_dtype == "int8" else None),
             "faults": faults or None,
             "loads": points,
             "h2d": {
@@ -749,15 +756,19 @@ def bench_serve_scaling(serve_devices: int, **kwargs) -> dict:
 
 def bench_serve_wire(**kwargs) -> dict:
     """Wire-format comparison sweep (``make bench-serve-wire``): the
-    serve bench across all four wire × compute cells — f32/uint8 wire ×
-    f32/bf16 device compute — so the uint8 wire's 4× H2D-byte cut and
-    bf16's latency effect are measured side by side (docs/PERF.md
-    "Serving wire format").  Emits the full detail of the last cell
-    (uint8 + bf16, the production configuration) plus ``wire_sweep``:
-    p50/p95/p99, img/s, and H2D bytes/batch per cell."""
+    serve bench across all six wire × compute cells — f32/uint8 wire ×
+    f32/bf16/int8 device compute — so the uint8 wire's 4× H2D-byte cut,
+    bf16's latency effect, and int8's ~4× weight-HBM cut are measured
+    side by side (docs/PERF.md "Serving wire format").  Emits the full
+    detail of the last cell (uint8 + int8, the smallest-footprint
+    configuration) plus ``wire_sweep``: p50/p95/p99, img/s, H2D
+    bytes/batch, and resident weight bytes per cell.
+    ``weight_hbm_ratio_int8_over_f32`` is the acceptance number for the
+    int8 quantization path (≤ 0.27 expected; serve/quant.py keeps
+    biases and BN f32, so the ratio sits just above 0.25)."""
     table, last = [], None
     for wire in ("float32", "uint8"):
-        for infer in ("float32", "bfloat16"):
+        for infer in ("float32", "bfloat16", "int8"):
             last = bench_serve(wire_dtype=wire, infer_dtype=infer,
                                **kwargs)
             top = last["loads"][-1]
@@ -767,13 +778,22 @@ def bench_serve_wire(**kwargs) -> dict:
                 "p50_ms": top["p50_ms"], "p95_ms": top["p95_ms"],
                 "p99_ms": top["p99_ms"], "errors": top["errors"],
                 "h2d_mib": last["h2d"]["mib"],
-                "h2d_bytes_per_batch": last["h2d"]["bytes_per_batch"]})
+                "h2d_bytes_per_batch": last["h2d"]["bytes_per_batch"],
+                "weight_hbm_bytes": last.get("weight_hbm_bytes"),
+                "calib_batches": last.get("calib_batches")})
     f32w = [r for r in table if r["wire_dtype"] == "float32"]
     u8w = [r for r in table if r["wire_dtype"] == "uint8"]
     if f32w and u8w and u8w[0]["h2d_bytes_per_batch"]:
         last["h2d_bytes_ratio_f32_over_u8"] = round(
             f32w[0]["h2d_bytes_per_batch"]
             / u8w[0]["h2d_bytes_per_batch"], 2)
+    f32c = [r for r in table if r["infer_dtype"] == "float32"
+            and r["weight_hbm_bytes"]]
+    i8c = [r for r in table if r["infer_dtype"] == "int8"
+           and r["weight_hbm_bytes"]]
+    if f32c and i8c:
+        last["weight_hbm_ratio_int8_over_f32"] = round(
+            i8c[0]["weight_hbm_bytes"] / f32c[0]["weight_hbm_bytes"], 4)
     last["wire_sweep"] = table
     return last
 

@@ -79,21 +79,23 @@ def build_server(args):
                              "checkpoint-path only")
         return _build_plane_server(args, registry, wire_dtype,
                                    infer_dtype)
+    calib_batches = int(getattr(args, "calib_batches", 2) or 2)
+    calib_dir = getattr(args, "calib_dir", None)
     if args.stablehlo:
-        if infer_dtype != "float32":
-            raise ValueError(
-                "--stablehlo serves the blob's exported float32 "
-                "signature; --infer-dtype bfloat16 needs the checkpoint "
-                "path (re-serve without --stablehlo)")
         # blobs were traced at float32 with host-side normalization —
-        # the wire knob doesn't apply (describe() shows the real wire)
+        # the wire knob doesn't apply (describe() shows the real wire);
+        # a non-f32 --infer-dtype is rejected by the registry with the
+        # single "f32-wire/f32-compute only" error
         wire_dtype = "float32"
         sm = registry.load_exported(args.model, args.stablehlo,
-                                    args.workdir)
+                                    args.workdir,
+                                    infer_dtype=infer_dtype)
     else:
         sm = registry.load_checkpoint(args.model, args.workdir,
                                       wire_dtype=wire_dtype,
-                                      infer_dtype=infer_dtype)
+                                      infer_dtype=infer_dtype,
+                                      calib_batches=calib_batches,
+                                      calib_dir=calib_dir)
     buckets = [int(b) for b in args.buckets.split(",")] if args.buckets \
         else None
     fault_spec = getattr(args, "faults", None)
@@ -250,9 +252,11 @@ def _build_plane_server(args, registry, wire_dtype: str,
                               admission_factory=admission_for)
     for name in names:
         workdir = os.path.join(args.workdir, name)
-        sm = registry.load_checkpoint(name, workdir,
-                                      wire_dtype=wire_dtype,
-                                      infer_dtype=infer_dtype)
+        sm = registry.load_checkpoint(
+            name, workdir, wire_dtype=wire_dtype,
+            infer_dtype=infer_dtype,
+            calib_batches=int(getattr(args, "calib_batches", 2) or 2),
+            calib_dir=getattr(args, "calib_dir", None))
         plane.deploy(sm, workdir=workdir)
     if args.warmup:
         for name, eng in plane.active_engines().items():
@@ -311,12 +315,27 @@ def main(argv=None):
                         "float32 = host-preprocessed floats (the "
                         "pre-uint8 contract).  StableHLO blobs always "
                         "serve their exported float32 signature")
-    p.add_argument("--infer-dtype", choices=("float32", "bfloat16"),
+    p.add_argument("--infer-dtype",
+                   choices=("float32", "bfloat16", "int8"),
                    default="float32",
                    help="on-device compute dtype: bfloat16 casts params "
                         "once at load and runs bucket programs in bf16 "
                         "with float32 outputs (docs/SERVING.md bf16 "
-                        "caveats); checkpoint path only")
+                        "caveats); int8 post-training-quantizes weights "
+                        "at load (per-channel scales, calibrated "
+                        "activation scale, fused Pallas ingest, f32 "
+                        "outputs — docs/SERVING.md int8 section); "
+                        "checkpoint path only")
+    p.add_argument("--calib-batches", type=int, default=2,
+                   help="int8 calibration: batches run through the "
+                        "instrumented forward to collect activation "
+                        "absmax ranges (--infer-dtype int8 only)")
+    p.add_argument("--calib-dir", default=None,
+                   help="int8 calibration: directory of held-out uint8 "
+                        "*.npy images (HWC or NHWC); default = "
+                        "deterministic synthetic batches — fine for "
+                        "latency work, use real data before trusting "
+                        "the accuracy gate (docs/SERVING.md)")
     p.add_argument("--serve-devices", type=int, default=1,
                    help="replicate the engine over this many local "
                         "devices behind one queue (0 = all; default 1 "

@@ -161,6 +161,9 @@ class AdversarialTrainer:
             step, states,
             extras={"epoch": epoch - 1,
                     "scheduler": self.scheduler.state_dict()})
+        # block until durable: the preempt grace window is the one
+        # place an async save must not still be in flight
+        self.checkpointer.wait_until_finished()
         if self.uploader is not None:
             # the VM disappears seconds after SIGTERM — the preempt
             # save is the one that MUST reach off-host
@@ -201,6 +204,8 @@ class AdversarialTrainer:
                     extras={"epoch": epoch,
                             "scheduler": self.scheduler.state_dict()})
                 if self.uploader is not None:
+                    # async save must land before the mirror copies it
+                    self.checkpointer.wait_until_finished()
                     self.uploader.sync(self.checkpointer.directory,
                                        "checkpoints")
             if sample_hook is not None:

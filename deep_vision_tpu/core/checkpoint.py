@@ -19,8 +19,21 @@ from deep_vision_tpu.core.state import TrainState
 
 
 class Checkpointer:
-    def __init__(self, directory: str, max_to_keep: int = 3):
+    """``async_save=True`` (the default) lets ``save()``/``save_tree()``
+    return as soon as Orbax has snapshotted the arrays, with
+    serialization finishing in the background — the train loop pays
+    device→host copy time, not disk time (ROADMAP item: async
+    checkpointing).  The wait moves to where durability is actually
+    needed: the start of the NEXT save (at most one save in flight),
+    every read/restore/introspection path, ``close()``, and explicit
+    ``wait_until_finished()`` calls (the trainer's SIGTERM preempt path
+    blocks on it before announcing the checkpoint durable).
+    ``async_save=False`` restores the old save-then-wait behavior."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 async_save: bool = True):
         self.directory = os.path.abspath(directory)
+        self.async_save = bool(async_save)
         os.makedirs(self.directory, exist_ok=True)
         self._mgr = ocp.CheckpointManager(
             self.directory,
@@ -35,10 +48,20 @@ class Checkpointer:
             },
         )
 
+    def wait_until_finished(self):
+        """Block until any in-flight async save is durable on disk —
+        the preempt/exit/upload barrier.  A no-op when nothing is
+        pending (or when ``async_save=False``, where every save already
+        waited)."""
+        self._mgr.wait_until_finished()
+
     def save(self, step: int, state: TrainState, extras: dict | None = None,
              force: bool = False):
         """``extras`` must be JSON-serializable (epoch, scheduler, history)."""
         payload = {"state": state.save_dict()}
+        # at most one save in flight: the previous async save must
+        # finalize before this step starts writing
+        self._mgr.wait_until_finished()
         self._mgr.save(
             step,
             args=ocp.args.Composite(
@@ -47,20 +70,24 @@ class Checkpointer:
             ),
             force=force,
         )
-        self._mgr.wait_until_finished()
+        if not self.async_save:
+            self._mgr.wait_until_finished()
 
     def latest_step(self) -> int | None:
+        self._mgr.wait_until_finished()  # an in-flight save counts
         return self._mgr.latest_step()
 
     def all_steps(self) -> list[int]:
         """Retained checkpoint steps, ascending — the restore fallback
         (core/restore.py) walks these newest-first when the latest
         checkpoint is corrupt or partially written."""
+        self._mgr.wait_until_finished()  # an in-flight save counts
         return sorted(self._mgr.all_steps())
 
     def _state_meta(self, step: int | None) -> dict:
         """The stored state payload's metadata dict ({} when absent) —
         the one place that knows the save() payload nesting."""
+        self._mgr.wait_until_finished()  # metadata must be finalized
         if step is None:
             step = self._mgr.latest_step()
         if step is None:
@@ -90,6 +117,7 @@ class Checkpointer:
         checkpoint predates (e.g. ``bad_steps``) are dropped from the
         template and left at their fresh-state values, so old checkpoints
         stay restorable after TrainState grows a field."""
+        self._mgr.wait_until_finished()  # restore needs a durable step
         abstract = jax.tree_util.tree_map(
             ocp.utils.to_shape_dtype_struct, template)
         try:
@@ -138,6 +166,7 @@ class Checkpointer:
 
     def save_tree(self, step: int, states: dict, extras: dict | None = None):
         payload = {k: v.save_dict() for k, v in states.items()}
+        self._mgr.wait_until_finished()  # one save in flight, as save()
         self._mgr.save(
             step,
             args=ocp.args.Composite(
@@ -145,7 +174,8 @@ class Checkpointer:
                 extras=ocp.args.JsonSave(extras or {}),
             ),
         )
-        self._mgr.wait_until_finished()
+        if not self.async_save:
+            self._mgr.wait_until_finished()
 
     def restore_tree(self, states: dict, step: int | None = None
                      ) -> tuple[dict, dict]:
@@ -159,4 +189,7 @@ class Checkpointer:
         return new_states, extras
 
     def close(self):
+        # an async save still in flight must land before the manager
+        # tears down its thread pool
+        self._mgr.wait_until_finished()
         self._mgr.close()

@@ -611,6 +611,9 @@ class Trainer:
                 # mid-epoch save as epoch-1: resume re-runs this epoch
                 # from its start but keeps every applied step/param update
                 self.save(state, epoch - 1)
+                # the VM disappears seconds after SIGTERM: block until
+                # the (possibly async) save is durable before reporting
+                self.checkpointer.wait_until_finished()
                 print(f"[preempt] checkpoint saved at step "
                       f"{int(jax.device_get(state.step))}; rerun with "
                       f"--resume to continue", flush=True)
@@ -630,6 +633,7 @@ class Trainer:
                 # SIGTERM during validation: save NOW — the preemption
                 # grace period is too short for best-ckpt/scheduler work
                 self.save(state, epoch)
+                self.checkpointer.wait_until_finished()  # durable first
                 print(f"[preempt] checkpoint saved at step "
                       f"{int(jax.device_get(state.step))}; rerun with "
                       f"--resume to continue", flush=True)
@@ -646,6 +650,9 @@ class Trainer:
                     extras={"epoch": epoch, "metric": float(metric_val),
                             "monitor": monitor or ""})
                 if self.uploader is not None:
+                    # the async save must be on disk before the mirror
+                    # copies the directory (else it uploads a partial)
+                    self.best_checkpointer.wait_until_finished()
                     self.uploader.sync(self.best_checkpointer.directory,
                                        "checkpoints_best")
         return state
@@ -657,4 +664,6 @@ class Trainer:
                     "scheduler": self.scheduler.state_dict(),
                     "history": self.logger.state_dict()})
         if self.uploader is not None:
+            # durability barrier before the mirror walks the directory
+            self.checkpointer.wait_until_finished()
             self.uploader.sync(self.checkpointer.directory, "checkpoints")

@@ -70,15 +70,25 @@ def compiled_flops(compiled) -> float | None:
 
 
 def params_flops_lower_bound(variables, batch: int) -> float:
-    """The documented fallback: 2 × float-param count × batch (one
+    """The documented fallback: 2 × param count × batch (one
     multiply-add per weight per image — exact for dense layers, a lower
-    bound for convolutions, which reuse each weight spatially)."""
+    bound for convolutions, which reuse each weight spatially).
+
+    Counts float leaves AND int8 leaves: a quantized variables tree
+    (serve/quant.py) stores its conv/dense kernels as int8, but each
+    dequantized weight still does one MAC per image — excluding them
+    would collapse the int8 serving-MFU numerator to biases+scales."""
     import jax
     import numpy as np
 
+    i8 = np.dtype("int8")
+
+    def _counts(a) -> bool:
+        dt = getattr(a, "dtype", np.dtype("O"))
+        return dt.kind == "f" or dt == i8
+
     n = sum(int(np.prod(a.shape))
-            for a in jax.tree_util.tree_leaves(variables)
-            if getattr(a, "dtype", np.dtype("O")).kind == "f")
+            for a in jax.tree_util.tree_leaves(variables) if _counts(a))
     return 2.0 * n * batch
 
 

@@ -1,5 +1,17 @@
 """Pallas TPU kernels for hot ops.
 
+``serve_ingest``: the int8 serving prologue — uint8 decode + mean/std
+normalize + symmetric activation quantize fused into one VMEM pass
+(serve/quant.py, docs/SERVING.md "Wire format & inference dtype").  The
+XLA formulation materializes the normalized f32 HWC tensor in HBM (4×
+the wire bytes) before the quantize reads it back; this kernel streams
+the uint8 rows through VMEM and writes int8 straight out, so the only
+HBM traffic is wire-bytes in, wire-bytes out.  Layout: the NHWC batch
+is viewed as (B·H, W·C) rows — per-channel mean/std tile along the
+W·C lane axis — with rows tiled through the grid and lanes padded to
+the 128-lane width.  CPU tests run the same kernel via
+``interpret=True`` (the ``best_iou_max`` pattern below).
+
 ``best_iou_max``: for every predicted box, the max IoU against the image's
 (padded, masked) ground-truth boxes — the YOLO ignore-mask inner loop
 (tasks/detection.yolo_scale_loss).  The XLA formulation materializes a
@@ -23,10 +35,149 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 TILE_N = 256
 LANE = 128
+#: serve_ingest row tile (sublane dim of the (B·H, W·C) view) — a
+#: multiple of the int8 sublane granularity (32) so the quantized
+#: output block tiles cleanly
+INGEST_TILE_R = 256
+
+
+def _ingest_norm_constants(kind: str, channels: int):
+    """Per-channel (mean, std) f32 vectors for ``kind`` — the SAME
+    values ops/preprocess.serve_normalize subtracts/divides, so the
+    fused kernel is bit-compatible with the XLA prologue (imported from
+    the data modules directly to keep ops.preprocess → pallas_ops a
+    one-way dependency)."""
+    from deep_vision_tpu.data.mnist import MEAN as MNIST_MEAN
+    from deep_vision_tpu.data.mnist import STD as MNIST_STD
+    from deep_vision_tpu.data.transforms import IMAGENET_MEAN, IMAGENET_STD
+
+    if kind == "imagenet":
+        mean = np.asarray(IMAGENET_MEAN, np.float32)
+        std = np.asarray(IMAGENET_STD, np.float32)
+    elif kind == "mnist":
+        mean = np.full((channels,), MNIST_MEAN, np.float32)
+        std = np.full((channels,), MNIST_STD, np.float32)
+    elif kind == "unit":
+        mean = np.zeros((channels,), np.float32)
+        std = np.ones((channels,), np.float32)
+    else:
+        raise ValueError(f"unknown serve preprocess kind '{kind}'")
+    if mean.shape[0] != channels:
+        raise ValueError(
+            f"'{kind}' normalization is {mean.shape[0]}-channel; "
+            f"input has {channels}")
+    return mean, std
+
+
+def _serve_ingest_kernel(x_ref, mean_ref, std_ref, out_ref, *,
+                         act_scale: float, quantize: bool):
+    # dvtlint: traced
+    # one (TILE_R, lanes) block: decode, normalize, quantize, store —
+    # division (not reciprocal-multiply) keeps it bit-identical to the
+    # XLA serve_normalize/quantize_activations path
+    x = x_ref[...].astype(jnp.float32) / 255.0
+    y = (x - mean_ref[...]) / std_ref[...]
+    if quantize:
+        q = jnp.clip(jnp.round(y / act_scale), -127.0, 127.0)
+        out_ref[...] = q.astype(jnp.int8)
+    else:
+        out_ref[...] = y
+
+
+@functools.partial(jax.jit, static_argnames=("kind", "act_scale",
+                                             "quantize", "interpret"))
+def serve_ingest(x, kind: str, act_scale: float = 1.0,
+                 quantize: bool = True, interpret: bool = False):
+    """uint8 NHWC wire batch → int8 activations (or normalized f32
+    when ``quantize=False`` — the decode+normalize-only mode the parity
+    tests compare exactly against serve_normalize).
+
+    ``act_scale`` is the per-tensor symmetric activation scale from
+    calibration (serve/quant.py): ``q = round(normalized/act_scale)``
+    clipped to ±127.  Static per program — each int8 model's bucket
+    programs bake their own scale in at AOT-compile time.
+    """
+    B, H, W, C = x.shape
+    mean_c, std_c = _ingest_norm_constants(kind, C)
+    rows, lanes = B * H, W * C
+    r_pad = (-rows) % INGEST_TILE_R
+    l_pad = (-lanes) % LANE
+    rows_p, lanes_p = rows + r_pad, lanes + l_pad
+    x2 = jnp.pad(x.reshape(rows, lanes), ((0, r_pad), (0, l_pad)))
+    # per-lane constants: channel-fastest, matching the (W, C) flatten;
+    # pad std with 1.0 so the dead lanes don't divide by zero
+    mean_row = np.pad(np.tile(mean_c, W), (0, l_pad))[None, :]
+    std_row = np.pad(np.tile(std_c, W), (0, l_pad),
+                     constant_values=1.0)[None, :]
+    out = pl.pallas_call(
+        functools.partial(_serve_ingest_kernel,
+                          act_scale=float(act_scale),
+                          quantize=bool(quantize)),
+        out_shape=jax.ShapeDtypeStruct(
+            (rows_p, lanes_p), jnp.int8 if quantize else jnp.float32),
+        grid=(rows_p // INGEST_TILE_R,),
+        in_specs=[
+            pl.BlockSpec((INGEST_TILE_R, lanes_p), lambda r: (r, 0)),
+            pl.BlockSpec((1, lanes_p), lambda r: (0, 0)),
+            pl.BlockSpec((1, lanes_p), lambda r: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((INGEST_TILE_R, lanes_p),
+                               lambda r: (r, 0)),
+        interpret=interpret,
+    )(x2, jnp.asarray(mean_row, jnp.float32),
+      jnp.asarray(std_row, jnp.float32))
+    return out[:rows, :lanes].reshape(B, H, W, C)
+
+
+def serve_ingest_auto(x, kind: str, act_scale: float = 1.0,
+                      quantize: bool = True):
+    """Pallas on TPU; interpret-mode elsewhere (tests, CPU serving)."""
+    on_tpu = jax.default_backend() == "tpu"
+    return serve_ingest(x, kind, act_scale=act_scale, quantize=quantize,
+                        interpret=not on_tpu)
+
+
+_INGEST_PARITY_CACHE: dict[tuple, bool] = {}
+
+
+def ingest_parity_ok(shape: tuple, kind: str, act_scale: float,
+                     interpret: bool = False) -> bool:
+    """One-batch parity check of the compiled ingest kernel vs the pure
+    jnp reference, gated per (shape, kind) before a bucket program
+    selects the Pallas path on real hardware (the ``pallas_parity_ok``
+    pattern: Mosaic lowering is environment- and shape-sensitive, so a
+    compile failure or >1-step divergence falls back to XLA)."""
+    key = (tuple(shape), kind, round(float(act_scale), 12))
+    if key in _INGEST_PARITY_CACHE and not interpret:
+        return _INGEST_PARITY_CACHE[key]
+    try:
+        B, H, W, C = shape
+        raw = np.random.RandomState(7).randint(0, 256, shape, np.uint8)
+        got = np.asarray(jax.device_get(
+            serve_ingest(jnp.asarray(raw), kind, act_scale=act_scale,
+                         interpret=interpret))).astype(np.int32)
+        mean_c, std_c = _ingest_norm_constants(kind, C)
+        y = (raw.astype(np.float32) / 255.0 - mean_c) / std_c
+        want = np.clip(np.round(y / float(act_scale)), -127.0,
+                       127.0).astype(np.int32)
+        err = int(np.abs(got - want).max())
+        ok = err <= 1  # one quantization step of rounding slack
+        if not ok:
+            print(f"[pallas] ingest parity FAILED (max err {err} steps)"
+                  " — falling back to the XLA serve prologue")
+    except Exception as e:  # noqa: BLE001 — compile/runtime failure → XLA fallback
+        print(f"[pallas] ingest kernel unavailable "
+              f"({type(e).__name__}: {e}) — falling back to the XLA "
+              f"serve prologue")
+        ok = False
+    if not interpret:
+        _INGEST_PARITY_CACHE[key] = ok
+    return ok
 
 
 def _best_iou_kernel(pred_ref, gt_ref, mask_ref, out_ref):

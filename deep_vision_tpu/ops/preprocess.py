@@ -76,6 +76,44 @@ def make_serve_preprocess(kind: str, wire_dtype, compute_dtype=jnp.float32):
     return fn
 
 
+def quantize_activations(x, act_scale: float):  # dvtlint: traced
+    """Normalized float activations → symmetric int8 with the per-tensor
+    calibration scale (serve/quant.py): ``round(x/act_scale)`` clipped
+    to ±127.  The XLA half of the int8 ingest — same math as the fused
+    Pallas kernel, kept for parity testing and the float32 wire."""
+    q = jnp.clip(jnp.round(x / act_scale), -127.0, 127.0)
+    return q.astype(jnp.int8)
+
+
+def make_int8_ingest(kind: str, wire_dtype, act_scale: float,
+                     use_pallas: bool = True):
+    """Traced int8 serve-prologue (``--infer-dtype int8`` bucket
+    programs, serve/registry.py): the batch leaves as int8 activations
+    the program dequantizes into its first conv.
+
+    A uint8 wire takes the FUSED path — decode + normalize + quantize in
+    one VMEM pass (ops/pallas_ops.serve_ingest; interpret-mode off-TPU)
+    so the wire bytes never materialize as an f32 HWC tensor in HBM —
+    unless ``use_pallas`` is False (the XLA fallback kept for parity
+    testing, or a failed on-TPU parity gate).  A float wire was
+    normalized by the client, so only the quantize runs."""
+    wire_is_int = jnp.issubdtype(jnp.dtype(wire_dtype), jnp.integer)
+    if wire_is_int and use_pallas:
+        from deep_vision_tpu.ops.pallas_ops import serve_ingest_auto
+
+        def fn(x):  # dvtlint: traced
+            return serve_ingest_auto(x, kind, act_scale=act_scale)
+
+        return fn
+
+    def fn(x):  # dvtlint: traced
+        if wire_is_int:
+            x = serve_normalize(x, kind)
+        return quantize_activations(x, act_scale)
+
+    return fn
+
+
 def jitter_normalize(images, rng, train: bool,
                      mean=IMAGENET_MEAN, std=IMAGENET_STD,
                      brightness: float = 0.2, contrast: float = 0.2,

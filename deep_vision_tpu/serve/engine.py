@@ -1019,6 +1019,11 @@ class BatchingEngine:
                    "wire_dtype": str(self.wire_dtype),
                    "infer_dtype": getattr(self.model, "infer_dtype",
                                           "float32"),
+                   # the served weights' byte footprint (int8 models
+                   # report the true quantized size — bench.py's
+                   # weight-HBM pricing and the /metrics gauge)
+                   "weight_hbm_bytes": self.model.param_bytes()
+                   if hasattr(self.model, "param_bytes") else None,
                    "pipeline": {
                        "depth": self.pipeline_depth,
                        "inflight": self._inflight,

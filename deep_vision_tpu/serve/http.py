@@ -251,6 +251,10 @@ def render_serve_metrics(stats: dict) -> str:
 def _render_engine_metrics(p, name: str, s: dict) -> None:
     """Emit one engine's dvt_serve_* series (shared by both shapes)."""
     lab = {"model": name}
+    if s.get("weight_hbm_bytes") is not None:
+        p.gauge("dvt_serve_weight_hbm_bytes", s["weight_hbm_bytes"],
+                lab, help="Byte footprint of the served weights "
+                          "(int8 models report the quantized size)")
     p.counter("dvt_serve_requests_submitted_total", s["submitted"],
               lab, help="Requests entering submit (incl. shed)")
     p.counter("dvt_serve_requests_served_total", s["served"], lab,

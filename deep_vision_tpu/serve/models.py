@@ -667,7 +667,12 @@ class ModelControlPlane:
         sm = CheckpointServingModel(
             old.name, cfg, model, state,
             wire_dtype=str(old.wire_dtype),
-            infer_dtype=old.infer_dtype)
+            infer_dtype=old.infer_dtype,
+            # int8 reloads recalibrate the NEW weights with the same
+            # provenance (batch count / held-out dir / ingest choice)
+            calib_batches=getattr(old, "calib_batches", 2),
+            calib_dir=getattr(old, "calib_dir", None),
+            ingest=getattr(old, "ingest", "pallas"))
         sm.restored_step = info.get("step")
         sm.restore_fallback = bool(info.get("fallback"))
         sm.restored_mtime = info.get("mtime")

@@ -1,0 +1,245 @@
+"""Post-training int8 quantization for the serving tier.
+
+Scheme (the post-training corner of Jacob et al. 2018): symmetric
+per-channel int8 for every conv/dense kernel (absmax over all axes but
+the trailing ``cout``), a symmetric per-tensor scale for the ingest
+activations, and "simulated-integer" execution — bucket programs keep
+the weights INT8-RESIDENT in HBM and dequantize inside the traced
+apply (``w_i8.astype(f32) * scale``), which XLA fuses into the weight
+read, so the HBM footprint is the int8 one while accumulation stays
+float32 and outputs leave the program as float32 (the accuracy-gate
+contract in tests/test_quant.py).
+
+What stays float: 1-D leaves (biases, BN scale/shift — a few hundred
+bytes that would cost accuracy for no footprint win) and every
+``batch_stats`` leaf.  The quantized variables tree
+
+    {"params": <int8/f32 mixed>, "param_scales": <f32 scales>,
+     "batch_stats": ...}
+
+is an opaque pytree to everything downstream: the WeightCache's
+spill/re-admit (serve/models.py) and ``for_device``/``for_mesh`` views
+are leaf-wise ``tree_map``s, so int8 leaves round-trip bit-identically,
+and ``param_bytes()`` reports the true ~0.26× footprint for free.
+
+Calibration runs a held-out batch (or a deterministic synthetic one)
+through an instrumented forward (``capture_intermediates``) to collect
+per-path activation absmax ranges plus the post-normalize input absmax
+that prices the ingest scale.  It is pure: the same batches always
+produce identical scales (tests/test_quant.py determinism gate).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import os
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Calibration:
+    """What the calibration pass measured (all host floats, JSON-safe).
+
+    ``act_scale`` is the per-tensor symmetric scale the ingest kernel
+    quantizes normalized activations with (``q = round(x/act_scale)``);
+    ``ranges`` maps each captured intermediate's path to its absmax over
+    the calibration batches (the per-tensor activation ranges a future
+    fully-integer backend would consume)."""
+
+    act_scale: float
+    act_absmax: float
+    ranges: dict
+    batches: int
+    batch_size: int
+    source: str
+
+    def describe(self) -> dict:
+        """Compact JSON block for ``ServingModel.describe()`` — the full
+        per-path ``ranges`` dict stays on the object (it can be hundreds
+        of entries for deep nets)."""
+        return {"act_scale": self.act_scale,
+                "act_absmax": self.act_absmax,
+                "activation_ranges": len(self.ranges),
+                "calib_batches": self.batches,
+                "calib_batch_size": self.batch_size,
+                "calib_source": self.source}
+
+
+def _quantize_leaf(w):
+    """One param leaf → (stored leaf, scale leaf).
+
+    Conv/dense kernels (ndim ≥ 2, float) become symmetric per-channel
+    int8 over the trailing (cout) axis; everything else passes through
+    with a scalar identity scale so the two trees stay congruent for
+    ``tree_map``.  All-zero channels get scale 1.0 (quantize to 0
+    exactly) instead of a 0/0."""
+    import jax
+
+    a = np.asarray(jax.device_get(w))
+    if a.ndim >= 2 and a.dtype.kind == "f":
+        a32 = a.astype(np.float32)
+        absmax = np.max(np.abs(a32), axis=tuple(range(a.ndim - 1)))
+        scale = np.where(absmax > 0.0, absmax / 127.0, 1.0)
+        scale = scale.astype(np.float32)
+        q = np.clip(np.rint(a32 / scale), -127.0, 127.0).astype(np.int8)
+        return q, scale
+    return a, np.asarray(1.0, np.float32)
+
+
+def quantize_params(params) -> tuple:
+    """params pytree → (quantized pytree, scale pytree), same structure.
+
+    Quantized leaves are int8 with a (cout,)-shaped f32 scale that
+    broadcasts over the kernel's trailing axis; unquantized leaves keep
+    their dtype with a 0-d identity scale."""
+    import jax
+
+    pairs = jax.tree_util.tree_map(_quantize_leaf, params)
+    is_pair = lambda t: isinstance(t, tuple)  # noqa: E731
+    q = jax.tree_util.tree_map(lambda t: t[0], pairs, is_leaf=is_pair)
+    s = jax.tree_util.tree_map(lambda t: t[1], pairs, is_leaf=is_pair)
+    return q, s
+
+
+def dequantize_params(qparams, scales, dtype=None):  # dvtlint: traced
+    """Traced inverse of :func:`quantize_params`: int8 leaves expand to
+    ``dtype`` (default float32) inside the bucket program — XLA fuses
+    the cast+multiply into the weight HBM read, so the f32 copy never
+    persists — and float leaves pass through untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    if dtype is None:
+        dtype = jnp.float32
+
+    def leaf(w, s):
+        if w.dtype == jnp.int8:
+            return w.astype(dtype) * s.astype(dtype)
+        return w
+
+    return jax.tree_util.tree_map(leaf, qparams, scales)
+
+
+def synthetic_calibration_batches(input_shape, n_batches: int = 2,
+                                  batch_size: int = 8) -> list:
+    """Deterministic uint8 calibration data for workflows without a
+    held-out set (bench, smoke, random-init tests): a fresh
+    ``RandomState(0)`` every call, so two calibrations of the same model
+    see byte-identical batches → identical scales."""
+    rng = np.random.RandomState(0)
+    return [rng.randint(0, 256, (batch_size, *input_shape), dtype=np.uint8)
+            for _ in range(n_batches)]
+
+
+def load_calibration_dir(calib_dir: str, input_shape,
+                         n_batches: int = 2,
+                         batch_size: int = 8) -> list:
+    """Held-out calibration data: ``*.npy`` files under ``calib_dir``,
+    each a uint8 HWC image or NHWC batch of ``input_shape`` images,
+    loaded in sorted order (deterministic) and re-batched."""
+    paths = sorted(glob.glob(os.path.join(calib_dir, "*.npy")))
+    if not paths:
+        raise FileNotFoundError(
+            f"no *.npy calibration files under {calib_dir}")
+    imgs = []
+    want = tuple(input_shape)
+    for p in paths:
+        a = np.load(p)
+        if a.ndim == len(want):
+            a = a[None]
+        if a.ndim != len(want) + 1 or tuple(a.shape[1:]) != want:
+            raise ValueError(
+                f"{p}: expected uint8 images of shape {want} "
+                f"(or batches thereof), got {a.shape}")
+        imgs.append(np.asarray(a, np.uint8))
+        if sum(len(i) for i in imgs) >= n_batches * batch_size:
+            break
+    flat = np.concatenate(imgs)[:n_batches * batch_size]
+    if len(flat) < batch_size:
+        raise ValueError(
+            f"{calib_dir} holds {len(flat)} calibration images; "
+            f"need at least one batch of {batch_size}")
+    return [flat[i:i + batch_size]
+            for i in range(0, len(flat) - batch_size + 1, batch_size)]
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        key = getattr(p, "key", getattr(p, "idx", getattr(p, "name", p)))
+        parts.append(str(key))
+    return "/".join(parts)
+
+
+def calibrate(model, variables, batches, kind: str) -> Calibration:
+    """Instrumented forward over ``batches`` (uint8 NHWC) → Calibration.
+
+    Each batch is normalized exactly like the serving wire
+    (ops/preprocess.serve_normalize for ``kind``), then run through
+    ``model.apply(..., capture_intermediates=True)``; the input absmax
+    over all batches prices the per-tensor ingest scale and every
+    captured intermediate contributes its per-path absmax range.  Pure
+    function of (weights, batches): no RNG, no clock."""
+    import jax
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.ops.preprocess import serve_normalize
+
+    if not batches:
+        raise ValueError("calibration needs at least one batch")
+    act_absmax = 0.0
+    ranges: dict[str, float] = {}
+    for b in batches:
+        x = serve_normalize(jnp.asarray(np.asarray(b, np.uint8)), kind)
+        act_absmax = max(act_absmax,
+                         float(jax.device_get(jnp.max(jnp.abs(x)))))
+        _, st = model.apply(variables, x, train=False,
+                            capture_intermediates=True,
+                            mutable=["intermediates"])
+        flat, _ = jax.tree_util.tree_flatten_with_path(
+            st["intermediates"])
+        for path, leaf in flat:
+            if not jnp.issubdtype(leaf.dtype, jnp.floating):
+                continue
+            key = _path_str(path)
+            ranges[key] = max(
+                ranges.get(key, 0.0),
+                float(jax.device_get(jnp.max(jnp.abs(leaf)))))
+    act_absmax = act_absmax if act_absmax > 0.0 else 1.0
+    return Calibration(act_scale=act_absmax / 127.0,
+                       act_absmax=act_absmax,
+                       ranges=dict(sorted(ranges.items())),
+                       batches=len(batches),
+                       batch_size=int(np.asarray(batches[0]).shape[0]),
+                       source="")
+
+
+def quantize_for_serving(model, variables, *, kind: str, input_shape,
+                         calib_batches: int = 2,
+                         calib_dir: str | None = None,
+                         batch_size: int = 8) -> tuple:
+    """The registry's one-call int8 load path → (qvariables, Calibration).
+
+    Calibrates on ``calib_dir``'s held-out images when given (the real
+    deployment path), else on deterministic synthetic batches (bench /
+    smoke / random-init tests), then quantizes the weights.  The
+    returned tree is what ``CheckpointServingModel._variables`` becomes:
+    int8 weights + their scales + untouched batch_stats."""
+    if calib_dir:
+        batches = load_calibration_dir(calib_dir, input_shape,
+                                       n_batches=calib_batches,
+                                       batch_size=batch_size)
+        source = calib_dir
+    else:
+        batches = synthetic_calibration_batches(
+            input_shape, n_batches=calib_batches, batch_size=batch_size)
+        source = "synthetic"
+    calib = calibrate(model, variables, batches, kind)
+    calib = dataclasses.replace(calib, source=source)
+    qparams, scales = quantize_params(variables["params"])
+    qvariables = {"params": qparams, "param_scales": scales}
+    if variables.get("batch_stats"):
+        qvariables["batch_stats"] = variables["batch_stats"]
+    return qvariables, calib

@@ -55,8 +55,11 @@ import numpy as np
 #: program's traced prologue (ops/preprocess.make_serve_preprocess);
 #: float32 is the original host-normalized contract.
 WIRE_DTYPES = ("float32", "uint8")
-#: supported on-device compute dtypes (outputs are always float32)
-INFER_DTYPES = ("float32", "bfloat16")
+#: supported on-device compute dtypes (outputs are always float32):
+#: bfloat16 casts params once at load; int8 post-training-quantizes
+#: them (serve/quant.py) — int8-resident weights, fused ingest
+#: quantize, float32 accumulation and outputs
+INFER_DTYPES = ("float32", "bfloat16", "int8")
 
 
 class ServingModel:
@@ -125,6 +128,21 @@ class ServingModel:
         self._variables = jax.tree_util.tree_map(
             np.asarray, jax.device_get(variables))
 
+    def param_bytes(self) -> int:
+        """Total bytes of the variable tree (the weight cache's HBM
+        accounting unit for this model) — for int8 models this is the
+        true quantized footprint (~0.26× f32: int8 kernels + f32
+        scales/biases), so the cache admits ~4× more versions per
+        budget."""
+        variables = getattr(self, "_variables", None)
+        if variables is None:
+            return 0
+        import jax
+
+        # .nbytes is metadata on both jax and numpy arrays — no D2H
+        return int(sum(a.nbytes for a in
+                       jax.tree_util.tree_leaves(variables)))
+
     def placement_desc(self) -> str | None:
         """Human-readable placement for stats/health (None = default)."""
         import jax
@@ -160,7 +178,10 @@ class CheckpointServingModel(ServingModel):
 
     def __init__(self, name: str, cfg, model, state,
                  wire_dtype: str = "float32",
-                 infer_dtype: str = "float32"):
+                 infer_dtype: str = "float32",
+                 calib_batches: int = 2,
+                 calib_dir: str | None = None,
+                 ingest: str = "pallas"):
         super().__init__(
             name, task=cfg.task,
             input_shape=(cfg.image_size, cfg.image_size, cfg.channels),
@@ -173,6 +194,16 @@ class CheckpointServingModel(ServingModel):
         from deep_vision_tpu.ops.preprocess import serve_preprocess_kind
 
         self.preprocess_kind = serve_preprocess_kind(cfg.task, cfg.channels)
+        # int8 calibration provenance (None / unused outside int8);
+        # kept public so a hot reload rebuilds the same quantization
+        # (serve/models.py _load_model) and describe() can price it
+        self.quant = None
+        self.calib_batches = int(calib_batches)
+        self.calib_dir = calib_dir
+        if str(ingest) not in ("pallas", "xla"):
+            raise ValueError(f"ingest '{ingest}' unsupported "
+                             f"(have ('pallas', 'xla'))")
+        self.ingest = str(ingest)
         if self.infer_dtype == "bfloat16":
             import jax
             import jax.numpy as jnp
@@ -192,6 +223,20 @@ class CheckpointServingModel(ServingModel):
         variables = {"params": state.params}
         if state.batch_stats:
             variables["batch_stats"] = state.batch_stats
+        if self.infer_dtype == "int8":
+            # post-training quantization AT LOAD (serve/quant.py):
+            # calibrate activation ranges on a held-out (or synthetic)
+            # batch, then swap the variable tree for the int8-resident
+            # one — bucket programs dequantize inside the trace, the
+            # WeightCache rounds the int8 leaves through spill/re-admit
+            # untouched, and param_bytes() prices the real footprint
+            from deep_vision_tpu.serve.quant import quantize_for_serving
+
+            variables, self.quant = quantize_for_serving(
+                model, variables, kind=self.preprocess_kind,
+                input_shape=self.input_shape,
+                calib_batches=self.calib_batches,
+                calib_dir=self.calib_dir)
         self._variables = variables
         # variable sharding paired with ``placement`` (replicated on a
         # mesh, pinned on a single device); None = wherever restore left
@@ -203,6 +248,15 @@ class CheckpointServingModel(ServingModel):
         # weights can spill to host RAM and be device_put back on demand
         # without recompiling any retained AOT executable
         self._cache = None
+
+    def describe(self) -> dict:
+        d = super().describe()
+        if self.quant is not None:
+            d["quant"] = dict(self.quant.describe(),
+                              param_bytes=self.param_bytes(),
+                              ingest=getattr(self, "ingest_path",
+                                             self.ingest))
+        return d
 
     def _live_variables(self):
         """The variables a bucket program should run with RIGHT NOW:
@@ -216,15 +270,6 @@ class CheckpointServingModel(ServingModel):
             if managed is not None:
                 return managed
         return self._variables
-
-    def param_bytes(self) -> int:
-        """Total bytes of the variable tree (the weight cache's HBM
-        accounting unit for this model)."""
-        import jax
-
-        # .nbytes is metadata on both jax and numpy arrays — no D2H
-        return int(sum(a.nbytes for a in
-                       jax.tree_util.tree_leaves(self._variables)))
 
     def for_device(self, device) -> "CheckpointServingModel":
         """Per-device replica view: SAME host restore, its OWN device
@@ -278,23 +323,63 @@ class CheckpointServingModel(ServingModel):
                     f"buckets that are multiples of {n} "
                     f"(engine.sharded_buckets)")
 
-        from deep_vision_tpu.ops.preprocess import make_serve_preprocess
+        from deep_vision_tpu.ops.preprocess import (
+            make_int8_ingest,
+            make_serve_preprocess,
+        )
 
         wire = jnp.dtype(str(self.wire_dtype))
         compute = jnp.bfloat16 if self.infer_dtype == "bfloat16" \
             else jnp.float32
-        # traced prologue: a uint8 wire batch is cast + scaled +
-        # normalized ON DEVICE (XLA fuses it into the first conv's HBM
-        # read — the H2D carried 4× fewer bytes); a float32 wire passes
-        # through (the client normalized).  Outputs always leave the
-        # program as float32, whatever the compute dtype.
-        pre = make_serve_preprocess(self.preprocess_kind, wire, compute)
 
-        def apply(variables, x):
-            out = self._model.apply(variables, pre(x), train=False)
+        def _f32_outputs(out):  # dvtlint: traced
             return jax.tree_util.tree_map(
                 lambda a: a.astype(jnp.float32)
                 if jnp.issubdtype(a.dtype, jnp.floating) else a, out)
+
+        if self.infer_dtype == "int8":
+            # the fused Pallas ingest is the default on the uint8 wire;
+            # on real TPUs it must pass the per-shape parity gate first
+            # (Mosaic lowering is shape-sensitive), falling back to the
+            # XLA prologue — NEVER recompiling any other model's
+            # retained f32/bf16 bucket programs
+            act_scale = float(self.quant.act_scale)
+            use_pallas = self.ingest == "pallas" and \
+                jnp.issubdtype(wire, jnp.integer)
+            if use_pallas and jax.default_backend() == "tpu":
+                from deep_vision_tpu.ops.pallas_ops import ingest_parity_ok
+
+                use_pallas = ingest_parity_ok(
+                    (batch, *self.input_shape), self.preprocess_kind,
+                    act_scale)
+            self.ingest_path = "pallas" if use_pallas else "xla"
+            pre_q = make_int8_ingest(self.preprocess_kind, wire,
+                                     act_scale, use_pallas=use_pallas)
+            from deep_vision_tpu.serve.quant import dequantize_params
+
+            def apply(variables, x):  # dvtlint: traced
+                # int8 activations dequantize into the first conv's
+                # read; int8-resident weights dequantize in-trace (XLA
+                # fuses both casts — no f32 weight copy persists in HBM)
+                xq = pre_q(x)
+                xf = xq.astype(jnp.float32) * act_scale
+                v = dict(variables)
+                scales = v.pop("param_scales")
+                v["params"] = dequantize_params(v["params"], scales)
+                out = self._model.apply(v, xf, train=False)
+                return _f32_outputs(out)
+        else:
+            # traced prologue: a uint8 wire batch is cast + scaled +
+            # normalized ON DEVICE (XLA fuses it into the first conv's
+            # HBM read — the H2D carried 4× fewer bytes); a float32 wire
+            # passes through (the client normalized).  Outputs always
+            # leave the program as float32, whatever the compute dtype.
+            pre = make_serve_preprocess(self.preprocess_kind, wire,
+                                        compute)
+
+            def apply(variables, x):
+                out = self._model.apply(variables, pre(x), train=False)
+                return _f32_outputs(out)
 
         x_spec = jax.ShapeDtypeStruct((batch, *self.input_shape),
                                       wire, sharding=self.placement)
@@ -438,14 +523,21 @@ class ModelRegistry:
     def load_checkpoint(self, config_name: str, workdir: str,
                         name: str | None = None,
                         wire_dtype: str = "float32",
-                        infer_dtype: str = "float32") -> ServingModel:
+                        infer_dtype: str = "float32",
+                        calib_batches: int = 2,
+                        calib_dir: str | None = None,
+                        ingest: str = "pallas") -> ServingModel:
         """``wire_dtype``: what clients ship and the engine H2D-transfers
         — "uint8" (raw 0–255 pixels, normalization fused into the bucket
         programs; the ``cli.serve`` default) or "float32" (the original
         host-normalized contract; the programmatic default, so existing
         direct callers are untouched).  ``infer_dtype``: "bfloat16" casts
         params once here and runs bucket programs in bf16 compute with
-        float32 outputs."""
+        float32 outputs; "int8" post-training-quantizes here
+        (serve/quant.py) — ``calib_batches`` held-out batches from
+        ``calib_dir`` (deterministic synthetic data when None) calibrate
+        the activation scales, and ``ingest`` picks the fused Pallas
+        serve-prologue ("pallas", the default) or the XLA fallback."""
         from deep_vision_tpu.core.config import get_config
         from deep_vision_tpu.core.restore import load_state
 
@@ -454,7 +546,10 @@ class ModelRegistry:
         model, state = load_state(cfg, workdir, tag="serve", info=info)
         sm = CheckpointServingModel(name or config_name, cfg, model, state,
                                     wire_dtype=wire_dtype,
-                                    infer_dtype=infer_dtype)
+                                    infer_dtype=infer_dtype,
+                                    calib_batches=calib_batches,
+                                    calib_dir=calib_dir,
+                                    ingest=ingest)
         sm.restored_step = info.get("step")
         sm.restore_fallback = bool(info.get("fallback"))
         sm.restored_mtime = info.get("mtime")
@@ -462,13 +557,30 @@ class ModelRegistry:
         return self.add(sm)
 
     def load_exported(self, config_name: str, blob_path: str, workdir: str,
-                      name: str | None = None) -> ServingModel:
+                      name: str | None = None,
+                      wire_dtype: str = "float32",
+                      infer_dtype: str = "float32") -> ServingModel:
         """Serve a ``cli.infer export`` artifact.
 
         The blob's inputs are (variables, x) — the same variables pytree
         the exporting process restored — so the companion workdir supplies
         them through the identical restore path.
+
+        Exported blobs are f32-wire/f32-compute only: the StableHLO was
+        traced at one float32 signature with host-side normalization, so
+        neither wire decoding nor a compute-dtype rewrite (bfloat16 OR
+        int8 quantization) can apply — those need the re-jitting
+        checkpoint path.  Checked FIRST, before any file I/O, so the
+        operator gets the dtype error rather than a restore traceback.
         """
+        if str(wire_dtype) != "float32" or str(infer_dtype) != "float32":
+            raise ValueError(
+                "exported StableHLO blobs are f32-wire/f32-compute "
+                "only: the blob serves exactly its traced float32 "
+                f"signature, so wire_dtype='{wire_dtype}' / "
+                f"infer_dtype='{infer_dtype}' (bfloat16 and int8 "
+                "included) need the checkpoint path — serve without "
+                "--stablehlo")
         from deep_vision_tpu.core.config import get_config
         from deep_vision_tpu.core.export import load_exported
         from deep_vision_tpu.core.restore import load_state

@@ -1,0 +1,113 @@
+"""`make quant-smoke`: the int8 serving path end to end over real HTTP.
+
+Boots the exact `python -m deep_vision_tpu.cli.serve` wiring
+(cli.serve.build_server) twice against the SAME LeNet workdir fixture —
+once at --infer-dtype float32, once at --infer-dtype int8 (which
+calibrates on deterministic synthetic batches at load, quantizes the
+weights per-channel, and serves int8-resident weights through the
+fused Pallas ingest, interpret-mode on CPU) — classifies the same raw
+uint8 images through both lanes, and gates on:
+
+  * top-1 agreement between the int8 and f32 answers (the accuracy
+    gate `--infer-dtype int8` is priced by, docs/SERVING.md);
+  * /v1/models exposing the quant block (act_scale, calib provenance,
+    true param_bytes, chosen ingest path);
+  * /v1/stats reporting weight_hbm_bytes ≤ 0.27× the f32 lane's.
+
+Run directly, not under pytest (chained into `make serve-smoke`)."""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import urllib.request
+
+import numpy as np
+
+# plain script (not pytest): make the repo root importable when invoked
+# as `python tests/quant_smoke.py` from the checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_IMAGES = 8
+
+
+def smoke_lane(workdir: str, infer_dtype: str, images) -> dict:
+    """One serve lane: boot, classify every image over HTTP, return
+    {"top1": [...], "weight_hbm_bytes": int, "describe": dict}."""
+    from deep_vision_tpu.cli.serve import build_server
+
+    args = argparse.Namespace(
+        model="lenet5", workdir=workdir, stablehlo=None,
+        host="127.0.0.1", port=0, max_batch=4, max_wait_ms=2.0,
+        buckets=None, max_queue=64, warmup=False, verbose=False,
+        pipeline_depth=2, faults="", fault_seed=0,
+        serve_devices=1, shard_batches=False,
+        wire_dtype="uint8", infer_dtype=infer_dtype,
+        calib_batches=2, calib_dir=None)
+    engine, server = build_server(args)
+    server.start_background()
+    base = f"http://{server.host}:{server.port}"
+    try:
+        top1 = []
+        for img in images:
+            req = urllib.request.Request(
+                base + "/v1/classify",
+                data=json.dumps({"pixels": img.tolist()}).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=60) as r:
+                assert r.status == 200, f"expected 200, got {r.status}"
+                top1.append(json.loads(r.read())["top"][0]["class"])
+        with urllib.request.urlopen(base + "/v1/stats", timeout=60) as r:
+            stats = json.loads(r.read())["lenet5"]
+        assert stats["infer_dtype"] == infer_dtype, stats["infer_dtype"]
+        with urllib.request.urlopen(base + "/v1/models", timeout=60) as r:
+            desc = json.loads(r.read())["models"]["lenet5"]["model"]
+        return {"top1": top1,
+                "weight_hbm_bytes": stats["weight_hbm_bytes"],
+                "describe": desc}
+    finally:
+        server.shutdown()
+        engine.stop(drain_deadline=5.0)
+
+
+def main():
+    with tempfile.TemporaryDirectory() as workdir:
+        # empty workdir: restore falls back to deterministic random
+        # init, so BOTH lanes serve the same weights — agreement
+        # measures quantization error only
+        rng = np.random.default_rng(0)
+        images = [rng.integers(0, 256, (32, 32, 1), dtype=np.uint8)
+                  for _ in range(N_IMAGES)]
+        f32 = smoke_lane(workdir, "float32", images)
+        i8 = smoke_lane(workdir, "int8", images)
+
+    agree = sum(a == b for a, b in zip(f32["top1"], i8["top1"]))
+    assert agree >= N_IMAGES - 1, \
+        f"int8 top-1 agreed on {agree}/{N_IMAGES} vs f32: " \
+        f"{i8['top1']} vs {f32['top1']}"
+
+    quant = i8["describe"].get("quant")
+    assert quant, i8["describe"]
+    assert quant["act_scale"] > 0, quant
+    assert quant["calib_source"] == "synthetic", quant
+    assert quant["calib_batches"] == 2, quant
+    assert quant["ingest"] in ("pallas", "xla"), quant
+    assert "quant" not in f32["describe"], f32["describe"]
+
+    ratio = i8["weight_hbm_bytes"] / f32["weight_hbm_bytes"]
+    assert ratio <= 0.27, \
+        f"int8 weight HBM {i8['weight_hbm_bytes']} is {ratio:.4f}x " \
+        f"the f32 lane's {f32['weight_hbm_bytes']} (gate: 0.27)"
+
+    print(f"quant-smoke PASS: int8 top-1 agreed {agree}/{N_IMAGES} "
+          f"with f32 over HTTP, weight HBM {i8['weight_hbm_bytes']} B "
+          f"= {ratio:.4f}x f32 ({f32['weight_hbm_bytes']} B), "
+          f"act_scale {quant['act_scale']:.6f} "
+          f"({quant['calib_source']}, {quant['calib_batches']} batches), "
+          f"ingest {quant['ingest']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,6 +75,42 @@ def test_sigterm_saves_and_resumes(tmp_path, mesh1):
         signal.getsignal(signal.SIGTERM))
 
 
+def test_async_save_restores_identically(tmp_path, mesh1):
+    """async_save=True (the default): save() returns with serialization
+    still in flight, and every read path (latest_step/restore) blocks on
+    the in-flight save first — so back-to-back saves and an immediate
+    restore see exactly the synchronous result."""
+    import jax
+
+    from deep_vision_tpu.core.checkpoint import Checkpointer
+
+    cfg, trainer = make_trainer(tmp_path, mesh1, epochs=1)
+    data = synthetic_mnist(64)
+    state = trainer.init_state(
+        next(iter(ArrayLoader(data, cfg.batch_size, seed=1))))
+
+    ckpt = Checkpointer(str(tmp_path / "async"))
+    assert ckpt.async_save
+    ckpt.save(1, state, extras={"epoch": 0})
+    ckpt.save(2, state, extras={"epoch": 1})  # waits for save 1 first
+    ckpt.wait_until_finished()  # the explicit preempt/exit barrier
+    assert ckpt.all_steps() == [1, 2]
+    restored, extras = ckpt.restore(state)
+    assert extras["epoch"] == 1
+    for a, b in zip(
+            jax.tree_util.tree_leaves(jax.device_get(state.params)),
+            jax.tree_util.tree_leaves(jax.device_get(restored.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    ckpt.close()
+
+    # async_save=False keeps the old save-then-wait behavior
+    sync = Checkpointer(str(tmp_path / "sync"), async_save=False)
+    assert not sync.async_save
+    sync.save(3, state, extras={"epoch": 2})
+    assert sync.latest_step() == 3
+    sync.close()
+
+
 def test_sigterm_handler_restored(tmp_path, mesh1):
     sentinel = lambda *_: None  # noqa: E731
     prev = signal.signal(signal.SIGTERM, sentinel)
