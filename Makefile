@@ -41,6 +41,7 @@ serve-smoke: lint lint-test
 	$(PY) tests/serve_smoke.py
 	$(PY) tests/quant_smoke.py
 	$(PY) tests/model_smoke.py
+	$(PY) tests/deploy_smoke.py
 	$(PY) tests/gateway_smoke.py
 	$(PY) tests/obs_smoke.py
 
@@ -64,6 +65,19 @@ quant-test:
 # /v1/stats, every /metrics line parsed (dvt_serve_model_up + cache)
 model-smoke:
 	$(PY) tests/model_smoke.py
+
+# the continuous train->deploy loop end to end: a real async-Orbax
+# checkpoint published mid-load auto-deploys through debounce -> gate
+# -> canary -> promote with zero client errors, a NaN checkpoint is
+# refused by the gate, and POST /v1/deploy/<name>/revert restores the
+# previous promoted weights (docs/DEPLOY.md)
+deploy-smoke:
+	$(PY) tests/deploy_smoke.py
+
+# the deploy unit suite alone (fingerprint tmp-skip, watcher debounce,
+# gate pass/fail, revert under load, autoscaler hysteresis + drain)
+deploy-test:
+	$(PY) -m pytest tests/test_deploy.py -q -m deploy
 
 # the model-plane unit suite alone (cache LRU/bit-identity, reload
 # zero-loss, canary auto-rollback, shadow discard, lifecycle HTTP)
@@ -131,6 +145,12 @@ bench-serve-scaling:
 bench-serve-wire:
 	$(PY) bench.py --serve --serve-wire
 
+# continuous-deploy reaction bench: checkpoint durable -> new version
+# ACTIVE under live load (debounce + gate + canary), plus autoscale
+# scale-up/scale-down reaction (docs/PERF.md "Deploy reaction")
+bench-deploy:
+	$(PY) bench.py --deploy
+
 # gateway failover bench: backends behind serve/gateway.py, one
 # hard-killed a third into the top load point — reports errors after
 # the kill (contract: 0), breaker-open latency, and the worst client
@@ -167,7 +187,8 @@ list:
 	$(PY) -m deep_vision_tpu.cli.train --list -m x
 
 .PHONY: test test-all bench bench-serve bench-serve-sync \
-	bench-serve-scaling bench-serve-wire bench-gateway serve-smoke \
+	bench-serve-scaling bench-serve-wire bench-gateway bench-deploy \
+	serve-smoke \
 	serve-multi serve-chaos gateway-smoke gateway-test obs-smoke \
-	obs-test model-smoke model-test quant-smoke quant-test lint \
-	lint-test list
+	obs-test model-smoke model-test quant-smoke quant-test \
+	deploy-smoke deploy-test lint lint-test list

@@ -1178,6 +1178,216 @@ def bench_gateway(model_name: str = "lenet5", loads: tuple = (1, 8),
             "device_kind": jax.devices()[0].device_kind}
 
 
+def bench_deploy(model_name: str = "lenet5",
+                 watch_interval_s: float = 0.05, **_ignored) -> dict:
+    """Continuous-deploy reaction bench (``bench.py --deploy``).
+
+    Two numbers, both end to end (docs/PERF.md "Deploy reaction"):
+
+    ``deploy_reaction_ms``  a REAL async-Orbax checkpoint becomes
+        durable mid-load → the new version is ACTIVE and serving: the
+        watcher's two-poll debounce, the candidate restore, the
+        synthetic accuracy-gate eval, and the shadow/canary/promote
+        rollout under a live closed-loop client (the canary gates need
+        traffic to clear).  The structural floor is 2× the watch
+        interval (debounce) plus the canary dwell.  The ledger's
+        wall-clock timestamps decompose the total.
+
+    ``scale_up_reaction_ms`` / ``scale_down_reaction_ms``  sustained
+        queue pressure → ``add_replica()`` returned, and first
+        observed idle → ``remove_replica()`` drained and returned.
+        The autoscaler is driven synchronously (``tick()`` per
+        interval, the documented bench seam) so the numbers measure
+        the hysteresis windows + the engine's replica build/drain
+        cost, not a daemon thread's scheduling jitter."""
+    import os
+    import sys
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from deep_vision_tpu.core.checkpoint import Checkpointer
+    from deep_vision_tpu.core.config import get_config
+    from deep_vision_tpu.core.restore import load_state
+    from deep_vision_tpu.deploy import (AccuracyGate, CheckpointWatcher,
+                                        DeploymentHistory,
+                                        ReplicaAutoscaler)
+    from deep_vision_tpu.serve.admission import Shed
+    from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.models import (CanaryPolicy,
+                                              ModelControlPlane,
+                                              WeightCache)
+    from deep_vision_tpu.serve.registry import ModelRegistry
+    from deep_vision_tpu.serve.replicas import ReplicatedEngine
+
+    out: dict = {"metric": "deploy_reaction_ms", "unit": "ms",
+                 "model": model_name,
+                 "watch_interval_s": watch_interval_s,
+                 "debounce_floor_ms": round(2 * watch_interval_s * 1e3,
+                                            1),
+                 "device_kind": jax.devices()[0].device_kind}
+
+    # -- part 1: checkpoint durable → new version ACTIVE ---------------
+    reg = ModelRegistry()
+    with tempfile.TemporaryDirectory() as workdir:
+        sm = reg.load_checkpoint(model_name, workdir)
+        plane = ModelControlPlane(
+            reg, lambda m: BatchingEngine(m, buckets=[8], max_wait_ms=2),
+            cache=WeightCache(budget_bytes=0),
+            policy=CanaryPolicy(canary_frac=0.5, min_requests=3,
+                                max_p99_ratio=None, phase_timeout_s=60.0))
+        plane.deploy(sm, workdir=workdir)
+        history = DeploymentHistory()
+        watcher = CheckpointWatcher(
+            plane, history, interval_s=watch_interval_s,
+            gate=AccuracyGate()).watch(model_name)
+        img = np.random.RandomState(0).randn(
+            *sm.input_shape).astype(np.float32)
+        errors: list = []
+        stop = threading.Event()
+
+        def load_loop():
+            while not stop.is_set():
+                try:
+                    r = plane.infer(model_name, img, timeout=30)
+                    if isinstance(r, Shed):
+                        errors.append(repr(r))
+                except Exception as e:  # noqa: BLE001 — every failure is a lost request
+                    errors.append(repr(e))
+
+        client = threading.Thread(target=load_loop, daemon=True)
+        client.start()
+        ckpt = None
+        try:
+            # warm the infer path before the clock starts
+            time.sleep(0.2)
+            cfg = get_config(model_name)
+            with tempfile.TemporaryDirectory() as seed_dir:
+                _, state = load_state(cfg, seed_dir,
+                                      log=lambda *a, **k: None)
+            ckpt = Checkpointer(os.path.join(workdir, "checkpoints"))
+            watcher.start()
+            ckpt.save(1, state)
+            ckpt.wait_until_finished()
+            t0 = time.perf_counter()
+            deadline = t0 + 120.0
+            while plane.active_version(model_name).version < 2:
+                if time.perf_counter() > deadline:
+                    raise SystemExit("deploy bench: promotion timed out")
+                time.sleep(0.002)
+            out["value"] = round((time.perf_counter() - t0) * 1e3, 1)
+            out["deploy_reaction_ms"] = out["value"]
+            # the ledger's wall-clock stamps decompose the reaction:
+            # durable→candidate is debounce+restore, candidate→
+            # gate_passed the held-out eval, gate_passed→promoted the
+            # shadow/canary rollout.  The promoted record lands just
+            # after the version flips, so give it a beat
+            t_led = time.perf_counter() + 5.0
+            while history.last_outcome(model_name) != "promoted" \
+                    and time.perf_counter() < t_led:
+                time.sleep(0.002)
+            ts = {e["outcome"]: e["ts"]
+                  for e in history.entries(model_name)}
+            if {"candidate", "gate_passed", "promoted"} <= ts.keys():
+                out["gate_eval_ms"] = round(
+                    (ts["gate_passed"] - ts["candidate"]) * 1e3, 1)
+                out["rollout_ms"] = round(
+                    (ts["promoted"] - ts["gate_passed"]) * 1e3, 1)
+        finally:
+            stop.set()
+            client.join(30)
+            watcher.stop()
+            if ckpt is not None:
+                ckpt.close()
+            plane.stop(drain_deadline=5.0)
+        if errors:
+            print(f"# deploy bench: {len(errors)} client errors: "
+                  f"{errors[:3]}", file=sys.stderr)
+        out["client_errors"] = len(errors)
+
+    # -- part 2: load step → replica added, idle → replica drained -----
+    if len(jax.devices()) < 2:
+        # add_replica() needs a spare device; main() forces 2 host
+        # devices, so this only trips when the backend initialized
+        # before the flag could land
+        out["autoscale_skipped"] = \
+            f"{len(jax.devices())} device(s): add_replica needs a spare"
+        return out
+    # fresh model: part 1's v1 weights were freed when the promoted v2
+    # retired it (the plane reclaims retired versions' HBM)
+    with tempfile.TemporaryDirectory() as td:
+        sm = ModelRegistry().load_checkpoint(model_name, td)
+    tick_s = 0.02
+    scaler_cfg = dict(min_replicas=1, max_replicas=2, interval_s=tick_s,
+                      high_water_ms=5.0, up_window=3, down_window=10,
+                      cooldown_s=0.2, drain_deadline_s=10.0)
+    eng = ReplicatedEngine(sm, devices=jax.devices()[:1], buckets=[8],
+                           max_wait_ms=2).start()
+    eng.warmup()
+    scaler = ReplicaAutoscaler(eng, name=model_name, **scaler_cfg)
+    futures: list = []
+    feeding = threading.Event()
+    feeding.set()
+
+    def feeder():
+        # keep a standing backlog so pressure survives the ticks — the
+        # bench measures the scaler's reaction, not a burst's drain
+        while feeding.is_set():
+            if eng._queue.qsize() < 32:
+                try:
+                    futures.append(eng.submit(img))
+                except Exception:  # noqa: BLE001 — shed under pressure is expected here
+                    pass
+            else:
+                time.sleep(0.001)
+
+    feed = threading.Thread(target=feeder, daemon=True)
+    feed.start()
+    try:
+        t_load = time.perf_counter()
+        deadline = t_load + 60.0
+        action = None
+        while action is None or action["action"] != "scale_up":
+            if time.perf_counter() > deadline:
+                raise SystemExit("deploy bench: scale-up timed out")
+            action = scaler.tick()
+            time.sleep(tick_s)
+        out["scale_up_reaction_ms"] = round(
+            (time.perf_counter() - t_load) * 1e3, 1)
+        out["scale_up_floor_ms"] = round(
+            scaler_cfg["up_window"] * tick_s * 1e3, 1)
+        feeding.clear()
+        feed.join(10)
+        for f in futures:
+            f.result(timeout=30)
+        while eng._queue.qsize() or eng.total_inflight():
+            time.sleep(0.002)
+        t_idle = time.perf_counter()
+        deadline = t_idle + 60.0
+        action = None
+        while action is None or action["action"] != "scale_down":
+            if time.perf_counter() > deadline:
+                raise SystemExit("deploy bench: scale-down timed out")
+            action = scaler.tick()
+            time.sleep(tick_s)
+        out["scale_down_reaction_ms"] = round(
+            (time.perf_counter() - t_idle) * 1e3, 1)
+        # the cooldown usually elapses during the drain, so the
+        # structural floor is the hysteresis window alone
+        out["scale_down_floor_ms"] = round(
+            scaler_cfg["down_window"] * tick_s * 1e3, 1)
+        out["autoscaler"] = {k: scaler_cfg[k] for k
+                             in ("up_window", "down_window",
+                                 "cooldown_s", "high_water_ms")}
+        out["autoscaler"]["tick_s"] = tick_s
+        out["scale_requests"] = len(futures)
+    finally:
+        feeding.clear()
+        eng.stop()
+    return out
+
+
 def bench_all() -> list[dict]:
     """Run every task bench in its own subprocess (fresh process ⇒
     per-model peak-HBM stats and no cross-compile interference)."""
@@ -1596,6 +1806,15 @@ def main():
                         "latency + breaker-open time (docs/PERF.md)")
     p.add_argument("--gateway-backends", type=int, default=2,
                    help="backend count for --gateway")
+    p.add_argument("--deploy", action="store_true",
+                   help="continuous-deploy reaction bench: real async-"
+                        "Orbax checkpoint durable → new version ACTIVE "
+                        "under live load (watcher debounce + gate + "
+                        "canary rollout), plus autoscale scale-up/"
+                        "scale-down reaction times (docs/PERF.md)")
+    p.add_argument("--watch-interval-s", type=float, default=0.05,
+                   help="watcher poll interval for --deploy (the "
+                        "debounce floor is 2x this)")
     p.add_argument("--serve-devices", type=int, default=1,
                    help="device-scaling sweep (--serve): bench replica "
                         "counts 1, 2, 4, ... N and emit the scaling "
@@ -1645,6 +1864,21 @@ def main():
             duration_s=args.serve_duration, max_batch=args.batch or 8,
             pipeline_depth=args.serve_pipeline_depth,
             hbm_budget_mb=args.hbm_budget_mb, zipf_s=args.zipf_s)))
+        return
+    if args.deploy:
+        # the autoscale half needs a spare device for add_replica();
+        # force a second host device before the backend initializes
+        # when the platform would otherwise expose one (the `make
+        # serve-multi` trick, applied automatically)
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=2"
+            ).strip()
+        print(json.dumps(bench_deploy(
+            model_name=args.serve_model,
+            watch_interval_s=args.watch_interval_s)))
         return
     if args.gateway:
         print(json.dumps(bench_gateway(

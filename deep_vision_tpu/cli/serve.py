@@ -36,8 +36,16 @@
     python -m deep_vision_tpu.cli.serve --models lenet5,yolov3_toy \\
         --workdir runs --hbm-budget-mb 512 --canary-frac 0.1
 
+    # continuous deploy: watch each model's workdir for new
+    # checkpoints, gate them on held-out data, roll out through
+    # shadow/canary, and autoscale replicas with demand
+    # (docs/DEPLOY.md)
+    python -m deep_vision_tpu.cli.serve --models lenet5 --workdir runs \\
+        --watch --gate-dir data/holdout --min-replicas 1 \\
+        --max-replicas 4
+
 Knobs and architecture: docs/SERVING.md.  Smoke: ``make serve-smoke``;
-chaos suite: ``make serve-chaos``.
+chaos suite: ``make serve-chaos``; deploy loop: ``make deploy-smoke``.
 """
 
 from __future__ import annotations
@@ -79,6 +87,12 @@ def build_server(args):
                              "checkpoint-path only")
         return _build_plane_server(args, registry, wire_dtype,
                                    infer_dtype)
+    if getattr(args, "watch", False) \
+            or int(getattr(args, "max_replicas", 0) or 0):
+        raise ValueError("--watch / --max-replicas need the model "
+                         "control plane (--models ...): the deploy "
+                         "pipeline rolls candidates through its "
+                         "version table")
     calib_batches = int(getattr(args, "calib_batches", 2) or 2)
     calib_dir = getattr(args, "calib_dir", None)
     if args.stablehlo:
@@ -198,8 +212,24 @@ def _build_plane_server(args, registry, wire_dtype: str,
         raise ValueError("--shard-batches is single-model only; "
                          "--models replicates per engine instead "
                          "(--serve-devices N)")
-    devices = local_devices(serve_devices or None) \
-        if serve_devices != 1 else None
+    min_replicas = int(getattr(args, "min_replicas", 0) or 0)
+    max_replicas = int(getattr(args, "max_replicas", 0) or 0)
+    if max_replicas and not min_replicas:
+        min_replicas = 1
+    if max_replicas and max_replicas < min_replicas:
+        raise ValueError(f"--max-replicas {max_replicas} < "
+                         f"--min-replicas {min_replicas}")
+    if min_replicas:
+        if serve_devices != 1:
+            raise ValueError("--min-replicas and --serve-devices both "
+                             "set the replica floor; use one")
+        # the autoscaler needs the elastic engine even at one replica
+        devices = local_devices(min_replicas)
+    else:
+        devices = local_devices(serve_devices or None) \
+            if serve_devices != 1 else None
+    replicated = devices is not None and (len(devices) > 1
+                                          or max_replicas > 1)
     tracer = Tracer(ring=getattr(args, "trace_ring", 256),
                     slow_ms=getattr(args, "slow_trace_ms", 250.0),
                     enabled=not getattr(args, "no_trace", False))
@@ -233,7 +263,7 @@ def _build_plane_server(args, registry, wire_dtype: str,
     def engine_factory(model):
         kwargs = dict(engine_kwargs,
                       admission=admission_for(model.name))
-        if devices is not None and len(devices) > 1:
+        if replicated:
             return ReplicatedEngine(model, devices=devices, **kwargs)
         return BatchingEngine(model, **kwargs)
 
@@ -262,6 +292,49 @@ def _build_plane_server(args, registry, wire_dtype: str,
         for name, eng in plane.active_engines().items():
             print(f"[serve] warming {name} {eng.buckets} ...")
         plane.warmup()
+
+    # deploy pipeline (deploy/__init__.py, docs/DEPLOY.md): the ledger
+    # always rides along with a watcher or autoscaler; --watch adds the
+    # per-model checkpoint watcher + accuracy gate, --max-replicas adds
+    # one autoscaler per (elastic) engine
+    pipeline = None
+    if getattr(args, "watch", False) or max_replicas > min_replicas:
+        from deep_vision_tpu.deploy import (
+            AccuracyGate,
+            CheckpointWatcher,
+            DeploymentHistory,
+            DeployPipeline,
+            ReplicaAutoscaler,
+        )
+
+        history = DeploymentHistory(os.path.join(args.workdir,
+                                                 "_deploy"))
+        watcher = None
+        if getattr(args, "watch", False):
+            gate = AccuracyGate(
+                gate_dir=getattr(args, "gate_dir", None),
+                min_agreement=float(getattr(args, "gate_min_agreement",
+                                            0.8)))
+            watcher = CheckpointWatcher(
+                plane, history,
+                interval_s=float(getattr(args, "watch_interval_s",
+                                         2.0)),
+                gate=gate)
+            for name in names:
+                watcher.watch(name)
+        autoscalers = {}
+        if max_replicas > min_replicas:
+            for name in names:
+                # resolve the engine per tick: a hot reload swaps the
+                # active engine and the scaler must follow it
+                autoscalers[name] = ReplicaAutoscaler(
+                    lambda name=name: plane.active_engine(name),
+                    name=name, min_replicas=min_replicas or 1,
+                    max_replicas=max_replicas, history=history)
+        pipeline = DeployPipeline(plane, history=history,
+                                  watcher=watcher,
+                                  autoscalers=autoscalers or None)
+        pipeline.start()
     socket_timeout_s = getattr(args, "socket_timeout_s", 30.0)
     server = ServeServer(
         registry, plane.active_engines(), host=args.host,
@@ -269,7 +342,7 @@ def _build_plane_server(args, registry, wire_dtype: str,
         max_body_bytes=int(getattr(args, "max_body_mb", 32) * 2**20),
         socket_timeout_s=socket_timeout_s if socket_timeout_s > 0
         else None,
-        tracer=tracer, plane=plane)
+        tracer=tracer, plane=plane, deploy=pipeline)
     return plane, server
 
 
@@ -407,6 +480,36 @@ def main(argv=None):
     p.add_argument("--phase-timeout-s", type=float, default=30.0,
                    help="max seconds a shadow/canary phase may wait for "
                         "its request quota before rolling back")
+    # -- continuous deploy pipeline (docs/DEPLOY.md) --
+    p.add_argument("--watch", action="store_true",
+                   help="watch each model's <workdir>/<name> for new "
+                        "checkpoints (debounced across two polls so an "
+                        "in-progress async save never half-deploys), "
+                        "gate them on held-out data, and roll passing "
+                        "candidates through shadow/canary/promote "
+                        "automatically (--models only)")
+    p.add_argument("--watch-interval-s", type=float, default=2.0,
+                   help="checkpoint-fingerprint poll interval")
+    p.add_argument("--gate-dir", default=None,
+                   help="held-out eval set for the deploy accuracy "
+                        "gate: uint8 *.npy images (HWC or NHWC) plus "
+                        "an optional labels.txt (one int per image); "
+                        "without labels the gate scores top-1 "
+                        "AGREEMENT against the active version; default "
+                        "= deterministic synthetic batches (NaN screen "
+                        "+ agreement only)")
+    p.add_argument("--gate-min-agreement", type=float, default=0.8,
+                   help="label-free gate: minimum candidate-vs-active "
+                        "top-1 agreement to deploy")
+    p.add_argument("--min-replicas", type=int, default=0,
+                   help="boot each model's engine with this many "
+                        "per-device replicas — the autoscaler's floor "
+                        "(0 = use --serve-devices; --models only)")
+    p.add_argument("--max-replicas", type=int, default=0,
+                   help="autoscale replicas up to this ceiling on "
+                        "queue-pressure, back down to --min-replicas "
+                        "when idle (0 disables autoscaling; --models "
+                        "only)")
     p.add_argument("--drain-deadline", type=float, default=5.0,
                    help="shutdown grace: reject new submits immediately, "
                         "finish admitted work up to this many seconds")
@@ -459,6 +562,19 @@ def main(argv=None):
               f"shadow_frac={args.shadow_frac}) — reload: curl -XPOST "
               f"http://{server.host}:{server.port}"
               f"/v1/models/<name>/reload")
+    deploy = getattr(server.httpd, "deploy", None)
+    if deploy is not None:
+        bits = []
+        if deploy.watcher is not None:
+            bits.append(f"watch every {args.watch_interval_s}s"
+                        + (f", gate={args.gate_dir}" if args.gate_dir
+                           else ", gate=synthetic"))
+        if deploy.autoscalers:
+            bits.append(f"autoscale {args.min_replicas or 1}.."
+                        f"{args.max_replicas} replicas")
+        print(f"[serve] deploy pipeline: {'; '.join(bits)} — history: "
+              f"curl http://{server.host}:{server.port}"
+              f"/v1/deploy/<name>/history")
     if hasattr(engine, "replicas"):
         print(f"[serve] {len(engine.replicas)} replicas: "
               + ", ".join(r.model.placement_desc() or "default"
@@ -475,6 +591,10 @@ def main(argv=None):
     except KeyboardInterrupt:
         print("[serve] shutting down")
     finally:
+        if deploy is not None:
+            # the watcher/autoscaler threads stop BEFORE the engines
+            # drain — no scale action or rollout races the shutdown
+            deploy.stop()
         server.shutdown()
         engine.stop(drain_deadline=args.drain_deadline)
     return 0

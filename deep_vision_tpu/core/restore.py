@@ -49,24 +49,72 @@ def params_digest(params) -> str:
     return h.hexdigest()
 
 
+#: substring Orbax stamps on its atomic-rename staging artifacts
+#: (``<step>.orbax-checkpoint-tmp-<ts>`` dirs, and item-level tmp dirs
+#: inside a step while an async save is materializing it)
+_ORBAX_TMP_MARKER = "orbax-checkpoint-tmp"
+
+
+def _complete_step_dir(path: str) -> bool:
+    """A step dir counts as durable only once it has content and none
+    of that content is an Orbax in-progress staging artifact — a step
+    mid-async-save (empty, or holding ``*.orbax-checkpoint-tmp-*``
+    items) must not fingerprint as deployable."""
+    try:
+        with os.scandir(path) as it:
+            children = [e.name for e in it]
+    except OSError:
+        return False
+    if not children:
+        return False
+    return not any(_ORBAX_TMP_MARKER in name for name in children)
+
+
 def checkpoint_fingerprint(workdir: str) -> dict:
     """Filesystem-only "new step published?" probe: the newest retained
     step under ``checkpoints_best``/``checkpoints`` (same preference
-    order as ``load_state``), its source dir, and that dir's mtime —
-    no checkpoint bytes are read, so the control plane can poll this
-    per reload request without touching the restore path.  Returns
-    ``{"step": None, "dir": None, "mtime": None}`` for a workdir with
-    no checkpoints (the random-init fixture path)."""
-    from deep_vision_tpu.core import checkpoint as ckpt_lib
+    order as ``load_state``), its source dir, and the STEP dir's mtime
+    — no checkpoint bytes are read and no Orbax manager is built, so
+    the control plane and the deploy watcher can poll this on a tight
+    interval without touching the restore path (or blocking on an
+    in-flight async save).
 
+    Orbax in-progress artifacts are invisible here: ``*.orbax-
+    checkpoint-tmp-*`` staging dirs, non-numeric names, and incomplete
+    step dirs (empty, or still holding item-level tmp dirs) are all
+    skipped, and the mtime is taken from the newest durable step dir
+    itself rather than the parent — so an async save materializing next
+    door never changes the fingerprint of what is already deployable.
+    Returns ``{"step": None, "dir": None, "mtime": None}`` for a
+    workdir with no durable checkpoints (the random-init fixture
+    path)."""
     for sub in ("checkpoints_best", "checkpoints"):
         d = os.path.join(workdir, sub)
         if not os.path.isdir(d):
             continue
-        steps = ckpt_lib.Checkpointer(d).all_steps()
-        if steps:
-            return {"step": max(steps), "dir": d,
-                    "mtime": os.path.getmtime(d)}
+        newest = None  # (step, mtime)
+        try:
+            with os.scandir(d) as it:
+                entries = list(it)
+        except OSError:
+            continue
+        for ent in entries:
+            name = ent.name
+            if _ORBAX_TMP_MARKER in name or not name.isdigit():
+                continue
+            try:
+                if not ent.is_dir(follow_symlinks=False):
+                    continue
+                if not _complete_step_dir(ent.path):
+                    continue
+                mtime = ent.stat(follow_symlinks=False).st_mtime
+            except OSError:
+                continue  # torn down mid-scan: not durable
+            step = int(name)
+            if newest is None or step > newest[0]:
+                newest = (step, mtime)
+        if newest is not None:
+            return {"step": newest[0], "dir": d, "mtime": newest[1]}
     return {"step": None, "dir": None, "mtime": None}
 
 

@@ -69,8 +69,12 @@ class AdmissionController:
         self._bucket_ewma_s: dict[int, float] = {}  # bucket → EWMA
         # replicas able to absorb work right now: an int, or a zero-arg
         # callable the ReplicatedEngine wires to its routing mask (DEAD
-        # replicas drop out of the divisor as they drop out of routing)
+        # replicas drop out of the divisor as they drop out of routing;
+        # replicas added/removed at runtime move it the same way)
         self._free_replicas = 1
+        # provisioned replicas (DEAD included) — the /v1/stats capacity
+        # gauge; None falls back to the free-replica divisor
+        self._live_replicas = None
         self._lock = new_lock("serve.admission.AdmissionController._lock")
         self.shed_queue_full = 0  # guarded-by: _lock
         self.shed_deadline = 0  # guarded-by: _lock
@@ -106,6 +110,20 @@ class AdmissionController:
         n = self._free_replicas() if callable(self._free_replicas) \
             else self._free_replicas
         return max(1, int(n))
+
+    def set_live_replicas(self, provider):
+        """Wire the provisioned-replica gauge (int or zero-arg
+        callable): how many replicas exist right now, DEAD included —
+        what the autoscaler changes.  Unset, it mirrors the free-replica
+        divisor (a single engine is one replica either way)."""
+        self._live_replicas = provider
+
+    def _live_count(self) -> int:
+        p = self._live_replicas
+        if p is None:
+            return self._replica_divisor()
+        n = p() if callable(p) else p
+        return max(0, int(n))
 
     def estimated_service_s(self, bucket: int | None = None,
                             inflight: int = 0) -> float:
@@ -192,6 +210,7 @@ class AdmissionController:
 
     def stats(self) -> dict:
         n = self._replica_divisor()  # outside the lock, see above
+        live = self._live_count()
         with self._lock:
             out = {"shed_queue_full": self.shed_queue_full,
                    "shed_deadline": self.shed_deadline,
@@ -201,6 +220,7 @@ class AdmissionController:
                        str(b): round(v * 1e3, 3)
                        for b, v in sorted(self._bucket_ewma_s.items())},
                    "free_replicas": n,
+                   "live_replicas": live,
                    "max_queue": self.max_queue}
         if self.name is not None:
             out["name"] = self.name

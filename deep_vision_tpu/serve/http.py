@@ -40,6 +40,19 @@ Routes (JSON in, JSON out):
                        load → shadow → canary walk (body: {"force"?,
                        "wait"?}); promote/rollback override the gates on
                        the in-flight candidate (docs/SERVING.md runbook)
+    GET  /v1/deploy/{name}/history
+                       the append-only deployment ledger for one model
+                       (deploy/history.py): every candidate sighting,
+                       gate verdict, promote/rollback/revert — ``?n=``
+                       caps the tail (deploy pipeline required, 503
+                       otherwise)
+    POST /v1/deploy/{name}/revert
+                       one-command rollback to the last previously
+                       promoted version, through the plane's gated
+                       state machine: 200 reverted / 409 while a
+                       lifecycle is in flight or nothing to revert to /
+                       500 when the restored version fails to boot
+                       (docs/DEPLOY.md runbook)
     POST /v1/drain     zero-downtime shutdown hook: healthz flips to
                        503 ``draining`` IMMEDIATELY (so a gateway or
                        load balancer stops routing here), new requests
@@ -242,10 +255,49 @@ def render_serve_metrics(stats: dict) -> str:
                       plane.get("resubmitted"), {},
                       help="Requests transparently resubmitted across "
                            "a version swap")
+            p.counter("dvt_serve_reverts_total", plane.get("reverts"),
+                      {}, help="One-command reverts to a prior "
+                               "promoted version")
+        dep = stats.get("deploy")
+        if isinstance(dep, dict):
+            _render_deploy_metrics(p, dep)
         return p.render()
     for name, s in stats.items():
         _render_engine_metrics(p, name, s)
     return p.render()
+
+
+def _render_deploy_metrics(p, dep: dict) -> None:
+    """Emit the dvt_deploy_* series from ``DeployPipeline.stats()``."""
+    hist = dep.get("history") or {}
+    p.counter("dvt_deploy_history_records_total", hist.get("records"),
+              {}, help="Deployment-ledger records appended")
+    p.counter("dvt_deploy_history_write_errors_total",
+              hist.get("write_errors"), {},
+              help="Ledger appends that failed to reach disk")
+    w = dep.get("watcher")
+    if isinstance(w, dict):
+        p.counter("dvt_deploy_watcher_polls_total", w.get("polls"), {},
+                  help="Checkpoint-fingerprint polls")
+        p.counter("dvt_deploy_watcher_debounces_total",
+                  w.get("debounces"), {},
+                  help="Candidates held one interval for stability")
+        p.counter("dvt_deploy_deploys_total", w.get("deploys"), {},
+                  help="Watcher-initiated rollouts that promoted")
+        p.counter("dvt_deploy_gate_failures_total",
+                  w.get("gate_failures"), {},
+                  help="Candidates refused by the accuracy gate")
+    for mname, a in (dep.get("autoscale") or {}).items():
+        lab = {"model": mname}
+        p.counter("dvt_deploy_scale_ups_total", a.get("scale_ups"),
+                  lab, help="Autoscaler replica additions")
+        p.counter("dvt_deploy_scale_downs_total", a.get("scale_downs"),
+                  lab, help="Autoscaler replica drains")
+        p.counter("dvt_deploy_scale_errors_total",
+                  a.get("scale_errors"), lab,
+                  help="Scale actions that raised (cooldown consumed)")
+        p.gauge("dvt_deploy_pressure_ms", a.get("pressure_ms"), lab,
+                help="queue_depth × exec EWMA — the scale-up signal")
 
 
 def _render_engine_metrics(p, name: str, s: dict) -> None:
@@ -267,6 +319,18 @@ def _render_engine_metrics(p, name: str, s: dict) -> None:
               lab, help="Pad rows executed beyond live requests")
     p.gauge("dvt_serve_queue_depth", s["queue_depth"], lab,
             help="Requests queued awaiting batch formation")
+    routing = s.get("routing")
+    if isinstance(routing, dict):
+        p.gauge("dvt_serve_replicas", routing.get("replicas"), lab,
+                help="Replica slots ever provisioned (append-only)")
+        p.gauge("dvt_serve_live_replicas", routing.get("live_replicas"),
+                lab, help="Non-retired replicas (the elastic capacity)")
+        p.counter("dvt_serve_replicas_added_total",
+                  routing.get("replicas_added"), lab,
+                  help="Scale-up replica additions")
+        p.counter("dvt_serve_replicas_removed_total",
+                  routing.get("replicas_removed"), lab,
+                  help="Scale-down replica retirements")
     adm = s.get("admission", {})
     h = s.get("health", {})
     p.counter("dvt_serve_shed_total", adm.get("shed_queue_full"),
@@ -478,8 +542,12 @@ class _Handler(BaseHTTPRequestHandler):
                          "models": self.server.registry.names(),
                          "engines": reports})
         elif path == "/v1/stats":
+            deploy = getattr(self.server, "deploy", None)
             if plane is not None:
-                self._reply(200, plane.stats())
+                stats = plane.stats()
+                if deploy is not None:
+                    stats["deploy"] = deploy.stats()
+                self._reply(200, stats)
                 return
             self._reply(200, {name: eng.stats()
                               for name, eng in self.server.engines.items()})
@@ -493,6 +561,9 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/metrics":
             if plane is not None:
                 stats = plane.stats()
+                deploy = getattr(self.server, "deploy", None)
+                if deploy is not None:
+                    stats["deploy"] = deploy.stats()
             else:
                 stats = {name: eng.stats()
                          for name, eng in self.server.engines.items()}
@@ -509,6 +580,13 @@ class _Handler(BaseHTTPRequestHandler):
                 "summary": tracer.summary() if tracer is not None
                 else None})
         else:
+            parts = path.split("/")
+            # /v1/deploy/<name>/history: the deployment ledger
+            if len(parts) == 5 and parts[1] == "v1" \
+                    and parts[2] == "deploy" and parts[4] == "history":
+                self._reply(*self._deploy_history(
+                    parts[3], parse_qs(query)))
+                return
             self._reply(404, {"error": f"no route {self.path}"})
 
     def do_POST(self):
@@ -537,6 +615,10 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 if verb in ("classify", "detect"):
                     path = f"/v1/{verb}"
+            if len(parts) == 5 and parts[1] == "v1" \
+                    and parts[2] == "deploy" and parts[4] == "revert":
+                self._reply(*self._deploy_revert(parts[3]))
+                return
             body = self._body()
             if path == "/v1/classify":
                 payload = self._classify(body, path_model)
@@ -618,6 +700,41 @@ class _Handler(BaseHTTPRequestHandler):
         return (409 if out.get("status") in ("refused", "in_progress")
                 else 200), out
 
+    def _deploy_history(self, name: str, params: dict) -> tuple:
+        """GET /v1/deploy/<name>/history → (status, payload): the
+        ledger tail for one model, 503 without a deploy pipeline."""
+        deploy = getattr(self.server, "deploy", None)
+        if deploy is None:
+            return 503, {"error": f"/v1/deploy/{name}/history needs the "
+                                  f"deploy pipeline (cli.serve --watch "
+                                  f"or --max-replicas)"}
+        n = int(params.get("n", ["0"])[0]) or None
+        try:
+            entries = deploy.entries(name, n)
+        except KeyError as e:
+            return 404, {"error": e.args[0]}
+        return 200, {"model": name, "entries": entries}
+
+    def _deploy_revert(self, name: str) -> tuple:
+        """POST /v1/deploy/<name>/revert → (status, payload): the
+        pipeline's status-map contract — reverted 200, a lifecycle in
+        flight or nothing to revert to 409, boot failure 500."""
+        deploy = getattr(self.server, "deploy", None)
+        if deploy is None:
+            return 503, {"error": f"/v1/deploy/{name}/revert needs the "
+                                  f"deploy pipeline (cli.serve --watch "
+                                  f"or --max-replicas)"}
+        if int(self.headers.get("Content-Length") or 0) > 0:
+            self._body()  # drain: revert takes no parameters
+        try:
+            out = deploy.revert(name)
+        except KeyError as e:
+            return 404, {"error": e.args[0]}
+        status = out.get("status")
+        if status in ("refused", "in_progress"):
+            return 409, out
+        return (500 if status == "failed" else 200), out
+
     def _classify(self, body: dict, path_model: str | None = None) -> dict:
         import numpy as np
 
@@ -664,7 +781,7 @@ class ServeServer:
                  port: int = 0, verbose: bool = False,
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
                  socket_timeout_s: float | None = 30.0,
-                 tracer=None, plane=None):
+                 tracer=None, plane=None, deploy=None):
         self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.registry = registry
         self.httpd.engines = engines
@@ -672,6 +789,9 @@ class ServeServer:
         # stats / lifecycle endpoints go through it; None keeps the
         # original single-version behaviour byte-for-byte
         self.httpd.plane = plane
+        # deploy pipeline (deploy/__init__.py): ledger + watcher +
+        # autoscalers behind /v1/deploy/... and the dvt_deploy_* series
+        self.httpd.deploy = deploy
         self.httpd.verbose = verbose
         self.httpd.max_body_bytes = max_body_bytes
         self.httpd.socket_timeout_s = socket_timeout_s

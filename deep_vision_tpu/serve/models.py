@@ -303,6 +303,9 @@ class ModelVersion:
         self.state = LOADING
         self.loaded_at = time.monotonic()
         self.state_reason: str | None = None
+        # ever held the default route?  revert() only targets versions
+        # that actually served as ACTIVE (not rolled-back candidates)
+        self.was_active = False
         # canary accounting (filled by the plane's done-callbacks)
         self.canary_requests = 0
         self.canary_errors = 0
@@ -314,6 +317,7 @@ class ModelVersion:
     def describe(self) -> dict:
         d = {"version": self.version, "state": self.state,
              "state_reason": self.state_reason,
+             "was_active": self.was_active,
              "step": self.model.restored_step,
              "digest": getattr(self.model, "params_digest", None),
              "mtime": getattr(self.model, "restored_mtime", None),
@@ -375,6 +379,7 @@ class ModelControlPlane:
         self.reloads = 0  # guarded-by: _lock
         self.promotions = 0  # guarded-by: _lock
         self.rollbacks = 0  # guarded-by: _lock
+        self.reverts = 0  # guarded-by: _lock
         self.resubmitted = 0  # guarded-by: _lock
 
     # -- deployment --------------------------------------------------------
@@ -423,6 +428,7 @@ class ModelControlPlane:
             old = self._active.get(model.name)
             self._active[model.name] = mv
             mv.state = ACTIVE
+            mv.was_active = True
         if old is not None:
             self._retire(old, reason="replaced by deploy")
         event(_log, "deploy", model=model.name, version=mv.version,
@@ -446,6 +452,23 @@ class ModelControlPlane:
         if mv is None:
             raise KeyError(f"unknown model '{name}'; serving {names}")
         return mv.model
+
+    def active_version(self, name: str) -> ModelVersion:
+        """The ACTIVE ModelVersion for ``name`` (workdir + model +
+        engine in one handle) — the deploy watcher's view."""
+        with self._lock:
+            mv = self._active.get(name)
+            names = sorted(self._active)
+        if mv is None:
+            raise KeyError(f"unknown model '{name}'; serving {names}")
+        return mv
+
+    def load_candidate(self, name: str):
+        """Load (but do NOT deploy) the newest checkpoint under
+        ``name``'s workdir as a fresh ServingModel — the same restore
+        path a reload takes.  The deploy watcher's accuracy gate
+        evaluates this before anything enters the version table."""
+        return self._load_model(self.active_version(name))
 
     def active_engine(self, name: str):
         with self._lock:
@@ -856,6 +879,7 @@ class ModelControlPlane:
             old = self._active.get(name)
             self._active[name] = mv
             mv.state = ACTIVE
+            mv.was_active = True
             self.promotions += 1
             # the candidate stops being canary/shadow traffic the same
             # instant it becomes the default route
@@ -962,6 +986,80 @@ class ModelControlPlane:
         return {"status": "rolled_back", "model": name,
                 "version": pair[0].version}
 
+    def revert(self, name: str) -> dict:
+        """One-command rollback to the previous promoted version: mint
+        a NEW version wrapping the newest RETIRED model that actually
+        held the default route (``was_active``), start + warm its fresh
+        engine, then swap it ACTIVE through the same guarded
+        ``_promote`` transition every other path uses — the current
+        active drains afterwards, so no instant exists where neither
+        serves and admitted work finishes where it was admitted.
+
+        Busy-vs-failed semantics match the gateway fan-out: a lifecycle
+        already in flight answers ``in_progress`` (HTTP 409) without
+        touching anything; nothing to revert to answers ``refused``; a
+        revert whose engine fails to boot answers ``failed`` (500) and
+        leaves the current active untouched."""
+        with self._lock:
+            active = self._active.get(name)
+            if active is None:
+                raise KeyError(f"unknown model '{name}'; "
+                               f"serving {sorted(self._active)}")
+            t = self._reloading.get(name)
+            if (t is not None and t.is_alive()) \
+                    or name in self._canary or name in self._shadow:
+                return {"status": "in_progress", "model": name,
+                        "reason": "a reload lifecycle is in flight"}
+            target = None
+            for old in reversed(self._table.get(name, [])):
+                if old.version < active.version \
+                        and old.state == RETIRED and old.was_active:
+                    target = old
+                    break
+        if target is None:
+            return {"status": "refused", "model": name,
+                    "reason": "no previous promoted version to "
+                              "revert to"}
+        sm = target.model
+        engine = self.engine_factory(sm)
+        mv = ModelVersion(0, sm, engine, workdir=target.workdir)
+        # same single-critical-section allocation as deploy()/reload
+        with self._lock:
+            versions = self._table.setdefault(name, [])
+            mv.version = (versions[-1].version + 1) if versions else 1
+            sm.serve_version = mv.version
+            versions.append(mv)
+        try:
+            if self.cache is not None and \
+                    hasattr(sm, "_live_variables"):
+                self.cache.register(sm)
+            engine.start()
+            engine.warmup()  # no canary phase: warm before the swap
+        except Exception as e:  # noqa: BLE001 — failed revert must not take the active down
+            with self._lock:
+                mv.state = FAILED
+                mv.state_reason = f"{type(e).__name__}: {e}"
+            engine.stop()
+            if self.cache is not None:
+                self.cache.drop(sm)
+            self._release_weights(mv)
+            event(_log, "revert_failed", model=name, version=mv.version,
+                  error=mv.state_reason)
+            return {"status": "failed", "model": name,
+                    "reason": mv.state_reason}
+        if not self._promote(name, mv):
+            self._retire(mv, reason="revert lost the promote race")
+            return {"status": "refused", "model": name,
+                    "reason": "another lifecycle decided first"}
+        with self._lock:
+            self.reverts += 1
+        event(_log, "revert", model=name, version=mv.version,
+              restores=target.version, from_version=active.version,
+              step=sm.restored_step, digest=sm.params_digest)
+        return {"status": "reverted", "model": name,
+                "version": mv.version, "restores": target.version,
+                "from_version": active.version}
+
     # -- lifecycle / engine-surface compatibility --------------------------
 
     @property
@@ -1035,6 +1133,7 @@ class ModelControlPlane:
             plane = {"reloads": self.reloads,
                      "promotions": self.promotions,
                      "rollbacks": self.rollbacks,
+                     "reverts": self.reverts,
                      "resubmitted": self.resubmitted,
                      "policy": self.policy.describe()}
         models = {}

@@ -41,6 +41,17 @@ Failure semantics (docs/SERVING.md "Multi-device serving"):
     NO replica can serve (all DEAD, or the router's restart budget is
     spent) — a degraded replica drains, it doesn't take the fleet down.
 
+The replica set is ELASTIC (PR 11): ``add_replica()`` spawns a new
+per-device view on a spare local device and opens it to routing;
+``remove_replica(drain_deadline=)`` masks a slot out of routing and the
+admission divisor, drains its in-flight cohorts (evacuating stragglers
+onto a healthy peer — scale-down never drops admitted work), then stops
+the engine and releases the view's device weights (the ``WeightCache``
+entry is dropped with them).  Slots are append-only: a removed replica
+is masked, never popped, so rescue closures and routing counters keep
+stable indices.  ``deploy/autoscale.py`` drives both ends from the
+admission controller's observed load.
+
 The big-batch path is separate: ``--shard-batches`` builds ONE engine
 over ``registry.for_mesh`` so a single padded mega-batch spans the data
 axis of every chip (``engine.sharded_buckets`` keeps buckets divisible
@@ -131,28 +142,27 @@ class ReplicatedEngine:
         # trace state must not be per-replica
         self.tracer = tracer or Tracer()
         self.replicas: list[BatchingEngine] = []
+        # replica-construction kwargs, retained so add_replica() builds
+        # later replicas identically to the originals
+        self._replica_kwargs = dict(
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            buckets=buckets, pipeline_depth=pipeline_depth,
+            watchdog_interval_s=watchdog_interval_s,
+            restart_budget=restart_budget, **engine_kwargs)
         for i, dev in enumerate(self.devices):
-            view = model.for_device(dev) if hasattr(model, "for_device") \
-                else model
-            self.replicas.append(BatchingEngine(
-                view, max_batch=max_batch, max_wait_ms=max_wait_ms,
-                buckets=buckets, admission=self.admission,
-                pipeline_depth=pipeline_depth, faults=self.faults,
-                watchdog_interval_s=watchdog_interval_s,
-                restart_budget=restart_budget,
-                external_batcher=True,
-                rescue=(lambda pending, err, _i=i:
-                        self._rescue_from(_i, pending, err)),
-                tracer=self.tracer,
-                **engine_kwargs))
+            self.replicas.append(self._build_replica(i, dev))
         self.buckets = self.replicas[0].buckets
+        # replicas added later must reuse the resolved bucket ladder,
+        # not re-derive it — _bucket_for must agree across the fleet
+        self._replica_kwargs["buckets"] = list(self.buckets)
         self.max_batch = self.replicas[0].max_batch
         self.pipeline_depth = self.replicas[0].pipeline_depth
         # every replica view shares the source model's wire format
         self.wire_dtype = self.replicas[0].wire_dtype
         # DEAD replicas drop out of the shed estimate as they drop out
-        # of routing
+        # of routing; retired slots drop out of both gauges
         self.admission.set_free_replicas(self._free_replicas)
+        self.admission.set_live_replicas(self.live_replicas)
         self._queue: queue.Queue[_Request] = queue.Queue()
         self._lock = new_lock("serve.replicas.ReplicatedEngine._lock")
         self._stop = threading.Event()
@@ -162,12 +172,28 @@ class ReplicatedEngine:
         self._supervisor: threading.Thread | None = None
         self._rr = 0  # round-robin tie-break cursor
         self._evacuated = [False] * len(self.replicas)
+        # slots are append-only (rescue closures and routing counters
+        # are index-keyed): a removed replica is MASKED here, never
+        # popped, so indices stay stable for the life of the engine
+        self._retired = [False] * len(self.replicas)  # guarded-by: _lock
         self.submitted = 0  # guarded-by: _lock
         self.shed_shutdown = 0  # guarded-by: _lock
         self.routed_batches = [0] * len(self.replicas)  # guarded-by: _lock
         self.rescued_requests = 0  # guarded-by: _lock
         self.evacuations = 0  # guarded-by: _lock
         self.shed_all_dead = 0  # guarded-by: _lock
+        self.replicas_added = 0  # guarded-by: _lock
+        self.replicas_removed = 0  # guarded-by: _lock
+
+    def _build_replica(self, i: int, dev) -> BatchingEngine:
+        view = self.model.for_device(dev) \
+            if hasattr(self.model, "for_device") else self.model
+        return BatchingEngine(
+            view, admission=self.admission, faults=self.faults,
+            external_batcher=True,
+            rescue=(lambda pending, err, _i=i:
+                    self._rescue_from(_i, pending, err)),
+            tracer=self.tracer, **self._replica_kwargs)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -176,8 +202,9 @@ class ReplicatedEngine:
             self._stop.clear()
             self.health.revive()
             self._evacuated = [False] * len(self.replicas)
-            for rep in self.replicas:
-                rep.start()
+            for i, rep in enumerate(self.replicas):
+                if not self._retired[i]:
+                    rep.start()
             self._thread = threading.Thread(
                 target=self._route_loop,
                 name=f"router-{self.model.name}", daemon=True)
@@ -228,8 +255,9 @@ class ReplicatedEngine:
         self.stop()
 
     def warmup(self, buckets: list[int] | None = None):
-        for rep in self.replicas:
-            rep.warmup(buckets)
+        for i, rep in enumerate(self.replicas):
+            if not self._retired[i]:
+                rep.warmup(buckets)
 
     # -- request path ------------------------------------------------------
 
@@ -357,7 +385,7 @@ class ReplicatedEngine:
         for k in range(n):
             i = (start + k) % n
             rep = self.replicas[i]
-            if rep.health.state == DEAD:
+            if self._retired[i] or rep.health.state == DEAD:
                 continue
             score = (rep._inflight + rep._forming) * ewma
             if best_score is None or score < best_score:
@@ -365,7 +393,105 @@ class ReplicatedEngine:
         return best
 
     def _free_replicas(self) -> int:
-        return sum(1 for r in self.replicas if r.health.state != DEAD)
+        return sum(1 for i, r in enumerate(self.replicas)
+                   if not self._retired[i] and r.health.state != DEAD)
+
+    def live_replicas(self) -> int:
+        """Provisioned (non-retired) slots, DEAD included — the capacity
+        the autoscaler reasons about (a DEAD replica still occupies its
+        device until revived or retired)."""
+        return sum(1 for f in self._retired if not f)
+
+    # -- elasticity (deploy/autoscale.py drives these) ---------------------
+
+    def _spare_device(self):
+        used = {self.devices[i] for i in range(len(self.replicas))
+                if not self._retired[i]}
+        for dev in local_devices():
+            if dev not in used:
+                return dev
+        raise ValueError(
+            f"no free local device: {len(local_devices())} present, "
+            f"{self.live_replicas()} live replica(s)")
+
+    def add_replica(self, device=None) -> int:
+        """Scale up: build one more per-device replica (its own weight
+        view, AOT compile cache, pipeline window, watchdog) and open it
+        to routing.  Returns the new slot index.  The view registers
+        with the source model's weight cache (when one manages it) so
+        replica residency is budgeted like any version's weights —
+        scale-up can evict a colder model's weights, scale-down gives
+        the bytes back."""
+        if not hasattr(self.model, "for_device"):
+            raise ValueError(
+                f"model '{self.model.name}' has no per-device view "
+                f"(for_device) — StableHLO blobs serve single-device")
+        if device is None:
+            device = self._spare_device()
+        with self._lock:
+            i = len(self.replicas)
+            rep = self._build_replica(i, device)
+            self.replicas.append(rep)
+            self.devices.append(device)
+            self.routed_batches.append(0)
+            self._evacuated.append(False)
+            self._retired.append(False)
+            self.replicas_added += 1
+        cache = getattr(self.model, "_cache", None)
+        if cache is not None and rep.model is not self.model:
+            cache.register(rep.model)
+        if self._accepting:
+            rep.start()
+        event(_log, "replica_added", model=self.model.name, replica=i,
+              device=str(device), live=self.live_replicas())
+        return i
+
+    def remove_replica(self, index: int | None = None,
+                       drain_deadline: float = 5.0) -> int:
+        """Scale down without dropping admitted work: mask the replica
+        out of routing (and out of the admission divisor), let its
+        in-flight cohorts finish, evacuate whatever outlives
+        ``drain_deadline`` onto a healthy peer, then stop it and release
+        its device weights.  Refuses to retire the last live replica.
+        Returns the retired slot index."""
+        with self._lock:
+            live = [i for i in range(len(self.replicas))
+                    if not self._retired[i]]
+            if len(live) <= 1:
+                raise ValueError(
+                    "refusing to retire the last live replica")
+            if index is None:
+                # idlest live slot; ties break to the HIGHEST index so
+                # repeated scale-downs unwind recent scale-ups first
+                index = max(live, key=lambda i: (
+                    -(self.replicas[i]._inflight
+                      + self.replicas[i]._forming), i))
+            elif index not in live:
+                raise ValueError(f"replica {index} is not live")
+            self._retired[index] = True
+            self.replicas_removed += 1
+        rep = self.replicas[index]
+        t_end = time.monotonic() + drain_deadline
+        while time.monotonic() < t_end:
+            if rep._inflight + rep._forming == 0:
+                break
+            time.sleep(0.005)
+        if rep._inflight + rep._forming > 0:
+            # deadline blown: same re-homing path as replica death, so
+            # the cohorts finish elsewhere instead of being dropped
+            self._evacuated[index] = True
+            self._evacuate(index, reason="scale-down drain deadline")
+        rep.stop(timeout=5.0)
+        view = rep.model
+        if view is not self.model:
+            cache = getattr(self.model, "_cache", None)
+            if cache is not None:
+                cache.drop(view)
+            if hasattr(view, "release_device_weights"):
+                view.release_device_weights()
+        event(_log, "replica_removed", model=self.model.name,
+              replica=index, live=self.live_replicas())
+        return index
 
     # -- failure handling (rescue + evacuation) ----------------------------
 
@@ -378,7 +504,8 @@ class ReplicatedEngine:
         target = None
         best_score = None
         for i, rep in enumerate(self.replicas):
-            if i == source or rep.health.state == DEAD:
+            if i == source or self._retired[i] \
+                    or rep.health.state == DEAD:
                 continue
             score = rep._inflight + rep._forming
             if best_score is None or score < best_score:
@@ -415,6 +542,8 @@ class ReplicatedEngine:
         if t is not None and not t.is_alive():
             self._restart_router()
         for i, rep in enumerate(self.replicas):
+            if self._retired[i]:
+                continue  # scale-down owns its own drain/evacuation
             if rep.health.state == DEAD and not self._evacuated[i]:
                 self._evacuated[i] = True
                 self._evacuate(i)
@@ -441,13 +570,16 @@ class ReplicatedEngine:
             name=f"router-{self.model.name}", daemon=True)
         self._thread.start()
 
-    def _evacuate(self, i: int):
-        """A replica went DEAD with cohorts in flight: cancel its
-        window records (a late drain on a zombie thread is discarded)
-        and re-home every still-pending request on a healthy replica.
-        Admitted work survives replica death; only an all-DEAD fleet
-        fails futures."""
+    def _evacuate(self, i: int, reason: str | None = None):
+        """A replica left service with cohorts in flight (went DEAD, or
+        blew its scale-down drain deadline): cancel its window records
+        (a late drain on a zombie thread is discarded) and re-home every
+        still-pending request on a healthy replica.  Admitted work
+        survives replica departure; only an all-DEAD fleet fails
+        futures."""
         rep = self.replicas[i]
+        if reason is None:
+            reason = f"DEAD: {rep.health.dead_reason}"
         with rep._lock:  # dvtlint: lock=serve.engine.BatchingEngine._lock
             recs = [r for r in rep._inflight_recs if not r.cancelled]
             for r in recs:
@@ -460,15 +592,14 @@ class ReplicatedEngine:
         pending = [q for r in recs for q in r.requests
                    if not q.future.done()]
         event(_log, "evacuation", model=self.model.name, replica=i,
-              reason=rep.health.dead_reason, requests=len(pending))
+              reason=reason, requests=len(pending))
         if not pending:
             return
         for q in pending:
             if q.span is not None:
-                q.span.note("evacuated", f"replica {i} DEAD")
+                q.span.note("evacuated", f"replica {i}: {reason}")
         err = RuntimeError(
-            f"replica {i} is DEAD ({rep.health.dead_reason}); "
-            f"cohort re-routed")
+            f"replica {i} left service ({reason}); cohort re-routed")
         if not self._rescue_from(i, pending, err):
             for q in pending:
                 if not q.future.done():
@@ -485,11 +616,17 @@ class ReplicatedEngine:
         rep["drainer_alive"] = None  # replicas own their drainers
         rep["accepting"] = self._accepting
         rep["inflight"] = self.total_inflight()
-        replicas = {str(i): r.health_report()
-                    for i, r in enumerate(self.replicas)}
+        replicas = {}
+        states = []  # live slots only: retired replicas can't 503 us
+        for i, r in enumerate(self.replicas):
+            h = r.health_report()
+            h["retired"] = self._retired[i]
+            replicas[str(i)] = h
+            if not self._retired[i]:
+                states.append(h["state"])
         rep["replicas"] = replicas
-        states = [r["state"] for r in replicas.values()]
-        if router_state == DEAD or all(s == DEAD for s in states):
+        if router_state == DEAD or not states \
+                or all(s == DEAD for s in states):
             state = DEAD
         elif router_state == OK and all(s == OK for s in states):
             state = OK
@@ -512,7 +649,7 @@ class ReplicatedEngine:
         rep["watchdog_restarts"] += sum(r.health.watchdog_restarts
                                         for r in self.replicas)
         rep["shed_shutdown"] = self.shed_shutdown
-        ages = [a for r in replicas.values()
+        ages = [a for r in replicas.values() if not r.get("retired")
                 if (a := r.get("last_batch_age_s")) is not None]
         rep["last_batch_age_s"] = min(ages) if ages else None
         if self.faults.enabled:
@@ -534,6 +671,7 @@ class ReplicatedEngine:
                 "device": rep.model.placement_desc()
                 if hasattr(rep.model, "placement_desc") else None,
                 "state": rep.health.state,
+                "retired": self._retired[i],
                 "routed_batches": routed,
                 "batches": rep.batches,
                 "served": rep.served,
@@ -562,10 +700,13 @@ class ReplicatedEngine:
                    "routing": {
                        "policy": "least_outstanding_work",
                        "replicas": len(self.replicas),
+                       "live_replicas": self.live_replicas(),
                        "free_replicas": self._free_replicas(),
                        "rescued_requests": self.rescued_requests,
                        "evacuations": self.evacuations,
-                       "shed_all_dead": self.shed_all_dead}}
+                       "shed_all_dead": self.shed_all_dead,
+                       "replicas_added": self.replicas_added,
+                       "replicas_removed": self.replicas_removed}}
         out["replicas"] = per
         pooled: dict = {}
         h2d_by_bucket: dict = {}

@@ -48,7 +48,7 @@ def host_devices():
 # if any thread observed a lock-order inversion (even one a worker thread
 # swallowed). Engines/gateways are constructed inside the tests, after this
 # fixture enables the seam, so every lock they create is instrumented.
-_SANITIZED_MARKERS = {"chaos", "gateway", "replicas", "models"}
+_SANITIZED_MARKERS = {"chaos", "gateway", "replicas", "models", "deploy"}
 
 
 @pytest.fixture(autouse=True)

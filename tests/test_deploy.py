@@ -1,0 +1,563 @@
+"""Continuous-deploy pipeline contract (CPU, tier-1 fast): the
+checkpoint watcher debounces in-progress saves and acts on a stable
+fingerprint exactly once, the accuracy gate blocks NaN/regressed
+candidates while the active version keeps serving, revert restores the
+previous promoted weights under live load with zero lost requests, and
+the replica autoscaler scales up on queue pressure / down on sustained
+idle with hysteresis + cooldown — draining, never dropping, in-flight
+cohorts.
+
+Uses LeNet at random init (deterministic under PRNGKey(0)): deploy
+correctness is about state machines and routing, not learned weights.
+Runs with the lock-order sanitizer enabled (conftest fixture keyed on
+the ``deploy`` marker).
+"""
+
+import os
+import queue
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.serve.admission import AdmissionController, Shed
+from deep_vision_tpu.serve.engine import BatchingEngine
+from deep_vision_tpu.serve.models import (ACTIVE, RETIRED, CanaryPolicy,
+                                          ModelControlPlane, WeightCache)
+from deep_vision_tpu.serve.registry import (CheckpointServingModel,
+                                            ModelRegistry)
+
+pytestmark = pytest.mark.deploy
+
+
+def _engine_factory(model):
+    return BatchingEngine(model, buckets=[4], max_wait_ms=2)
+
+
+def _clone_sm(sm, transform=None):
+    """A new ServingModel over the same (or ``transform``-ed) weights —
+    the watcher loader seam's 'new checkpoint' stand-in."""
+    import jax
+
+    params = sm._variables["params"]
+    if transform is not None:
+        params = jax.tree_util.tree_map(transform, params)
+    state = types.SimpleNamespace(
+        params=params,
+        batch_stats=sm._variables.get("batch_stats"))
+    new = CheckpointServingModel(sm.name, sm.cfg, sm._model, state)
+    new.restored_step = (sm.restored_step or 0) + 1
+    return new
+
+
+@pytest.fixture()
+def lenet_plane(tmp_path):
+    reg = ModelRegistry()
+    workdir = str(tmp_path / "lenet5")
+    sm = reg.load_checkpoint("lenet5", workdir)
+    plane = ModelControlPlane(
+        reg, _engine_factory, cache=WeightCache(budget_bytes=0),
+        policy=CanaryPolicy(canary_frac=0.5, min_requests=3,
+                            max_p99_ratio=None, phase_timeout_s=15.0),
+        admission_factory=lambda name: AdmissionController(name=name))
+    plane.deploy(sm, workdir=workdir)
+    yield reg, sm, plane, workdir
+    plane.stop()
+
+
+def _img(shape=(32, 32, 1), seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+class _LoadThread(threading.Thread):
+    """Closed-loop client collecting every failure, so deploy/revert
+    tests can assert the zero-lost-requests contract."""
+
+    def __init__(self, plane, name, img):
+        super().__init__(daemon=True)
+        self.plane, self.name, self.img = plane, name, img
+        self.stop_flag = threading.Event()
+        self.served = 0
+        self.errors: list = []
+
+    def run(self):
+        while not self.stop_flag.is_set():
+            try:
+                r = self.plane.infer(self.name, self.img, timeout=30)
+            except Exception as e:  # noqa: BLE001 — every failure is a lost request
+                self.errors.append(repr(e))
+                continue
+            if isinstance(r, Shed):
+                self.errors.append(repr(r))
+                continue
+            self.served += 1
+
+    def finish(self):
+        self.stop_flag.set()
+        self.join(30)
+
+
+def _fake_ckpt(workdir: str, step: int, mtime: float | None = None,
+               kind: str = "checkpoints") -> str:
+    """A complete-looking Orbax step dir: fingerprinting reads only
+    filesystem metadata, so a numeric dir with one file inside is a
+    checkpoint as far as the watcher is concerned."""
+    d = os.path.join(workdir, kind, str(step))
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "params"), "w") as f:
+        f.write("x")
+    if mtime is not None:
+        os.utime(d, (mtime, mtime))
+    return d
+
+
+# -- checkpoint fingerprint (satellite: tmp/incomplete artifacts) ----------
+
+
+def test_fingerprint_skips_tmp_and_incomplete(tmp_path):
+    """An async save's ``*.orbax-checkpoint-tmp-*`` staging dir and an
+    empty (still-materializing) step dir must not move the fingerprint;
+    the completed step must."""
+    from deep_vision_tpu.core.restore import checkpoint_fingerprint
+
+    workdir = str(tmp_path / "w")
+    assert checkpoint_fingerprint(workdir)["step"] is None
+    _fake_ckpt(workdir, 100)
+    before = checkpoint_fingerprint(workdir)
+    assert before["step"] == 100
+
+    # in-progress async save: staging dir + empty final dir
+    staging = os.path.join(workdir, "checkpoints",
+                           "101.orbax-checkpoint-tmp-1234")
+    os.makedirs(staging)
+    with open(os.path.join(staging, "params"), "w") as f:
+        f.write("x")
+    os.makedirs(os.path.join(workdir, "checkpoints", "101"))
+    assert checkpoint_fingerprint(workdir) == before
+
+    # non-numeric clutter is ignored too
+    os.makedirs(os.path.join(workdir, "checkpoints", "tmpdir"))
+    assert checkpoint_fingerprint(workdir) == before
+
+    # the save completes: fingerprint moves to the new step
+    _fake_ckpt(workdir, 101)
+    assert checkpoint_fingerprint(workdir)["step"] == 101
+    # checkpoints_best outranks checkpoints (load_state's preference)
+    _fake_ckpt(workdir, 102, kind="checkpoints_best")
+    assert checkpoint_fingerprint(workdir)["step"] == 102
+
+
+# -- deployment history ----------------------------------------------------
+
+
+def test_history_ledger_survives_restart_and_torn_tail(tmp_path):
+    from deep_vision_tpu.deploy import DeploymentHistory
+
+    root = str(tmp_path / "_deploy")
+    h = DeploymentHistory(root, retain=4)
+    for i in range(6):
+        h.record("lenet5", "candidate", step=i)
+    h.record("other", "promoted", version=2)
+    # in-memory view trims to retain; the file keeps everything
+    assert [e["step"] for e in h.entries("lenet5")] == [2, 3, 4, 5]
+    assert h.entries("lenet5", n=2)[-1]["step"] == 5
+    assert h.last_outcome("other") == "promoted"
+
+    # crash mid-append: a torn tail line is skipped on reload
+    with open(os.path.join(root, "lenet5.jsonl"), "a") as f:
+        f.write('{"ts": 1, "model": "lenet5", "outco')
+    h2 = DeploymentHistory(root, retain=4)
+    assert [e["step"] for e in h2.entries("lenet5")] == [2, 3, 4, 5]
+    assert sorted(h2.names()) == ["lenet5", "other"]
+    st = h2.stats()
+    assert st["models"]["lenet5"]["last_outcome"] == "candidate"
+
+
+# -- accuracy gate ---------------------------------------------------------
+
+
+def test_gate_identical_weights_pass(lenet_plane):
+    from deep_vision_tpu.deploy import AccuracyGate
+
+    _, sm, _, _ = lenet_plane
+    out = AccuracyGate().evaluate(_clone_sm(sm), sm)
+    assert out["passed"]
+    assert out["agreement"] == 1.0
+    assert out["gate_dir"] == "synthetic"
+
+
+def test_gate_fails_nan_candidate(lenet_plane):
+    from deep_vision_tpu.deploy import AccuracyGate
+
+    _, sm, _, _ = lenet_plane
+    bad = _clone_sm(sm, transform=lambda a: a * np.nan)
+    out = AccuracyGate().evaluate(bad, sm)
+    assert not out["passed"]
+    assert "NaN" in out["reason"]
+
+
+def test_gate_labeled_accuracy(lenet_plane, tmp_path):
+    """labels.txt beside the *.npy images upgrades the gate from
+    agreement to real accuracy: identical weights pass at delta 0, a
+    candidate collapsed to one class fails on the accuracy drop."""
+    from deep_vision_tpu.deploy import AccuracyGate
+
+    _, sm, _, _ = lenet_plane
+    gate_dir = str(tmp_path / "holdout")
+    os.makedirs(gate_dir)
+    rng = np.random.RandomState(0)
+    for i in range(16):
+        np.save(os.path.join(gate_dir, f"img_{i:02d}.npy"),
+                rng.randint(0, 256, (32, 32, 1), dtype=np.uint8))
+    gate = AccuracyGate(gate_dir=gate_dir)
+    # labels := the active model's own predictions → active_acc == 1.0
+    preds, nan = gate._predict(sm, gate._batches(sm))
+    assert preds is not None and not nan
+    np.savetxt(os.path.join(gate_dir, "labels.txt"),
+               np.asarray(preds, np.int64), fmt="%d")
+
+    out = gate.evaluate(_clone_sm(sm), sm)
+    assert out["passed"]
+    assert out["candidate_acc"] == 1.0
+    assert out["active_acc"] == 1.0
+    assert out["delta"] == 0.0
+
+    # zeroed params → uniform logits → argmax collapses to class 0
+    flat = gate.evaluate(_clone_sm(sm, transform=np.zeros_like), sm)
+    assert flat["candidate_acc"] < 1.0
+    assert not flat["passed"]
+    assert "dropped" in flat["reason"]
+
+
+# -- checkpoint watcher ----------------------------------------------------
+
+
+def _watcher(plane, history=None, gate=None, loader=None):
+    from deep_vision_tpu.deploy import CheckpointWatcher, DeploymentHistory
+
+    history = history or DeploymentHistory()
+    w = CheckpointWatcher(plane, history, interval_s=0.05, gate=gate,
+                          loader=loader).watch("lenet5")
+    return w, history
+
+
+def test_watcher_debounce_never_acts_on_moving_fingerprint(lenet_plane):
+    _, sm, plane, workdir = lenet_plane
+    w, _ = _watcher(plane, loader=lambda p, n: _clone_sm(sm))
+    assert w.poll_once("lenet5")["status"] == "no_checkpoint"
+    # a fingerprint that changes between every pair of polls (an async
+    # save still materializing) never graduates past debounce
+    for i in range(4):
+        _fake_ckpt(workdir, 5, mtime=1000.0 + i)
+        assert w.poll_once("lenet5")["status"] == "debounce"
+    assert w.stats()["deploys"] == 0
+    assert w.stats()["debounces"] == 4
+
+
+def test_watcher_deploys_stable_fingerprint_exactly_once(lenet_plane):
+    _, sm, plane, workdir = lenet_plane
+    w, history = _watcher(plane, loader=lambda p, n: _clone_sm(sm))
+    _fake_ckpt(workdir, 5, mtime=1000.0)
+    assert w.poll_once("lenet5")["status"] == "debounce"
+    load = _LoadThread(plane, "lenet5", _img())
+    load.start()
+    try:
+        out = w.poll_once("lenet5")  # stable across two polls → deploy
+    finally:
+        load.finish()
+    assert out["status"] == "promoted"
+    assert load.errors == []
+    assert plane.active_version("lenet5").model.restored_step \
+        == (sm.restored_step or 0) + 1
+    # the same fingerprint is decided at most once
+    assert w.poll_once("lenet5")["status"] == "acted"
+    assert w.stats()["deploys"] == 1
+    outcomes = [e["outcome"] for e in history.entries("lenet5")]
+    assert outcomes == ["candidate", "promoted"]
+
+
+def test_watcher_gate_failure_keeps_active_serving(lenet_plane):
+    from deep_vision_tpu.deploy import AccuracyGate
+
+    _, sm, plane, workdir = lenet_plane
+    active_before = plane.active_version("lenet5")
+    w, history = _watcher(
+        plane, gate=AccuracyGate(),
+        loader=lambda p, n: _clone_sm(sm, transform=lambda a: a * np.nan))
+    _fake_ckpt(workdir, 7, mtime=2000.0)
+    assert w.poll_once("lenet5")["status"] == "debounce"
+    out = w.poll_once("lenet5")
+    assert out["status"] == "gate_failed"
+    assert "NaN" in out["gate"]["reason"]
+    # FAILED deployment recorded with the eval verdict; active untouched
+    outcomes = [e["outcome"] for e in history.entries("lenet5")]
+    assert outcomes == ["candidate", "gate_failed"]
+    assert plane.active_version("lenet5") is active_before
+    assert w.stats()["gate_failures"] == 1
+    assert w.stats()["deploys"] == 0
+    assert w.poll_once("lenet5")["status"] == "acted"
+
+
+# -- revert ----------------------------------------------------------------
+
+
+def test_revert_under_load_restores_previous_version(lenet_plane):
+    from deep_vision_tpu.deploy import DeployPipeline
+
+    _, sm, plane, _ = lenet_plane
+    pipeline = DeployPipeline(plane)
+    v1_digest = plane.active_version("lenet5").model.params_digest
+    load = _LoadThread(plane, "lenet5", _img())
+    load.start()
+    try:
+        out = plane.reload("lenet5", wait=True,
+                           _loader=lambda: _clone_sm(sm))
+        assert out["version"]["state"] == ACTIVE
+        assert plane.active_version("lenet5").version == 2
+        rv = pipeline.revert("lenet5")
+    finally:
+        load.finish()
+    assert rv["status"] == "reverted"
+    assert rv["from_version"] == 2
+    active = plane.active_version("lenet5")
+    assert active.version == 3
+    assert active.model.params_digest == v1_digest
+    # zero admitted-request loss across reload AND revert
+    assert load.errors == []
+    assert load.served > 0
+    assert pipeline.history.last_outcome("lenet5") == "reverted"
+    # the displaced v2 drained out of service
+    assert plane.models()["lenet5"]["versions"][1]["state"] == RETIRED
+
+
+def test_revert_refused_without_prior_promoted_version(lenet_plane):
+    from deep_vision_tpu.deploy import DeployPipeline
+
+    _, _, plane, _ = lenet_plane
+    out = DeployPipeline(plane).revert("lenet5")
+    assert out["status"] == "refused"  # → HTTP 409
+    with pytest.raises(KeyError):
+        DeployPipeline(plane).revert("nope")
+
+
+def test_revert_refuses_while_reload_in_flight(lenet_plane):
+    _, sm, plane, _ = lenet_plane
+    gate = threading.Event()
+
+    def slow_loader():
+        gate.wait(10)
+        return _clone_sm(sm)
+
+    load = _LoadThread(plane, "lenet5", _img())
+    load.start()
+    try:
+        assert plane.reload("lenet5", wait=False,
+                            _loader=slow_loader)["status"] == "reloading"
+        out = plane.revert("lenet5")
+        assert out["status"] == "in_progress"  # → HTTP 409
+    finally:
+        gate.set()
+        worker = plane._reloading.get("lenet5")
+        if worker is not None:
+            worker.join(20)
+        load.finish()
+
+
+# -- replica autoscaler ----------------------------------------------------
+
+
+class _FakeEngine:
+    """The four signals + two actions the scaler touches, no devices."""
+
+    def __init__(self, live=1, ewma_s=0.01):
+        self._queue: queue.Queue = queue.Queue()
+        self.admission = types.SimpleNamespace(
+            bucket_ewma_s=lambda: ewma_s)
+        self.model = types.SimpleNamespace(name="fake")
+        self.live = live
+        self.inflight = 0
+
+    def total_inflight(self):
+        return self.inflight
+
+    def live_replicas(self):
+        return self.live
+
+    def add_replica(self):
+        self.live += 1
+        return self.live - 1
+
+    def remove_replica(self, drain_deadline=5.0):
+        self.live -= 1
+        return self.live
+
+
+def _pressurize(eng, n):
+    while eng._queue.qsize() < n:
+        eng._queue.put(object())
+    while eng._queue.qsize() > n:
+        eng._queue.get_nowait()
+
+
+def test_autoscaler_hysteresis_and_cooldown():
+    from deep_vision_tpu.deploy import ReplicaAutoscaler
+
+    eng = _FakeEngine()
+    s = ReplicaAutoscaler(eng, min_replicas=1, max_replicas=3,
+                          high_water_ms=50.0, up_window=3,
+                          down_window=3, cooldown_s=60.0)
+    # pressure_ms = depth × 10ms: 10 deep = 100ms > high water
+    _pressurize(eng, 10)
+    assert s.tick() is None and s.tick() is None  # hysteresis: 2 < 3
+    assert eng.live == 1  # monotone within the window
+    act = s.tick()
+    assert act["action"] == "scale_up" and eng.live == 2
+    # cooldown: sustained pressure cannot act again immediately
+    for _ in range(5):
+        assert s.tick() is None
+    assert eng.live == 2
+    assert s.scale_ups == 1
+
+    # a contrary tick resets the idle streak
+    s2 = ReplicaAutoscaler(_FakeEngine(live=3), min_replicas=1,
+                           max_replicas=3, high_water_ms=50.0,
+                           up_window=3, down_window=3, cooldown_s=0.0)
+    assert s2.tick() is None and s2.tick() is None  # idle ×2
+    _pressurize(s2.engine, 1)  # brief blip: not idle, not high water
+    assert s2.tick() is None
+    _pressurize(s2.engine, 0)
+    assert s2.tick() is None and s2.tick() is None  # restart the streak
+    assert s2.engine.live == 3
+    act = s2.tick()
+    assert act["action"] == "scale_down" and s2.engine.live == 2
+
+    # bounds: at min_replicas, idleness never counts
+    s3 = ReplicaAutoscaler(_FakeEngine(live=1), min_replicas=1,
+                           max_replicas=3, down_window=1, cooldown_s=0.0)
+    for _ in range(5):
+        assert s3.tick() is None
+    assert s3.engine.live == 1
+
+
+def test_autoscaler_failed_action_consumes_cooldown():
+    from deep_vision_tpu.deploy import ReplicaAutoscaler
+
+    class _Broken(_FakeEngine):
+        def add_replica(self):
+            raise ValueError("no free local device")
+
+    eng = _Broken()
+    s = ReplicaAutoscaler(eng, min_replicas=1, max_replicas=3,
+                          up_window=1, cooldown_s=60.0)
+    _pressurize(eng, 10)
+    assert s.tick() is None
+    assert s.scale_errors == 1
+    assert s.tick() is None  # cooling down, not retrying hot
+    assert s.scale_errors == 1
+
+
+# -- elastic ReplicatedEngine on forced host devices -----------------------
+
+
+@pytest.fixture()
+def elastic_engine(tmp_path, host_devices):
+    from deep_vision_tpu.serve.replicas import ReplicatedEngine
+
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("lenet5", str(tmp_path / "l"))
+    eng = ReplicatedEngine(sm, devices=host_devices[:1], buckets=[4],
+                           max_wait_ms=2)
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+def test_add_remove_replica_live_accounting(elastic_engine):
+    eng = elastic_engine
+    assert eng.live_replicas() == 1
+    i = eng.add_replica()
+    assert i == 1
+    assert eng.live_replicas() == 2
+    # satellite (b): admission accounting follows elasticity
+    assert eng.admission.stats()["live_replicas"] == 2
+    assert eng.stats()["routing"]["live_replicas"] == 2
+    for seed in range(8):
+        r = eng.infer(_img(seed=seed), timeout=30)
+        assert not isinstance(r, Shed)
+
+    removed = eng.remove_replica(drain_deadline=10.0)
+    assert eng.live_replicas() == 1
+    assert eng.admission.stats()["live_replicas"] == 1
+    per = eng.stats()["replicas"]
+    assert per[removed]["retired"] is True
+    # retired slots are masked, never popped: indices stay stable
+    assert [p["replica"] for p in per] == [0, 1]
+    r = eng.infer(_img(), timeout=30)
+    assert not isinstance(r, Shed)
+    with pytest.raises(ValueError):
+        eng.remove_replica()  # never below one live replica
+
+
+def test_scale_down_drains_inflight_cohorts(elastic_engine):
+    """remove_replica under load: every future admitted before the
+    drain resolves to a real output — scale-down drops nothing."""
+    eng = elastic_engine
+    eng.add_replica()
+    futs = [eng.submit(_img(seed=s)) for s in range(24)]
+    removed = eng.remove_replica(drain_deadline=10.0)
+    for f in futs:
+        r = f.result(timeout=30)
+        assert not isinstance(r, Shed)
+        assert np.isfinite(np.asarray(r)).all()
+    assert eng.stats()["replicas"][removed]["retired"] is True
+
+
+def test_autoscaler_drives_real_engine(elastic_engine):
+    """Forced pressure scales the real engine up; real idleness scales
+    it back down; the count stays inside [min, max] throughout."""
+    from deep_vision_tpu.deploy import ReplicaAutoscaler
+
+    eng = elastic_engine
+
+    class _Forced(ReplicaAutoscaler):
+        forced: dict | None = None
+
+        def signals(self):
+            sig = super().signals()
+            if self.forced is not None:
+                sig.update(self.forced)
+            return sig
+
+    s = _Forced(eng, min_replicas=1, max_replicas=2, up_window=2,
+                down_window=2, cooldown_s=0.0, high_water_ms=50.0)
+    s.forced = {"pressure_ms": 500.0, "queue_depth": 5}
+    acts = [s.tick() for _ in range(3)]
+    assert [a["action"] for a in acts if a] == ["scale_up"]
+    assert eng.live_replicas() == 2
+    # at max_replicas, pressure no longer counts toward scaling up
+    assert s.tick() is None and s.tick() is None
+    assert eng.live_replicas() == 2
+
+    s.forced = None  # real signals: queue empty, nothing in flight
+    acts = [s.tick() for _ in range(3)]
+    assert [a["action"] for a in acts if a] == ["scale_down"]
+    assert eng.live_replicas() == 1
+    assert 1 <= s.stats()["live"] <= 2
+
+
+# -- pipeline stats / HTTP glue -------------------------------------------
+
+
+def test_pipeline_entries_unknown_model_raises(lenet_plane):
+    from deep_vision_tpu.deploy import DeployPipeline
+
+    _, _, plane, _ = lenet_plane
+    pipeline = DeployPipeline(plane)
+    pipeline.history.record("lenet5", "candidate", step=1)
+    assert pipeline.entries("lenet5")[-1]["outcome"] == "candidate"
+    with pytest.raises(KeyError):
+        pipeline.entries("nope")
+    st = pipeline.stats()
+    assert st["history"]["records"] == 1
