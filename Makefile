@@ -39,11 +39,26 @@ lint-test:
 # machine-checked invariants fails here before any engine boots
 serve-smoke: lint lint-test
 	$(PY) tests/serve_smoke.py
+	$(PY) tests/edge_smoke.py
 	$(PY) tests/quant_smoke.py
 	$(PY) tests/model_smoke.py
 	$(PY) tests/deploy_smoke.py
 	$(PY) tests/gateway_smoke.py
 	$(PY) tests/obs_smoke.py
+
+# the async HTTP edge end to end over real sockets: keep-alive reuse
+# visible in the connection counters, a content-addressed cache hit
+# consuming zero engine capacity, the starved tenant class 429ing
+# (Retry-After) while premium serves, a stalled body 408'd and a
+# slow-loris closed silently by the deadline sweep
+edge-smoke:
+	$(PY) tests/edge_smoke.py
+
+# the edge unit suite alone (selector loop, pipelining, bounded
+# connections + eviction/accept-pause, cache lifecycle, tenant QoS,
+# gateway connection pooling + payload affinity)
+edge-test:
+	$(PY) -m pytest tests/test_edge.py -q -m edge
 
 # the int8 quantization path end to end: calibrate at load, serve
 # int8-resident weights over real HTTP next to an f32 lane on the same
@@ -190,5 +205,6 @@ list:
 	bench-serve-scaling bench-serve-wire bench-gateway bench-deploy \
 	serve-smoke \
 	serve-multi serve-chaos gateway-smoke gateway-test obs-smoke \
+	edge-smoke edge-test \
 	obs-test model-smoke model-test quant-smoke quant-test \
 	deploy-smoke deploy-test lint lint-test list

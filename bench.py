@@ -1027,11 +1027,10 @@ def bench_gateway(model_name: str = "lenet5", loads: tuple = (1, 8),
     retry on the survivor).  Load points carry ``errors`` and
     ``retries`` like the ``--serve`` bench, plus the gateway's own
     counters (retries, failovers, breaker transitions, hedges)."""
+    import http.client
     import sys
     import tempfile
     import threading
-    import urllib.error
-    import urllib.request
 
     import numpy as np
 
@@ -1066,7 +1065,6 @@ def bench_gateway(model_name: str = "lenet5", loads: tuple = (1, 8),
                  probe_interval_s=0.05, retry_budget=3,
                  breaker_threshold=2, breaker_cooldown_s=30.0).start()
     gsrv = GatewayServer(gw, port=0).start_background()
-    url = f"http://127.0.0.1:{gsrv.port}/v1/classify"
     points = []
     failover: dict = {}
     try:
@@ -1083,27 +1081,38 @@ def bench_gateway(model_name: str = "lenet5", loads: tuple = (1, 8),
             def client(seed):
                 rng = random.Random(seed)
                 local, local_err, local_retry = [], 0, 0
+                # ONE persistent keep-alive connection per worker (it
+                # reconnects lazily after close()): the bench pays the
+                # TCP handshake once, not once per request, matching
+                # how production clients drive the edge
+                conn = http.client.HTTPConnection(
+                    "127.0.0.1", gsrv.port, timeout=60)
                 while time.perf_counter() < stop_at:
                     t0 = time.perf_counter()
                     try:
                         for _ in range(3):
-                            req = urllib.request.Request(
-                                url, data=body,
-                                headers={"Content-Type":
-                                         "application/json"})
                             try:
-                                with urllib.request.urlopen(
-                                        req, timeout=60) as r:
-                                    r.read()
+                                conn.request(
+                                    "POST", "/v1/classify", body,
+                                    {"Content-Type":
+                                     "application/json"})
+                                r = conn.getresponse()
+                                r.read()
+                            except (OSError,
+                                    http.client.HTTPException):
+                                conn.close()  # stale conn: redial
+                                raise
+                            if r.will_close:
+                                conn.close()
+                            if r.status == 200:
                                 break
-                            except urllib.error.HTTPError as e:
-                                if e.code != 429:
-                                    raise
-                                local_retry += 1
-                                ra = float(e.headers.get(
-                                    "Retry-After") or 1)
-                                time.sleep(min(ra, 0.25)
-                                           * (0.5 + rng.random()))
+                            if r.status != 429:
+                                raise RuntimeError(f"HTTP {r.status}")
+                            local_retry += 1
+                            ra = float(r.headers.get(
+                                "Retry-After") or 1)
+                            time.sleep(min(ra, 0.25)
+                                       * (0.5 + rng.random()))
                         else:
                             local_err += 1
                             continue
@@ -1112,6 +1121,7 @@ def bench_gateway(model_name: str = "lenet5", loads: tuple = (1, 8),
                         continue
                     local.append((t0 - t_base,
                                   time.perf_counter() - t0))
+                conn.close()
                 with lock:
                     latencies.extend(local)
                     errors[0] += local_err
@@ -1175,6 +1185,346 @@ def bench_gateway(model_name: str = "lenet5", loads: tuple = (1, 8),
             "pipeline_depth": pipeline_depth,
             "loads": points, "failover": failover,
             "gateway": counters, "backend_reports": reports,
+            "device_kind": jax.devices()[0].device_kind}
+
+
+def _serve_stack(model_name: str, max_batch: int, max_wait_ms: float,
+                 pipeline_depth: int):
+    """One warmed engine + registry for the HTTP edge benches — built
+    once and shared across server variants so the A/B isolates the
+    front-end, not the compile."""
+    import contextlib
+    import sys
+    import tempfile
+
+    import numpy as np
+
+    from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.registry import ModelRegistry
+
+    registry = ModelRegistry()
+    with tempfile.TemporaryDirectory() as td, \
+            contextlib.redirect_stdout(sys.stderr):
+        # load_checkpoint (not bare load_state): it stamps
+        # params_digest, without which the response cache has no
+        # version identity and stays silently cold.  Its random-init
+        # warning prints to stdout, which must stay JSON-only here.
+        sm = registry.load_checkpoint(model_name, td)
+    img = np.random.RandomState(0).randn(
+        *sm.input_shape).astype(np.float32)
+    body = json.dumps({"pixels": img.tolist()}).encode()
+    eng = BatchingEngine(sm, max_batch=max_batch,
+                         max_wait_ms=max_wait_ms,
+                         pipeline_depth=pipeline_depth).start()
+    eng.warmup()
+    return registry, sm, eng, body
+
+
+def bench_serve_edge(model_name: str = "lenet5",
+                     loads: tuple = (4, 16, 32),
+                     duration_s: float = 2.0, max_batch: int = 8,
+                     max_wait_ms: float = 2.0,
+                     pipeline_depth: int = 2, **_ignored) -> dict:
+    """Edge A/B (``bench.py --serve-edge``): the selector event loop
+    vs the thread-per-request baseline, same engine, real HTTP.
+
+    For each front-end, C closed-loop clients with persistent
+    keep-alive connections sweep the load points (p50/p99, img/s), and
+    a single-threaded churn probe measures requests/s with a FRESH
+    connection per request vs reusing one — the per-connection tax
+    (accept + thread spawn on the baseline; accept only on the edge).
+    The methodology claim (docs/PERF.md): the edge sustains the top
+    load point at equal-or-better p99 without spawning a thread per
+    connection, and its churn overhead is the smaller delta."""
+    import http.client
+    import threading
+
+    import numpy as np
+
+    from deep_vision_tpu.serve.http import ServeServer
+
+    registry, sm, eng, body = _serve_stack(
+        model_name, max_batch, max_wait_ms, pipeline_depth)
+    variants = []
+    try:
+        for edge in (True, False):
+            srv = ServeServer(registry, {sm.name: eng},
+                              port=0, edge=edge).start_background()
+            points = []
+            try:
+                for clients in loads:
+                    latencies: list = []
+                    errors = [0]
+                    lock = threading.Lock()
+                    stop_at = time.perf_counter() + duration_s
+
+                    def client():
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", srv.port, timeout=60)
+                        local, local_err = [], 0
+                        while time.perf_counter() < stop_at:
+                            t0 = time.perf_counter()
+                            try:
+                                conn.request(
+                                    "POST", "/v1/classify", body,
+                                    {"Content-Type":
+                                     "application/json"})
+                                r = conn.getresponse()
+                                r.read()
+                                if r.will_close:
+                                    conn.close()
+                                if r.status != 200:
+                                    local_err += 1
+                                    continue
+                            except (OSError,
+                                    http.client.HTTPException):
+                                conn.close()
+                                local_err += 1
+                                continue
+                            local.append(time.perf_counter() - t0)
+                        conn.close()
+                        with lock:
+                            latencies.extend(local)
+                            errors[0] += local_err
+
+                    threads = [threading.Thread(target=client)
+                               for _ in range(clients)]
+                    t_start = time.perf_counter()
+                    for t in threads:
+                        t.start()
+                    for t in threads:
+                        t.join()
+                    elapsed = time.perf_counter() - t_start
+                    lat = np.asarray(latencies) * 1e3
+                    points.append({
+                        "clients": clients,
+                        "requests": len(latencies),
+                        "errors": errors[0],
+                        "img_per_sec": round(len(lat) / elapsed, 1),
+                        "p50_ms": round(float(np.percentile(lat, 50)),
+                                        2),
+                        "p99_ms": round(float(np.percentile(lat, 99)),
+                                        2)})
+                # churn probe: sequential healthz, fresh vs reused conn
+                churn = {}
+                for mode in ("fresh", "reused"):
+                    conn = None
+                    n = 0
+                    t0 = time.perf_counter()
+                    while time.perf_counter() - t0 < min(duration_s,
+                                                         1.0):
+                        if conn is None or mode == "fresh":
+                            if conn is not None:
+                                conn.close()
+                            conn = http.client.HTTPConnection(
+                                "127.0.0.1", srv.port, timeout=10)
+                        conn.request("GET", "/v1/healthz")
+                        conn.getresponse().read()
+                        n += 1
+                    conn.close()
+                    churn[f"{mode}_req_per_sec"] = round(
+                        n / (time.perf_counter() - t0), 1)
+                churn["overhead_pct"] = round(
+                    (1 - churn["fresh_req_per_sec"]
+                     / churn["reused_req_per_sec"]) * 100, 1)
+                edge_stats = srv.httpd.stats() if edge else None
+            finally:
+                srv.shutdown()
+            variants.append({
+                "front_end": "edge" if edge else "thread",
+                "loads": points, "churn": churn, "edge": edge_stats})
+    finally:
+        eng.stop()
+    top = {v["front_end"]: v["loads"][-1] for v in variants}
+    return {"metric": f"serve_edge_{model_name}_img_per_sec",
+            "value": top["edge"]["img_per_sec"], "unit": "img/s",
+            "model": model_name, "max_batch": max_batch,
+            "max_wait_ms": max_wait_ms, "variants": variants,
+            "top_load": top,
+            "device_kind": jax.devices()[0].device_kind}
+
+
+def bench_serve_trace(model_name: str = "lenet5",
+                      duration_s: float = 4.0, rate: float = 60.0,
+                      dup_frac: float = 0.4, max_batch: int = 8,
+                      max_wait_ms: float = 2.0,
+                      pipeline_depth: int = 2,
+                      cache_mb: float = 64.0, **_ignored) -> dict:
+    """Trace-driven OPEN-LOOP bench (``bench.py --serve-trace``):
+    requests arrive on a generated schedule whether or not earlier ones
+    finished — a diurnal sine envelope over the base ``rate`` with a 4×
+    burst in the middle third, Poisson inter-arrivals throughout.
+
+    ``dup_frac`` of arrivals draw from a small hot payload pool (the
+    content-addressed cache's hit source, ≥30% per the methodology);
+    the rest are unique.  Tenants split premium/standard/best_effort
+    (2:6:2) through ``X-DVT-Tenant`` against a QoS spec whose
+    best-effort knee is lowest.  Latency is measured from SCHEDULED
+    arrival (queueing delay included — the open-loop honesty), per
+    class.  The JSON carries per-class p50/p99 + sheds, the server's
+    cache hit rate, and the edge's connection counters (accepted vs
+    keep-alive reuses = churn avoided)."""
+    import http.client
+    import math
+    import threading
+
+    import numpy as np
+
+    from deep_vision_tpu.serve.admission import TENANT_HEADER, TenantQoS
+    from deep_vision_tpu.serve.cache import ResponseCache
+    from deep_vision_tpu.serve.http import ServeServer
+
+    registry, sm, eng, _ = _serve_stack(
+        model_name, max_batch, max_wait_ms, pipeline_depth)
+    qos = TenantQoS.parse(
+        "premium:rate=0,shed_at=1.0,tenants=tenant-p;"
+        "standard:rate=0,shed_at=0.85;"
+        "best_effort:rate=0,shed_at=0.6,tenants=tenant-b;"
+        "default=standard")
+    srv = ServeServer(
+        registry, {sm.name: eng}, port=0,
+        response_cache=ResponseCache(int(cache_mb * 2**20)),
+        qos=qos).start_background()
+
+    rng = random.Random(0)
+    n_hot = 4  # hot payload pool: what the response cache can reuse
+    pool = []
+    for i in range(n_hot + 1):
+        img = np.random.RandomState(i).randn(
+            *sm.input_shape).astype(np.float32)
+        pool.append(json.dumps({"pixels": img.tolist()}).encode())
+    unique_base = np.random.RandomState(99).randn(
+        *sm.input_shape).astype(np.float32)
+
+    # arrival schedule: diurnal sine envelope + midday burst, Poisson
+    arrivals = []
+    t = 0.0
+    while t < duration_s:
+        envelope = 0.55 + 0.45 * math.sin(
+            2 * math.pi * t / duration_s - math.pi / 2)
+        r = rate * envelope
+        if duration_s / 3 <= t < duration_s * 2 / 3:
+            r *= 4.0  # the burst window
+        t += rng.expovariate(max(r, 1e-3))
+        if t >= duration_s:
+            break
+        tenant = rng.choices(
+            ["tenant-p", "tenant-s", "tenant-b"],
+            weights=(2, 6, 2))[0]
+        if rng.random() < dup_frac:
+            body = pool[rng.randrange(n_hot)]
+        else:
+            # unique payload: mutate one pixel deterministically
+            u = unique_base.copy()
+            u.flat[len(arrivals) % u.size] += len(arrivals) + 1
+            body = json.dumps({"pixels": u.tolist()}).encode()
+        arrivals.append((t, tenant, body))
+
+    results: dict = {c: {"lat": [], "shed": 0, "errors": 0}
+                     for c in ("premium", "standard", "best_effort")}
+    cls_of = {"tenant-p": "premium", "tenant-s": "standard",
+              "tenant-b": "best_effort"}
+    lock = threading.Lock()
+    conns = threading.local()
+
+    # service latency (send → response, excluding open-loop queueing)
+    # split by the X-DVT-Cache header: the hit-vs-compute comparison
+    hit_svc: list = []
+    miss_svc: list = []
+
+    def fire(t_sched, tenant, body, t_base):
+        try:
+            conn = getattr(conns, "c", None)
+            if conn is None:
+                conn = conns.c = http.client.HTTPConnection(
+                    "127.0.0.1", srv.port, timeout=60)
+            t_send = time.perf_counter()
+            try:
+                conn.request("POST", "/v1/classify", body,
+                             {"Content-Type": "application/json",
+                              TENANT_HEADER: tenant})
+                r = conn.getresponse()
+                r.read()
+                if r.will_close:
+                    conn.close()
+                    conns.c = None
+            except (OSError, http.client.HTTPException):
+                conn.close()
+                conns.c = None
+                raise
+            done = time.perf_counter()
+            with lock:
+                row = results[cls_of[tenant]]
+                if r.status == 200:
+                    row["lat"].append(done - t_base - t_sched)
+                    if r.headers.get("X-DVT-Cache") == "hit":
+                        hit_svc.append(done - t_send)
+                    else:
+                        miss_svc.append(done - t_send)
+                elif r.status == 429:
+                    row["shed"] += 1
+                else:
+                    row["errors"] += 1
+        except Exception:  # noqa: BLE001 — open loop: count, continue
+            with lock:
+                results[cls_of[tenant]]["errors"] += 1
+
+    from concurrent.futures import ThreadPoolExecutor
+
+    futures = []
+    try:
+        with ThreadPoolExecutor(max_workers=64) as pool_exec:
+            t_base = time.perf_counter()
+            for t_sched, tenant, body in arrivals:
+                delay = t_sched - (time.perf_counter() - t_base)
+                if delay > 0:
+                    time.sleep(delay)
+                futures.append(pool_exec.submit(
+                    fire, t_sched, tenant, body, t_base))
+            for f in futures:
+                f.result()
+        import urllib.request
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/stats",
+                timeout=10) as r:
+            stats = json.loads(r.read())
+    finally:
+        srv.shutdown()
+        eng.stop()
+    classes = {}
+    for name, row in results.items():
+        lat = np.asarray(row["lat"]) * 1e3
+        classes[name] = {
+            "served": len(lat), "shed": row["shed"],
+            "errors": row["errors"],
+            "p50_ms": round(float(np.percentile(lat, 50)), 2)
+            if len(lat) else None,
+            "p99_ms": round(float(np.percentile(lat, 99)), 2)
+            if len(lat) else None}
+    edge_stats = stats.get("edge", {})
+    cache_stats = stats.get("response_cache", {})
+
+    def _svc(vals):
+        a = np.asarray(vals) * 1e3
+        return {"count": len(a),
+                "p50_ms": round(float(np.percentile(a, 50)), 2)
+                if len(a) else None,
+                "p99_ms": round(float(np.percentile(a, 99)), 2)
+                if len(a) else None}
+
+    return {"metric": f"serve_trace_{model_name}_cache_hit_rate",
+            "value": round(cache_stats.get("hit_rate", 0.0), 3),
+            "unit": "hit_rate", "model": model_name,
+            "offered": len(arrivals), "rate": rate,
+            "dup_frac": dup_frac, "duration_s": duration_s,
+            "classes": classes,
+            "service": {"cache_hit": _svc(hit_svc),
+                        "compute": _svc(miss_svc)},
+            "cache": cache_stats,
+            "edge": {k: edge_stats.get(k) for k in
+                     ("accepted", "keepalive_reuses", "requests",
+                      "open_connections")},
+            "qos": stats.get("qos", {}),
             "device_kind": jax.devices()[0].device_kind}
 
 
@@ -1798,6 +2148,25 @@ def main():
     p.add_argument("--zipf-s", type=float, default=1.1,
                    help="Zipf exponent for --serve-mix model "
                         "popularity (higher = hotter head)")
+    p.add_argument("--serve-edge", action="store_true",
+                   help="HTTP front-end A/B: selector event loop "
+                        "(keep-alive + pipelining + bounded conns) vs "
+                        "thread-per-request baseline on one shared "
+                        "engine, plus a fresh-vs-reused connection "
+                        "churn probe per variant (docs/PERF.md)")
+    p.add_argument("--serve-trace", action="store_true",
+                   help="trace-driven OPEN-LOOP bench: diurnal+burst "
+                        "Poisson arrivals, duplicate-heavy payload "
+                        "pool against the response cache, tenant mix "
+                        "against QoS classes; per-class p50/p99 from "
+                        "scheduled arrival + cache hit rate + edge "
+                        "connection churn (docs/PERF.md)")
+    p.add_argument("--trace-rate", type=float, default=60.0,
+                   help="base arrival rate (req/s) for --serve-trace "
+                        "before the diurnal envelope and burst apply")
+    p.add_argument("--trace-dup-frac", type=float, default=0.4,
+                   help="fraction of --serve-trace arrivals drawn from "
+                        "the hot payload pool (the cache-hit source)")
     p.add_argument("--gateway", action="store_true",
                    help="gateway failover bench: backend serve stacks "
                         "behind serve/gateway.py, HTTP clients through "
@@ -1879,6 +2248,20 @@ def main():
         print(json.dumps(bench_deploy(
             model_name=args.serve_model,
             watch_interval_s=args.watch_interval_s)))
+        return
+    if args.serve_edge:
+        print(json.dumps(bench_serve_edge(
+            model_name=args.serve_model,
+            loads=tuple(int(c) for c in args.serve_loads.split(",")),
+            duration_s=args.serve_duration, max_batch=args.batch or 8,
+            pipeline_depth=args.serve_pipeline_depth)))
+        return
+    if args.serve_trace:
+        print(json.dumps(bench_serve_trace(
+            model_name=args.serve_model,
+            duration_s=args.serve_duration, rate=args.trace_rate,
+            dup_frac=args.trace_dup_frac, max_batch=args.batch or 8,
+            pipeline_depth=args.serve_pipeline_depth)))
         return
     if args.gateway:
         print(json.dumps(bench_gateway(

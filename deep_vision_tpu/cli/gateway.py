@@ -50,7 +50,8 @@ def build_gateway(args):
         degraded_after=getattr(args, "degraded_after", 1),
         dead_after=getattr(args, "dead_after", 5),
         hedge=getattr(args, "hedge", False),
-        hedge_after_ms=getattr(args, "hedge_after_ms", None))
+        hedge_after_ms=getattr(args, "hedge_after_ms", None),
+        affinity=getattr(args, "affinity", False))
     gw.start()
     socket_timeout_s = getattr(args, "socket_timeout_s", 30.0)
     server = GatewayServer(
@@ -58,7 +59,10 @@ def build_gateway(args):
         verbose=getattr(args, "verbose", False),
         max_body_bytes=int(getattr(args, "max_body_mb", 32) * 2**20),
         socket_timeout_s=socket_timeout_s if socket_timeout_s > 0
-        else None)
+        else None,
+        edge=not getattr(args, "thread_server", False),
+        max_connections=int(getattr(args, "max_connections", 1024)),
+        http_workers=int(getattr(args, "http_workers", 8)))
     return gw, server
 
 
@@ -104,6 +108,19 @@ def main(argv=None):
                         "gateway's observed p99; first answer wins")
     p.add_argument("--hedge-after-ms", type=float, default=None,
                    help="fixed hedge delay instead of the learned p99")
+    p.add_argument("--affinity", action="store_true",
+                   help="rendezvous-hash backend choice on the payload "
+                        "digest: identical payloads land on the same "
+                        "healthy backend, maximizing its response-cache "
+                        "hit rate; failover falls to the next-highest "
+                        "hash")
+    p.add_argument("--thread-server", action="store_true",
+                   help="serve clients with the thread-per-request "
+                        "baseline instead of the selector event loop")
+    p.add_argument("--max-connections", type=int, default=1024,
+                   help="edge loop: open client-connection ceiling")
+    p.add_argument("--http-workers", type=int, default=8,
+                   help="edge loop: worker threads forwarding requests")
     p.add_argument("--max-body-mb", type=float, default=32.0)
     p.add_argument("--socket-timeout-s", type=float, default=30.0,
                    help="per-connection client socket timeout (0 "

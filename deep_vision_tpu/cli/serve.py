@@ -53,6 +53,28 @@ from __future__ import annotations
 import argparse
 
 
+def _edge_kwargs(args):
+    """Shared ServeServer edge wiring for both build paths.
+
+    The selector event loop is the default front-end; --thread-server
+    restores the thread-per-request baseline (the A/B foil in
+    docs/PERF.md).  The response cache and tenant QoS stay OFF unless
+    asked for, so single-purpose smokes keep their exact span/counter
+    expectations."""
+    from deep_vision_tpu.serve.admission import TenantQoS
+    from deep_vision_tpu.serve.cache import ResponseCache
+
+    cache_mb = float(getattr(args, "response_cache_mb", 0.0) or 0.0)
+    qos_spec = getattr(args, "qos", None)
+    return dict(
+        edge=not getattr(args, "thread_server", False),
+        max_connections=int(getattr(args, "max_connections", 1024)),
+        http_workers=int(getattr(args, "http_workers", 8)),
+        response_cache=ResponseCache(int(cache_mb * 2**20))
+        if cache_mb > 0 else None,
+        qos=TenantQoS.parse(qos_spec) if qos_spec else None)
+
+
 def build_server(args):
     """argparse namespace → (engine, ServeServer); shared with the smoke
     test so `make serve-smoke` boots exactly the production wiring.
@@ -168,7 +190,7 @@ def build_server(args):
         max_body_bytes=int(getattr(args, "max_body_mb", 32) * 2**20),
         socket_timeout_s=socket_timeout_s if socket_timeout_s > 0
         else None,
-        tracer=tracer)
+        tracer=tracer, **_edge_kwargs(args))
     return engine, server
 
 
@@ -342,7 +364,8 @@ def _build_plane_server(args, registry, wire_dtype: str,
         max_body_bytes=int(getattr(args, "max_body_mb", 32) * 2**20),
         socket_timeout_s=socket_timeout_s if socket_timeout_s > 0
         else None,
-        tracer=tracer, plane=plane, deploy=pipeline)
+        tracer=tracer, plane=plane, deploy=pipeline,
+        **_edge_kwargs(args))
     return plane, server
 
 
@@ -520,6 +543,36 @@ def main(argv=None):
                         "client (slow-loris) is closed / answered 408 "
                         "instead of pinning a handler thread; 0 "
                         "disables")
+    # -- async edge (docs/SERVING.md "Async edge, response cache &
+    #    tenant QoS") --
+    p.add_argument("--thread-server", action="store_true",
+                   help="serve with the original thread-per-request "
+                        "ThreadingHTTPServer instead of the selector "
+                        "event loop (the A/B baseline in docs/PERF.md; "
+                        "no keep-alive pooling, no connection bound)")
+    p.add_argument("--max-connections", type=int, default=1024,
+                   help="edge loop: open-connection ceiling — at "
+                        "capacity the oldest fully-idle keep-alive "
+                        "connection is evicted, else accepting pauses "
+                        "until a slot frees")
+    p.add_argument("--http-workers", type=int, default=8,
+                   help="edge loop: worker threads running handler "
+                        "logic off the event loop")
+    p.add_argument("--response-cache-mb", type=float, default=0.0,
+                   help="content-addressed response cache budget: "
+                        "identical payloads to the same model VERSION "
+                        "(wire/infer dtype included in the key) answer "
+                        "from memory; promote/rollback changes the "
+                        "version digest so stale hits are impossible "
+                        "(0 = off)")
+    p.add_argument("--qos", default=None,
+                   help="per-tenant QoS spec, e.g. 'premium:rate=0,"
+                        "shed_at=1.0;standard:rate=200,burst=50,"
+                        "shed_at=0.8,tenants=acme|globex;default="
+                        "standard' — X-DVT-Tenant maps tenants to "
+                        "classes with token-bucket quotas and "
+                        "pressure-weighted shedding (docs/SERVING.md; "
+                        "empty = off)")
     # -- observability (docs/OBSERVABILITY.md) --
     p.add_argument("--log-level", default="info",
                    choices=("debug", "info", "warning", "error"),

@@ -44,7 +44,7 @@ class Shed:
     retry can't make a deadline the first attempt already missed), nor
     do shutdown sheds (this replica is going away)."""
 
-    reason: str          # "queue_full" | "deadline" | "shutdown"
+    reason: str   # "queue_full" | "deadline" | "shutdown" | "quota" | "priority"
     detail: str = ""
     retry_after_s: float | None = None
 
@@ -225,3 +225,166 @@ class AdmissionController:
         if self.name is not None:
             out["name"] = self.name
         return out
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant QoS: priority classes, token-bucket quotas, weighted shedding
+# ---------------------------------------------------------------------------
+
+TENANT_HEADER = "X-DVT-Tenant"
+
+DEFAULT_QOS_SPEC = ("premium:rate=0,shed_at=1.0;"
+                    "standard:rate=200,burst=50,shed_at=0.8;"
+                    "best_effort:rate=50,burst=10,shed_at=0.5;"
+                    "default=standard")
+
+
+@dataclasses.dataclass
+class QoSClass:
+    """One priority class.
+
+    ``rate``/``burst`` parameterize each member tenant's token bucket
+    (requests/second sustained, requests of headroom); ``rate=0`` means
+    unmetered.  ``shed_at`` is the weighted-shedding knee: the fraction
+    of engine queue capacity beyond which this class's cache-missing
+    requests are shed pre-engine, so under pressure best-effort
+    (shed_at 0.5) absorbs the 429s half a queue before premium
+    (shed_at 1.0) loses anything."""
+
+    name: str
+    rate: float = 0.0
+    burst: float = 1.0
+    shed_at: float = 1.0
+    tenants: tuple = ()
+
+
+class TenantQoS:
+    """Maps the ``X-DVT-Tenant`` header to a priority class and applies
+    two independent controls at the edge:
+
+      quota     a per-tenant token bucket (class rate/burst), checked
+                BEFORE the response cache — a tenant over quota is 429'd
+                even for cached answers, otherwise a hot payload would
+                make quotas unenforceable.
+      priority  deterministic weighted shedding on engine queue
+                pressure, checked only on a cache MISS just before the
+                engine — pressure = queue_depth / max_queue, and a class
+                is shed when pressure ≥ its ``shed_at``.  Cache hits
+                bypass this (they cost no engine capacity).
+
+    Spec grammar (``--qos``):
+        ``premium:rate=0,shed_at=1.0,tenants=acme|bigco;``
+        ``best_effort:rate=20,burst=5,shed_at=0.5;default=best_effort``
+    ``tenants=`` pins named tenants to a class; everything else lands in
+    the ``default=`` class (first class declared if omitted)."""
+
+    def __init__(self, classes: list, default: str):
+        if not classes:
+            raise ValueError("QoS spec declares no classes")
+        self.classes = {c.name: c for c in classes}
+        if default not in self.classes:
+            raise ValueError(f"QoS default class {default!r} not declared")
+        self.default = default
+        self._tenant_class = {t: c.name for c in classes
+                              for t in c.tenants}
+        self._lock = new_lock("serve.admission.TenantQoS._lock")
+        # tenant → [tokens, last_refill_monotonic]  guarded-by: _lock
+        self._buckets: dict[str, list] = {}
+        # class → counters/histogram  guarded-by: _lock
+        self._served = {c.name: 0 for c in classes}
+        self._shed_quota = {c.name: 0 for c in classes}
+        self._shed_priority = {c.name: 0 for c in classes}
+        self._cache_hits = {c.name: 0 for c in classes}
+        from deep_vision_tpu.core.metrics import LatencyHistogram
+        self._latency = {c.name: LatencyHistogram() for c in classes}
+
+    @classmethod
+    def parse(cls, spec: str) -> "TenantQoS":
+        classes, default = [], None
+        for part in filter(None, (p.strip() for p in spec.split(";"))):
+            if part.startswith("default="):
+                default = part[len("default="):].strip()
+                continue
+            name, _, opts = part.partition(":")
+            kw: dict = {"name": name.strip()}
+            for opt in filter(None, (o.strip() for o in opts.split(","))):
+                k, _, v = opt.partition("=")
+                k = k.strip()
+                if k == "tenants":
+                    kw["tenants"] = tuple(
+                        t for t in v.strip().split("|") if t)
+                elif k in ("rate", "burst", "shed_at"):
+                    kw[k] = float(v)
+                else:
+                    raise ValueError(f"unknown QoS option {k!r} in "
+                                     f"{part!r}")
+            classes.append(QoSClass(**kw))
+        return cls(classes, default or (classes[0].name if classes
+                                        else ""))
+
+    def class_of(self, tenant: str) -> QoSClass:
+        return self.classes[self._tenant_class.get(tenant, self.default)]
+
+    def check_quota(self, tenant: str,
+                    now: float | None = None) -> Shed | None:
+        """Token-bucket admission for one request from ``tenant``.
+        None = within quota (one token consumed)."""
+        cls = self.class_of(tenant)
+        if cls.rate <= 0:
+            return None  # unmetered class
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            bucket = self._buckets.get(tenant)
+            if bucket is None:
+                bucket = [cls.burst, now]
+                self._buckets[tenant] = bucket
+            tokens = min(cls.burst,
+                         bucket[0] + cls.rate * (now - bucket[1]))
+            bucket[1] = now
+            if tokens >= 1.0:
+                bucket[0] = tokens - 1.0
+                return None
+            bucket[0] = tokens
+            self._shed_quota[cls.name] += 1
+            wait_s = (1.0 - tokens) / cls.rate
+        return Shed("quota",
+                    f"tenant {tenant!r} ({cls.name}) over "
+                    f"{cls.rate:g} req/s quota",
+                    retry_after_s=wait_s)
+
+    def check_pressure(self, tenant: str, queue_depth: int,
+                       max_queue: int) -> Shed | None:
+        """Weighted shedding on a cache miss: shed this class once
+        engine queue pressure crosses its knee."""
+        cls = self.class_of(tenant)
+        pressure = queue_depth / max_queue if max_queue > 0 else 0.0
+        if pressure < cls.shed_at:
+            return None
+        with self._lock:
+            self._shed_priority[cls.name] += 1
+        return Shed("priority",
+                    f"{cls.name} sheds at {cls.shed_at:g} queue "
+                    f"pressure (now {pressure:.2f})",
+                    retry_after_s=1.0)
+
+    def record_served(self, tenant: str, seconds: float,
+                      cache_hit: bool = False):
+        cls = self.class_of(tenant)
+        with self._lock:
+            self._served[cls.name] += 1
+            if cache_hit:
+                self._cache_hits[cls.name] += 1
+            self._latency[cls.name].record(seconds)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {name: {
+                        "rate": c.rate, "burst": c.burst,
+                        "shed_at": c.shed_at,
+                        "served": self._served[name],
+                        "shed_quota": self._shed_quota[name],
+                        "shed_priority": self._shed_priority[name],
+                        "cache_hits": self._cache_hits[name],
+                        "latency": self._latency[name].percentiles(),
+                        "default": name == self.default}
+                    for name, c in self.classes.items()}

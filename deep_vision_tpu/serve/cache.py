@@ -1,0 +1,113 @@
+"""Content-addressed response cache for the serving edge.
+
+Consumer image traffic is heavy-tailed: a popular image is classified
+thousands of times, and every repeat burns a full engine pass for an
+answer that is a pure function of (weights, dtypes, payload).  The
+cache exploits exactly that purity — the key is
+
+    (model name, active-version params digest, wire dtype,
+     infer dtype, blake2b(payload bytes))
+
+so a hit is byte-identical to what the engine would recompute, and
+promote / rollback / revert / hot-reload invalidate automatically:
+swapping the active version changes ``params_digest`` and every old
+key simply stops matching (stale entries age out through the LRU, no
+flush coordination with the control plane).
+
+What is deliberately NOT cached:
+  * shed (429) and quarantine/error (5xx) responses — transient
+    verdicts must be re-evaluated per request;
+  * debug-trace responses — the attached span is per-request;
+  * models without a ``params_digest`` (raw exported blobs) — no
+    version identity means no safe invalidation.
+
+The store is a byte-bounded LRU (``OrderedDict`` under one leaf lock);
+lookups and inserts are O(1) and the value is the already-serialized
+JSON body, so a hit skips decode, engine, and re-serialization in one
+step.  Payload digesting reuses the blake2b shape of
+``core/restore.py``'s ``params_digest`` (hex, 8-byte digest) so the
+two digest namespaces read the same in traces and stats.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from collections import OrderedDict
+
+from deep_vision_tpu.analysis.sanitizer import new_lock
+
+DEFAULT_CACHE_BYTES = 64 * 2**20
+
+
+def payload_digest(body: bytes) -> str:  # dvtlint: hot
+    """blake2b hex digest of the raw request payload bytes — the
+    content address.  Same digest family/size as
+    ``core.restore.params_digest`` so digests are uniform repo-wide."""
+    return hashlib.blake2b(body, digest_size=8).hexdigest()
+
+
+class ResponseCache:
+    """Byte-bounded LRU of serialized 200-responses."""
+
+    def __init__(self, max_bytes: int = DEFAULT_CACHE_BYTES):
+        self.max_bytes = max(0, int(max_bytes))
+        self._lock = new_lock("serve.cache.ResponseCache._lock")
+        # guarded-by: _lock
+        self._store: OrderedDict[tuple, bytes] = OrderedDict()
+        self._bytes = 0       # guarded-by: _lock
+        self.hits = 0         # guarded-by: _lock
+        self.misses = 0       # guarded-by: _lock
+        self.evictions = 0    # guarded-by: _lock
+        self.insertions = 0   # guarded-by: _lock
+
+    @staticmethod
+    def key(route: str, model: str, version_digest: str,
+            wire_dtype: str, infer_dtype: str,
+            body_digest: str) -> tuple:
+        """``route`` keeps /v1/classify and /v1/detect answers for the
+        same payload from aliasing each other."""
+        return (route, model, version_digest, wire_dtype, infer_dtype,
+                body_digest)
+
+    def get(self, key: tuple) -> bytes | None:  # dvtlint: hot
+        with self._lock:
+            blob = self._store.get(key)
+            if blob is None:
+                self.misses += 1
+                return None
+            self._store.move_to_end(key)
+            self.hits += 1
+            return blob
+
+    def put(self, key: tuple, blob: bytes):  # dvtlint: hot
+        size = len(blob)
+        if size > self.max_bytes:
+            return  # larger than the whole budget: not cacheable
+        with self._lock:
+            old = self._store.pop(key, None)
+            if old is not None:
+                self._bytes -= len(old)
+            self._store[key] = blob
+            self._bytes += size
+            self.insertions += 1
+            while self._bytes > self.max_bytes:
+                _, victim = self._store.popitem(last=False)
+                self._bytes -= len(victim)
+                self.evictions += 1
+
+    def clear(self):
+        with self._lock:
+            self._store.clear()
+            self._bytes = 0
+
+    def stats(self) -> dict:
+        with self._lock:
+            lookups = self.hits + self.misses
+            return {"entries": len(self._store),
+                    "bytes": self._bytes,
+                    "max_bytes": self.max_bytes,
+                    "hits": self.hits,
+                    "misses": self.misses,
+                    "hit_rate": self.hits / lookups if lookups else 0.0,
+                    "evictions": self.evictions,
+                    "insertions": self.insertions}

@@ -999,6 +999,12 @@ class BatchingEngine:
             rep["faults"] = self.faults.stats()
         return rep
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests awaiting batch formation right now — the edge QoS
+        pressure signal (``Queue.qsize`` is already thread-safe)."""
+        return self._queue.qsize()
+
     def stats(self) -> dict:
         with self._lock:
             span = None

@@ -58,11 +58,20 @@ backend's ``trace`` and the gateway-side ``gateway_trace`` breakdown.
 point: ``python -m deep_vision_tpu.cli.gateway``; chaos suite:
 ``tests/test_gateway.py`` (marker ``gateway``); end-to-end smoke with a
 real SIGKILL mid-load: ``make gateway-smoke``.  Zero new dependencies:
-stdlib ``http.client`` out, ``http.server`` in.
+stdlib ``http.client`` out, the ``serve/edge.py`` selector loop in
+(``ThreadingHTTPServer`` behind ``edge=False``).
+
+Forwarding rides per-backend keep-alive connection POOLS with
+retry-on-stale (an error on a reused socket drops the pool and retries
+once fresh; an error on a fresh socket is a real backend failure), and
+``affinity=True`` switches routing to rendezvous hashing on the
+payload digest so repeats of one payload land where the backend's
+response cache already holds the answer.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import math
 import random
@@ -82,6 +91,7 @@ from deep_vision_tpu.obs.trace import (
     Tracer,
     new_request_id,
 )
+from deep_vision_tpu.serve.edge import DEFAULT_MAX_CONNECTIONS, EdgeServer
 from deep_vision_tpu.serve.health import DEAD, DEGRADED, OK
 
 _log = get_logger("dvt.serve.gateway")
@@ -92,7 +102,7 @@ HALF_OPEN = "half_open"
 
 # retry-able HTTP verdicts vs. final ones: anything below 500 except a
 # 429 means the backend is alive and answered THIS request definitively
-_PROXY_HEADERS = ("Content-Type", "Retry-After")
+_PROXY_HEADERS = ("Content-Type", "Retry-After", "X-DVT-Cache")
 
 
 class Backend:
@@ -144,6 +154,62 @@ class Backend:
         # payload); empty until the first 200 probe — an empty list
         # routes everything, so a pre-probe gateway still forwards
         self.models: list[str] = []  # guarded-by: _lock
+        # keep-alive connection pool for forwarding: connections check
+        # out per exchange and return unless the response closed them.
+        # Its own leaf lock — pool operations never nest under _lock.
+        self._conn_lock = new_lock("serve.gateway.Backend._conn_lock")
+        self._conns: list[HTTPConnection] = []  # guarded-by: _conn_lock
+        self.conns_created = 0  # guarded-by: _conn_lock
+        self.conns_reused = 0  # guarded-by: _conn_lock
+
+    # -- keep-alive connection pool ----------------------------------------
+
+    def acquire_conn(self, timeout: float,
+                     fresh: bool = False) -> tuple[HTTPConnection, bool]:
+        """Check out a connection: ``(conn, reused)``.  ``fresh=True``
+        bypasses the pool — the retry-on-stale second attempt must not
+        draw another possibly-stale keep-alive socket."""
+        conn = None
+        if not fresh:
+            with self._conn_lock:
+                if self._conns:
+                    conn = self._conns.pop()
+                    self.conns_reused += 1
+        if conn is None:
+            conn = HTTPConnection(self.host, self.port, timeout=timeout)
+            with self._conn_lock:
+                self.conns_created += 1
+            return conn, False
+        if conn.sock is not None:
+            # per-use deadline: probes (1 s) and requests (30 s) share
+            # the pool, so the timeout rides the checkout, not the conn
+            conn.sock.settimeout(timeout)
+        return conn, True
+
+    def release_conn(self, conn: HTTPConnection):
+        with self._conn_lock:
+            if len(self._conns) < 8:
+                self._conns.append(conn)
+                return
+        conn.close()
+
+    def discard_conn(self, conn: HTTPConnection):
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def close_conns(self):
+        """Drop every pooled connection — on gateway stop, and when a
+        stale keep-alive surfaces (a restarted backend invalidates the
+        WHOLE pool, not just the socket that noticed)."""
+        with self._conn_lock:
+            conns, self._conns = self._conns, []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     # -- routing gate ------------------------------------------------------
 
@@ -276,8 +342,13 @@ class Backend:
 
     def report(self, now: float | None = None) -> dict:
         now = time.monotonic() if now is None else now
+        with self._conn_lock:
+            conns = {"pooled": len(self._conns),
+                     "created": self.conns_created,
+                     "reused": self.conns_reused}
         with self._lock:
             return {
+                "conns": conns,
                 "url": f"http://{self.name}",
                 "state": self.state,
                 "breaker": self.breaker,
@@ -333,6 +404,7 @@ class Gateway:
                  hedge: bool = False,
                  hedge_after_ms: float | None = None,
                  hedge_min_history: int = 32,
+                 affinity: bool = False,
                  tracer: Tracer | None = None):
         if not backends:
             raise ValueError("gateway needs at least one backend")
@@ -353,6 +425,11 @@ class Gateway:
         self.hedge = hedge
         self.hedge_after_ms = hedge_after_ms
         self.hedge_min_history = hedge_min_history
+        # payload-digest consistent hashing (rendezvous): repeats of
+        # one payload land on one backend so ITS response cache hits,
+        # instead of spreading a hot image's repeats across N cold
+        # caches.  Opt-in: load-based routing stays the default.
+        self.affinity = affinity
         self.tracer = tracer or Tracer()
         self.latency = LatencyHistogram()
         self._lock = new_lock("serve.gateway.Gateway._lock")
@@ -389,6 +466,8 @@ class Gateway:
             pool, self._pool = self._pool, None
         if pool is not None:
             pool.shutdown(wait=False)
+        for b in self.backends:
+            b.close_conns()
 
     def __enter__(self):
         return self.start()
@@ -409,8 +488,13 @@ class Gateway:
             now = time.monotonic()
             try:
                 status, _, payload = self._call(
-                    b, "GET", "/v1/healthz", None, self.probe_timeout_s)
+                    b, "GET", "/v1/healthz", None, self.probe_timeout_s,
+                    pooled=False)
             except (OSError, HTTPException) as e:
+                # the listener is gone: every pooled keep-alive socket
+                # to it is now a liability — drop them so requests
+                # can't ride a half-dead backend past its breaker
+                b.close_conns()
                 b.probe_failure(f"probe: {type(e).__name__}: {e}", now)
                 continue
             if status == 200:
@@ -485,6 +569,11 @@ class Gateway:
                  ) -> tuple[int, dict, bytes]:
         t0 = time.monotonic()
         model = self._path_model(path)
+        # rendezvous affinity key: the payload digest, hashed once per
+        # request (retries reuse it — failover is just the next-highest
+        # backend in the same hash ranking)
+        akey = hashlib.blake2b(body, digest_size=8).digest() \
+            if self.affinity and body else None
         with self._lock:
             self.proxied += 1
         tried: list[Backend] = []
@@ -492,13 +581,13 @@ class Gateway:
         last_fail: _Outcome | None = None
         prev: Backend | None = None
         for attempt in range(1 + self.retry_budget):
-            b = self._pick(tried, model)
+            b = self._pick(tried, model, akey)
             if b is None and tried:
                 # every routable backend failed this request once —
                 # clear the exclusions so the backoff'd retry may
                 # revisit (a transient blip shouldn't 502 the client)
                 tried = []
-                b = self._pick(tried, model)
+                b = self._pick(tried, model, akey)
             if b is None:
                 break
             if attempt > 0:
@@ -534,7 +623,7 @@ class Gateway:
                 last_shed = out
                 if span is not None:
                     span.note("shed", out.backend.name)
-                if self._pick(tried, model) is None:
+                if self._pick(tried, model, akey) is None:
                     break  # nobody with headroom: propagate the 429
             else:
                 last_fail = out
@@ -564,14 +653,23 @@ class Gateway:
         return {k: out.headers[k] for k in _PROXY_HEADERS
                 if k in out.headers}
 
-    def _pick(self, exclude: list,
-              model: str | None = None) -> Backend | None:  # dvtlint: hot
+    def _pick(self, exclude: list, model: str | None = None,
+              affinity_key: bytes | None = None
+              ) -> Backend | None:  # dvtlint: hot
         """Least outstanding work (outstanding × latency EWMA) over
         routable backends, scanning from a rotating offset with strict
         less-than — an idle fleet round-robins instead of piling onto
         backend 0 (same policy as serve/replicas.py).  ``model``
         (from a /v1/models/<name>/... path) filters to backends whose
-        probed model list serves it."""
+        probed model list serves it.
+
+        With an ``affinity_key`` (the payload digest, when
+        ``affinity=True``), routing switches to rendezvous hashing:
+        every candidate scores ``blake2b(key | backend-name)`` and the
+        highest wins — repeats of one payload deterministically land on
+        one backend (its response cache hits), a dead/excluded backend
+        just drops out of the candidate set (only ITS keys move), and
+        failover falls through to the next-highest hash."""
         now = time.monotonic()
         n = len(self.backends)
         with self._lock:
@@ -583,7 +681,13 @@ class Gateway:
             if b in exclude or not b.routable(now) \
                     or not b.serves(model):
                 continue
-            score = b.score()
+            if affinity_key is not None:
+                # highest-random-weight: bigger hash wins
+                score = -int.from_bytes(hashlib.blake2b(
+                    affinity_key + b.name.encode(),
+                    digest_size=8).digest(), "big")
+            else:
+                score = b.score()
             if best_score is None or score < best_score:
                 best, best_score = b, score
         return best
@@ -682,23 +786,55 @@ class Gateway:
 
     @staticmethod
     def _call(b: Backend, method: str, path: str, body: bytes | None,
-              timeout: float, extra_headers: dict | None = None
-              ) -> tuple[int, dict, bytes]:
-        """One HTTP exchange with a backend.  A fresh connection per
-        call: the failure modes we must detect (SIGKILL'd process, TCP
-        reset) surface as plain connect/read errors, never as a stale
-        keep-alive edge case."""
-        conn = HTTPConnection(b.host, b.port, timeout=timeout)
-        try:
-            headers = {"Content-Type": "application/json"} if body \
-                else {}
-            if extra_headers:
-                headers.update(extra_headers)
-            conn.request(method, path, body=body, headers=headers)
-            resp = conn.getresponse()
-            return resp.status, dict(resp.getheaders()), resp.read()
-        finally:
-            conn.close()
+              timeout: float, extra_headers: dict | None = None,
+              pooled: bool = True) -> tuple[int, dict, bytes]:  # dvtlint: hot
+        """One HTTP exchange over the backend's keep-alive pool.
+
+        A REUSED connection can die for a reason that says nothing
+        about the backend — it closed the idle socket between our
+        requests — so an error on a reused connection discards the
+        whole pool (a restarted backend invalidates every pooled
+        socket) and retries ONCE on a fresh connection.  An error on a
+        FRESH connection is the real thing (SIGKILL'd process, TCP
+        reset) and propagates — failure detection stays exactly as
+        sharp as the old connection-per-call scheme.  Retrying the
+        exchange is safe even for POSTs: a stale keep-alive fails at
+        send time, before the backend saw the request.
+
+        ``pooled=False`` forces a fresh dial-and-close exchange —
+        health probes use it, because a probe's whole job is proving
+        the backend still ACCEPTS connections; probing over a pooled
+        socket would let an established keep-alive mask a backend
+        whose listener is gone."""
+        headers = {"Content-Type": "application/json"} if body else {}
+        if extra_headers:
+            headers.update(extra_headers)
+        if not pooled:
+            conn = HTTPConnection(b.host, b.port, timeout=timeout)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                return resp.status, dict(resp.getheaders()), resp.read()
+            finally:
+                conn.close()
+        for attempt in (0, 1):
+            conn, reused = b.acquire_conn(timeout, fresh=attempt > 0)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
+                payload = resp.read()
+            except (OSError, HTTPException):
+                b.discard_conn(conn)
+                if reused:
+                    b.close_conns()
+                    continue  # stale keep-alive: one fresh retry
+                raise
+            if resp.will_close:
+                b.discard_conn(conn)
+            else:
+                b.release_conn(conn)
+            return resp.status, dict(resp.getheaders()), payload
+        raise HTTPException(f"{b.name}: unreachable retry state")
 
     # -- observability -----------------------------------------------------
 
@@ -840,17 +976,28 @@ class Gateway:
         return merged, mfu, per_model
 
 
-def render_gateway_metrics(gw: Gateway) -> str:
+def render_gateway_metrics(gw: Gateway, edge: dict | None = None) -> str:
     """Prometheus text for ``GET /metrics`` on the gateway: its own
     counters + per-backend breaker/load gauges + its request-latency
     histogram, plus the fleet aggregates (merged backend latency
     distribution and ``dvt_gateway_serving_mfu``) fetched from backend
-    /v1/stats — one scrape sees the whole serving tier."""
+    /v1/stats — one scrape sees the whole serving tier.  ``edge`` (the
+    front-end EdgeServer's ``stats()``) adds the connection gauges."""
     from deep_vision_tpu.core.metrics import PromText
 
     s = gw.stats()
     g = s["gateway"]
     p = PromText()
+    if isinstance(edge, dict):
+        p.gauge("dvt_gateway_open_connections",
+                edge.get("open_connections"),
+                help="Client sockets open on the gateway edge")
+        p.counter("dvt_gateway_edge_keepalive_reuses_total",
+                  edge.get("keepalive_reuses"),
+                  help="Client requests after the first per connection")
+        p.counter("dvt_gateway_edge_accepted_total",
+                  edge.get("accepted"),
+                  help="Client connections accepted")
     p.counter("dvt_gateway_proxied_total", g["proxied"],
               help="Inference requests entering forward()")
     p.counter("dvt_gateway_retries_total", g["retries"],
@@ -890,6 +1037,13 @@ def render_gateway_metrics(gw: Gateway) -> str:
         p.gauge("dvt_gateway_backend_ewma_seconds",
                 r["ewma_ms"] / 1e3 if r["ewma_ms"] is not None
                 else None, lab, help="Per-backend latency EWMA")
+        conns = r.get("conns") or {}
+        p.counter("dvt_gateway_backend_conns_created_total",
+                  conns.get("created"), lab,
+                  help="Backend connections dialed")
+        p.counter("dvt_gateway_backend_conns_reused_total",
+                  conns.get("reused"), lab,
+                  help="Keep-alive checkouts from the backend pool")
     p.histogram("dvt_gateway_request_latency_seconds",
                 g["latency_hist"],
                 help="Gateway-side forward() latency (incl. retries)")
@@ -952,10 +1106,17 @@ class _GatewayHandler(BaseHTTPRequestHandler):
             ok, payload = gw.healthz()
             self._reply(200 if ok else 503, payload)
         elif path == "/v1/stats":
-            self._reply(200, gw.stats())
+            stats = gw.stats()
+            edge_stats = getattr(self.server, "stats", None)
+            if callable(edge_stats):
+                stats["edge"] = edge_stats()
+            self._reply(200, stats)
         elif path == "/metrics":
+            edge_stats = getattr(self.server, "stats", None)
+            text = render_gateway_metrics(
+                gw, edge=edge_stats() if callable(edge_stats) else None)
             self._reply_raw(
-                200, render_gateway_metrics(gw).encode(),
+                200, text.encode(),
                 {"Content-Type":
                  "text/plain; version=0.0.4; charset=utf-8"})
         elif path == "/v1/traces":
@@ -1055,15 +1216,26 @@ class _GatewayHandler(BaseHTTPRequestHandler):
 
 
 class GatewayServer:
-    """ThreadingHTTPServer front for a ``Gateway`` (mirrors
-    ``serve.http.ServeServer``)."""
+    """HTTP front for a ``Gateway`` (mirrors ``serve.http.ServeServer``):
+    the selector edge by default, ``edge=False`` for the
+    thread-per-request baseline."""
 
     def __init__(self, gateway: Gateway, host: str = "127.0.0.1",
                  port: int = 0, verbose: bool = False,
                  max_body_bytes: int = 32 * 2**20,
-                 socket_timeout_s: float | None = 30.0):
+                 socket_timeout_s: float | None = 30.0,
+                 edge: bool = True,
+                 max_connections: int = DEFAULT_MAX_CONNECTIONS,
+                 http_workers: int = 8):
         self.gateway = gateway
-        self.httpd = ThreadingHTTPServer((host, port), _GatewayHandler)
+        if edge:
+            self.httpd = EdgeServer((host, port), _GatewayHandler,
+                                    max_connections=max_connections,
+                                    workers=http_workers,
+                                    name="gateway")
+        else:
+            self.httpd = ThreadingHTTPServer((host, port),
+                                             _GatewayHandler)
         self.httpd.gateway = gateway
         self.httpd.verbose = verbose
         self.httpd.max_body_bytes = max_body_bytes

@@ -85,6 +85,19 @@ Each connection carries a socket timeout (``socket_timeout_s``, default
 30 s): a client that opens a socket and never sends a request line gets
 the connection closed, and one that stalls mid-body gets 408 — either
 way a slow-loris can't pin a handler thread forever.
+
+The front-end itself is the selector event loop in ``serve/edge.py``
+(HTTP/1.1 keep-alive, pipelining, bounded connections) by default;
+``edge=False`` keeps the original thread-per-request
+``ThreadingHTTPServer`` — the A/B baseline in docs/PERF.md.  Either
+way the routes above run unchanged.  Two optional edge services hook
+the inference POST path: a content-addressed response cache
+(``serve/cache.py`` — a repeat payload against the same model version
+answers without touching the engine) and per-tenant QoS
+(``serve/admission.py TenantQoS`` — the ``X-DVT-Tenant`` header maps
+to a priority class with a token-bucket quota, checked before the
+cache, and a weighted-shedding knee on engine queue pressure, checked
+on cache misses only).
 """
 
 from __future__ import annotations
@@ -94,12 +107,16 @@ import io
 import json
 import math
 import threading
+import time
 
 from deep_vision_tpu.analysis.sanitizer import new_lock
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
 from deep_vision_tpu.obs.trace import REQUEST_ID_HEADER, new_request_id
+from deep_vision_tpu.serve.admission import TENANT_HEADER
+from deep_vision_tpu.serve.cache import ResponseCache, payload_digest
+from deep_vision_tpu.serve.edge import DEFAULT_MAX_CONNECTIONS, EdgeServer
 
 DEFAULT_MAX_BODY_BYTES = 32 * 2**20
 
@@ -201,6 +218,7 @@ def render_serve_metrics(stats: dict) -> str:
     from deep_vision_tpu.core.metrics import PromText
 
     p = PromText()
+    _render_edge_metrics(p, stats)
     if isinstance(stats.get("models"), dict):
         for name, entry in stats["models"].items():
             if isinstance(entry.get("engine"), dict):
@@ -263,8 +281,80 @@ def render_serve_metrics(stats: dict) -> str:
             _render_deploy_metrics(p, dep)
         return p.render()
     for name, s in stats.items():
+        if name in ("edge", "response_cache", "qos"):
+            continue  # front-end blocks, rendered above
         _render_engine_metrics(p, name, s)
     return p.render()
+
+
+def _render_edge_metrics(p, stats: dict) -> None:
+    """Emit the front-end tier's series: the selector edge's
+    connection counters, the response cache, and per-tenant-class QoS
+    (docs/OBSERVABILITY.md tabulates these)."""
+    edge = stats.get("edge")
+    if isinstance(edge, dict):
+        p.gauge("dvt_serve_open_connections",
+                edge.get("open_connections"), {},
+                help="Sockets currently open on the serving edge")
+        p.gauge("dvt_serve_max_connections",
+                edge.get("max_connections"), {},
+                help="Connection cap (--max-connections)")
+        p.counter("dvt_serve_edge_accepted_total", edge.get("accepted"),
+                  {}, help="Connections accepted")
+        p.counter("dvt_serve_edge_requests_total", edge.get("requests"),
+                  {}, help="Requests parsed off edge connections")
+        p.counter("dvt_serve_edge_keepalive_reuses_total",
+                  edge.get("keepalive_reuses"), {},
+                  help="Requests after the first on one connection")
+        p.counter("dvt_serve_edge_evicted_idle_total",
+                  edge.get("evicted_idle"), {},
+                  help="Idle connections evicted to admit new ones")
+        p.counter("dvt_serve_edge_accept_pauses_total",
+                  edge.get("accept_pauses"), {},
+                  help="Times the listener paused at the connection cap")
+        p.counter("dvt_serve_edge_timeouts_408_total",
+                  edge.get("timeouts_408"), {},
+                  help="Stalled-body connections answered 408")
+        p.counter("dvt_serve_edge_closed_idle_total",
+                  edge.get("closed_idle"), {},
+                  help="Idle/slow-loris connections closed silently")
+    rcache = stats.get("response_cache")
+    if isinstance(rcache, dict):
+        p.counter("dvt_serve_cache_hits_total", rcache.get("hits"), {},
+                  help="Inference answers served from the response cache")
+        p.counter("dvt_serve_cache_misses_total", rcache.get("misses"),
+                  {}, help="Cacheable lookups that missed")
+        p.counter("dvt_serve_cache_evictions_total",
+                  rcache.get("evictions"), {},
+                  help="LRU evictions from the response cache")
+        p.counter("dvt_serve_cache_insertions_total",
+                  rcache.get("insertions"), {},
+                  help="Responses inserted into the cache")
+        p.gauge("dvt_serve_cache_bytes", rcache.get("bytes"), {},
+                help="Bytes of cached serialized responses")
+        p.gauge("dvt_serve_cache_entries", rcache.get("entries"), {},
+                help="Entries in the response cache")
+    qos = stats.get("qos")
+    if isinstance(qos, dict):
+        for cls, q in qos.items():
+            lab = {"class": cls}
+            p.counter("dvt_serve_tenant_served_total", q.get("served"),
+                      lab, help="Requests served per tenant class")
+            p.counter("dvt_serve_tenant_shed_total", q.get("shed_quota"),
+                      {**lab, "reason": "quota"},
+                      help="Requests shed by tenant QoS")
+            p.counter("dvt_serve_tenant_shed_total",
+                      q.get("shed_priority"),
+                      {**lab, "reason": "priority"})
+            p.counter("dvt_serve_tenant_cache_hits_total",
+                      q.get("cache_hits"), lab,
+                      help="Cache hits per tenant class")
+            lat = q.get("latency") or {}
+            for k in ("p50_ms", "p95_ms", "p99_ms"):
+                p.gauge("dvt_serve_tenant_latency_seconds",
+                        (lat.get(k) or 0.0) / 1e3,
+                        {**lab, "quantile": k[1:-3]},
+                        help="Per-class request latency quantiles")
 
 
 def _render_deploy_metrics(p, dep: dict) -> None:
@@ -402,6 +492,7 @@ class _Handler(BaseHTTPRequestHandler):
     # per-request trace state (set at the top of do_POST)
     _rid = None
     _span = None
+    _raw_body = None  # raw payload bytes — the cache's content address
 
     # -- plumbing ----------------------------------------------------------
 
@@ -446,8 +537,9 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
             raise ServeError(
                 413, f"body of {length} bytes exceeds the {cap}-byte cap")
+        raw = self._raw_body = self.rfile.read(length)
         try:
-            return json.loads(self.rfile.read(length))
+            return json.loads(raw)
         except json.JSONDecodeError as e:
             raise ServeError(400, f"bad JSON: {e}") from e
 
@@ -508,7 +600,112 @@ class _Handler(BaseHTTPRequestHandler):
                 500, f"quarantined: {result.reason} {result.detail}")
         return model, result
 
+    @staticmethod
+    def _shed_429(shed) -> ServeError:
+        headers = None
+        if shed.retry_after_s:
+            headers = {"Retry-After": max(1, math.ceil(shed.retry_after_s))}
+        return ServeError(429, f"shed: {shed.reason} {shed.detail}",
+                          headers=headers)
+
+    def _infer_route(self, path: str, body: dict,
+                     path_model: str | None, debug: bool) -> bytes:  # dvtlint: hot
+        """The classify/detect POST path with the edge services hooked
+        in — returns the serialized 200 body.  Order matters:
+
+          1. tenant quota (token bucket) — BEFORE the cache, so a hot
+             payload can't make quotas unenforceable;
+          2. response cache lookup — a hit returns the byte-identical
+             serialized answer, skipping decode + engine + QoS pressure
+             (a hit consumes no engine capacity);
+          3. weighted shedding on engine queue pressure — misses only;
+          4. engine inference, then cache insert — 200s only: every
+             shed/quarantine/error path raises BEFORE the put, so a
+             transient verdict is never replayed from cache.
+
+        Debug-trace requests bypass the cache both ways (the attached
+        span is per-request), and models without a ``params_digest``
+        are never cached (no version identity → no safe invalidation).
+        """
+        span = self._span
+        qos = getattr(self.server, "qos", None)
+        tenant = ""
+        t0 = time.monotonic()
+        if qos is not None:
+            tenant = self.headers.get(TENANT_HEADER) or ""
+            shed = qos.check_quota(tenant)
+            if shed is not None:
+                raise self._shed_429(shed)
+        model, engine = self._engine(body, path_model)
+        cache = getattr(self.server, "response_cache", None)
+        key = None
+        if cache is not None and not debug \
+                and self._raw_body is not None:
+            digest = getattr(model, "params_digest", None)
+            if digest is not None:
+                key = ResponseCache.key(
+                    path, model.name, digest,
+                    str(getattr(model, "wire_dtype", "")),
+                    str(getattr(model, "infer_dtype", "")),
+                    payload_digest(self._raw_body))
+                blob = cache.get(key)
+                if blob is not None:
+                    self._cache_hit = True
+                    if span is not None:
+                        span.mark("cache_hit")
+                        span.mark("respond")
+                    if qos is not None:
+                        qos.record_served(
+                            tenant, time.monotonic() - t0,
+                            cache_hit=True)
+                    return blob
+        if qos is not None:
+            adm = getattr(engine, "admission", None)
+            shed = qos.check_pressure(
+                tenant, getattr(engine, "queue_depth", 0),
+                adm.max_queue if adm is not None else 0)
+            if shed is not None:
+                raise self._shed_429(shed)
+        if path == "/v1/classify":
+            payload = self._classify(body, path_model)
+        else:
+            payload = self._detect(body, path_model)
+        if span is not None:
+            span.mark("respond")
+            if debug:
+                payload["trace"] = span.to_dict()
+        blob = json.dumps(payload).encode()
+        if key is not None:
+            # during a canary window plane.infer may have routed this
+            # request to the CANDIDATE — filing that answer under the
+            # active version's digest would poison the cache, so
+            # inserts pause until the canary resolves
+            plane = getattr(self.server, "plane", None)
+            if plane is None or not plane.canary_active(model.name):
+                cache.put(key, blob)
+        if qos is not None:
+            qos.record_served(tenant, time.monotonic() - t0)
+        return blob
+
     # -- routes ------------------------------------------------------------
+
+    def _edge_blocks(self) -> dict:
+        """The front-end's own stats blocks ("edge", "response_cache",
+        "qos") — present only when the selector edge / cache / QoS are
+        wired, so the legacy flat shape stays byte-identical without
+        them.  Keys are reserved: no model may be named after them."""
+        out = {}
+        srv = self.server
+        edge_stats = getattr(srv, "stats", None)
+        if callable(edge_stats):
+            out["edge"] = edge_stats()
+        rcache = getattr(srv, "response_cache", None)
+        if rcache is not None:
+            out["response_cache"] = rcache.stats()
+        qos = getattr(srv, "qos", None)
+        if qos is not None:
+            out["qos"] = qos.stats()
+        return out
 
     def _live_engines(self) -> dict:
         """name → the engine taking that model's traffic right now:
@@ -547,10 +744,13 @@ class _Handler(BaseHTTPRequestHandler):
                 stats = plane.stats()
                 if deploy is not None:
                     stats["deploy"] = deploy.stats()
+                stats.update(self._edge_blocks())
                 self._reply(200, stats)
                 return
-            self._reply(200, {name: eng.stats()
-                              for name, eng in self.server.engines.items()})
+            stats = {name: eng.stats()
+                     for name, eng in self.server.engines.items()}
+            stats.update(self._edge_blocks())
+            self._reply(200, stats)
         elif path == "/v1/models":
             if plane is not None:
                 self._reply(200, {"models": plane.models()})
@@ -567,6 +767,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 stats = {name: eng.stats()
                          for name, eng in self.server.engines.items()}
+            stats.update(self._edge_blocks())
             text = render_serve_metrics(stats)
             self._reply_raw(
                 200, text.encode(),
@@ -619,19 +820,18 @@ class _Handler(BaseHTTPRequestHandler):
                     and parts[2] == "deploy" and parts[4] == "revert":
                 self._reply(*self._deploy_revert(parts[3]))
                 return
-            body = self._body()
-            if path == "/v1/classify":
-                payload = self._classify(body, path_model)
-            elif path == "/v1/detect":
-                payload = self._detect(body, path_model)
-            else:
+            if path not in ("/v1/classify", "/v1/detect"):
+                self._body()  # consistent 400 on empty/oversized bodies
                 self._reply(404, {"error": f"no route {self.path}"})
                 return
-            if span is not None:
-                span.mark("respond")
-                if debug:
-                    payload["trace"] = span.to_dict()
-            self._reply(200, payload)
+            body = self._body()
+            self._cache_hit = False
+            blob = self._infer_route(path, body, path_model, debug)
+            # X-DVT-Cache lets clients (and the trace bench) split
+            # hit/miss latency without a debug span per request
+            self._reply_raw(200, blob, "application/json",
+                            headers={"X-DVT-Cache": "hit"}
+                            if self._cache_hit else None)
         except ServeError as e:
             self._reply(e.status, {"error": str(e)}, headers=e.headers)
         except TimeoutError:
@@ -775,14 +975,28 @@ class _Handler(BaseHTTPRequestHandler):
 
 
 class ServeServer:
-    """ThreadingHTTPServer wired to a registry + one engine per model."""
+    """HTTP front-end wired to a registry + one engine per model.
+
+    ``edge=True`` (default) runs the selector event loop from
+    ``serve/edge.py`` — keep-alive, pipelining, bounded connections;
+    ``edge=False`` keeps the original thread-per-request
+    ``ThreadingHTTPServer`` (the A/B baseline in docs/PERF.md).  Both
+    carry the same context attributes, so ``self.httpd`` stays the
+    single handle tests and the CLI reach through."""
 
     def __init__(self, registry, engines: dict, host: str = "127.0.0.1",
                  port: int = 0, verbose: bool = False,
                  max_body_bytes: int = DEFAULT_MAX_BODY_BYTES,
                  socket_timeout_s: float | None = 30.0,
-                 tracer=None, plane=None, deploy=None):
-        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+                 tracer=None, plane=None, deploy=None, edge: bool = True,
+                 max_connections: int = DEFAULT_MAX_CONNECTIONS,
+                 http_workers: int = 8, response_cache=None, qos=None):
+        if edge:
+            self.httpd = EdgeServer((host, port), _Handler,
+                                    max_connections=max_connections,
+                                    workers=http_workers, name="serve")
+        else:
+            self.httpd = ThreadingHTTPServer((host, port), _Handler)
         self.httpd.registry = registry
         self.httpd.engines = engines
         # model control plane (serve/models.py): when wired, routing /
@@ -797,6 +1011,10 @@ class ServeServer:
         self.httpd.socket_timeout_s = socket_timeout_s
         self.httpd.draining = False
         self.httpd.drain_lock = new_lock("serve.http.Server.drain_lock")
+        # optional edge services (None = off): the content-addressed
+        # response cache and per-tenant QoS, hooked into _infer_route
+        self.httpd.response_cache = response_cache
+        self.httpd.qos = qos
         if tracer is None:
             # share the first engine's tracer so handler-created spans
             # land in the same ring /v1/traces reads

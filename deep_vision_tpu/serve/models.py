@@ -484,6 +484,14 @@ class ModelControlPlane:
             return {name: mv.engine
                     for name, mv in sorted(self._active.items())}
 
+    def canary_active(self, name: str) -> bool:
+        """True while a canary candidate takes a slice of ``name``'s
+        traffic — the response cache must not INSERT during that window
+        (a canary-served answer would be filed under the active
+        version's digest), though lookups stay safe."""
+        with self._lock:
+            return name in self._canary
+
     def submit(self, name: str, image, deadline_ms: float | None = None,
                span=None) -> Future:
         """Route one request: the ACTIVE version, or — every canary

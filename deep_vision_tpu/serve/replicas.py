@@ -656,6 +656,12 @@ class ReplicatedEngine:
             rep["faults"] = self.faults.stats()
         return rep
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests awaiting routing in the shared submit queue — the
+        edge QoS pressure signal."""
+        return self._queue.qsize()
+
     def stats(self) -> dict:
         merged = LatencyHistogram()
         per = []

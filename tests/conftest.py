@@ -48,7 +48,8 @@ def host_devices():
 # if any thread observed a lock-order inversion (even one a worker thread
 # swallowed). Engines/gateways are constructed inside the tests, after this
 # fixture enables the seam, so every lock they create is instrumented.
-_SANITIZED_MARKERS = {"chaos", "gateway", "replicas", "models", "deploy"}
+_SANITIZED_MARKERS = {"chaos", "gateway", "replicas", "models", "deploy",
+                      "edge"}
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,156 @@
+"""`make edge-smoke` (runs inside `make serve-smoke`): boot the real
+cli.serve wiring — selector event loop, response cache and tenant QoS
+all on — and assert the async-edge surface end to end over real
+sockets: N requests down ONE keep-alive connection register as a
+single accept with N-1 reuses, an identical payload answers from the
+content-addressed cache without consuming engine capacity, the
+starved QoS class 429s (with Retry-After) while premium keeps being
+served, and a client that stalls mid-body is answered 408 by the
+loop's deadline sweep while a header-less dribbler is closed silently.
+Run directly, not under pytest."""
+
+import argparse
+import http.client
+import json
+import os
+import socket
+import sys
+import tempfile
+import time
+import urllib.request
+
+import numpy as np
+
+# plain script (not pytest): make the repo root importable when invoked
+# as `python tests/edge_smoke.py` from the checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _stats(base: str) -> dict:
+    with urllib.request.urlopen(base + "/v1/stats", timeout=60) as r:
+        return json.loads(r.read())
+
+
+def main():
+    from deep_vision_tpu.cli.serve import build_server
+
+    with tempfile.TemporaryDirectory() as workdir:
+        args = argparse.Namespace(
+            model="lenet5", workdir=workdir, stablehlo=None,
+            host="127.0.0.1", port=0, max_batch=4, max_wait_ms=2.0,
+            buckets=None, max_queue=64, warmup=False, verbose=False,
+            pipeline_depth=2, faults="", fault_seed=0,
+            serve_devices=1, shard_batches=False,
+            wire_dtype="float32", infer_dtype="float32",
+            thread_server=False, max_connections=64, http_workers=4,
+            response_cache_mb=16.0,
+            qos="premium:rate=0,shed_at=1.0,tenants=vip;"
+                "bronze:rate=0,shed_at=0.0;default=bronze")
+        engine, server = build_server(args)
+        # short deadlines so the slow-loris leg settles fast; set before
+        # the first connection so every conn is swept on this budget
+        server.httpd.socket_timeout_s = 0.4
+        server.start_background()
+        host, port = server.host, server.port
+        base = f"http://{host}:{port}"
+        try:
+            # -- keep-alive: N requests, ONE accept, N-1 reuses --------
+            body = json.dumps(
+                {"pixels": np.zeros((32, 32, 1)).tolist()}).encode()
+            conn = http.client.HTTPConnection(host, port, timeout=60)
+            n = 4
+            for _ in range(n):
+                conn.request("POST", "/v1/classify", body,
+                             {"Content-Type": "application/json",
+                              "X-DVT-Tenant": "vip"})
+                r = conn.getresponse()
+                blob = r.read()
+                assert r.status == 200, (r.status, blob)
+                assert not r.will_close, "edge dropped keep-alive"
+            conn.close()
+            edge = _stats(base)["edge"]
+            # 2 accepts: the keep-alive conn + the stats scrape itself
+            assert edge["accepted"] == 2, edge
+            assert edge["keepalive_reuses"] >= n - 1, edge
+            assert edge["requests"] >= n, edge
+
+            # -- response cache: byte-identical replay, no engine use --
+            served_before = engine.served
+            with urllib.request.urlopen(urllib.request.Request(
+                    base + "/v1/classify", data=body,
+                    headers={"Content-Type": "application/json",
+                             "X-DVT-Tenant": "vip"}), timeout=60) as r:
+                assert r.status == 200, r.status
+                assert r.headers.get("X-DVT-Cache") == "hit", \
+                    dict(r.headers)
+            rcache = _stats(base)["response_cache"]
+            assert rcache["hits"] >= 1, rcache
+            assert rcache["insertions"] >= 1, rcache
+            assert engine.served == served_before, \
+                "cache hit consumed engine capacity"
+
+            # -- tenant QoS: bronze sheds at its knee, premium serves --
+            # (shed_at=0.0 puts bronze's knee at zero pressure, so the
+            # weighted-shed verdict is deterministic without a real
+            # overload; a fresh payload forces the cache-miss path the
+            # pressure check guards)
+            fresh = json.dumps(
+                {"pixels": np.full((32, 32, 1), 7.0).tolist()}).encode()
+            req = urllib.request.Request(
+                base + "/v1/classify", data=fresh,
+                headers={"Content-Type": "application/json",
+                         "X-DVT-Tenant": "anon"})
+            try:
+                urllib.request.urlopen(req, timeout=60)
+                raise AssertionError("bronze cache-miss was not shed")
+            except urllib.error.HTTPError as e:
+                assert e.code == 429, e.code
+                assert e.headers.get("Retry-After"), dict(e.headers)
+            with urllib.request.urlopen(urllib.request.Request(
+                    base + "/v1/classify", data=fresh,
+                    headers={"Content-Type": "application/json",
+                             "X-DVT-Tenant": "vip"}), timeout=60) as r:
+                assert r.status == 200, r.status
+            qstats = _stats(base)["qos"]
+            assert qstats["bronze"]["shed_priority"] >= 1, qstats
+            assert qstats["premium"]["served"] >= 1, qstats
+
+            # -- deadline sweep: stalled body → 408, dribbler → close --
+            s = socket.create_connection((host, port), timeout=10)
+            s.sendall(b"POST /v1/classify HTTP/1.1\r\n"
+                      b"Host: x\r\nContent-Type: application/json\r\n"
+                      b"Content-Length: 100\r\n\r\n{")  # then stall
+            s.settimeout(5.0)
+            head = s.recv(4096)
+            assert head.startswith(b"HTTP/1.1 408"), head[:64]
+            s.close()
+            s2 = socket.create_connection((host, port), timeout=10)
+            s2.sendall(b"GET /v1/healthz")  # no CRLF: mid-request-line
+            s2.settimeout(5.0)
+            assert s2.recv(4096) == b"", "loris got a reply, not a close"
+            s2.close()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                edge = _stats(base)["edge"]
+                if edge["timeouts_408"] >= 1 and edge["closed_idle"] >= 1:
+                    break
+                time.sleep(0.05)
+            assert edge["timeouts_408"] >= 1, edge
+            assert edge["closed_idle"] >= 1, edge
+            print(f"edge-smoke PASS: {edge['requests']} requests over "
+                  f"{edge['accepted']} accepts "
+                  f"({edge['keepalive_reuses']} keep-alive reuses), "
+                  f"cache {rcache['hits']} hit / "
+                  f"{rcache['insertions']} inserted, bronze shed "
+                  f"{qstats['bronze']['shed_priority']} with Retry-After "
+                  f"while premium served {qstats['premium']['served']}, "
+                  f"stalled body 408'd and loris closed in "
+                  f"{server.httpd.socket_timeout_s}s")
+        finally:
+            server.shutdown()
+            engine.stop(drain_deadline=5.0)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,6 +42,7 @@ class StubBackend:
         self.healthz_status = 200
         self.retry_after = 2
         self.requests = 0
+        self.killed = False
         self._lock = threading.Lock()
         stub = self
 
@@ -62,6 +63,9 @@ class StubBackend:
                 self.wfile.write(blob)
 
             def do_GET(self):
+                if stub.killed:
+                    self.close_connection = True
+                    return
                 if self.path == "/v1/healthz":
                     s = stub.healthz_status
                     self._reply(s, {"status": "ok" if s == 200
@@ -71,6 +75,9 @@ class StubBackend:
                                       "served": stub.requests})
 
             def do_POST(self):
+                if stub.killed:
+                    self.close_connection = True
+                    return
                 with stub._lock:
                     stub.requests += 1
                 if stub.delay_s:
@@ -97,7 +104,11 @@ class StubBackend:
         self._thread.start()
 
     def kill(self):
-        """SIGKILL-alike: stop answering, free the port."""
+        """SIGKILL-alike: stop answering, free the port.  A killed
+        process takes its ESTABLISHED sockets with it, so in-flight
+        keep-alive connections must die too, not just the listener —
+        the flag makes handler threads hang up without replying."""
+        self.killed = True
         self.httpd.shutdown()
         self.httpd.server_close()
         self._thread.join(5)
