@@ -36,8 +36,11 @@ lint-test:
 # cache + hot reload under load), the gateway smoke (cross-host
 # failover) and the observability smoke (/metrics, spans, id propagation)
 # lint + lint-test gate the smoke: a serving-tier change that breaks the
-# machine-checked invariants fails here before any engine boots
+# machine-checked invariants fails here before any engine boots;
+# input_smoke.py rides the same chain so a train-input regression
+# (staging-pool lifetime, wire bytes, fused-ingest parity) fails CI too
 serve-smoke: lint lint-test
+	$(PY) tests/input_smoke.py
 	$(PY) tests/serve_smoke.py
 	$(PY) tests/edge_smoke.py
 	$(PY) tests/quant_smoke.py
@@ -53,6 +56,19 @@ serve-smoke: lint lint-test
 # slow-loris closed silently by the deadline sweep
 edge-smoke:
 	$(PY) tests/edge_smoke.py
+
+# the staged train-input pipeline end to end: uint8 batches through a
+# DevicePrefetcher into a donated jitted step (two identical epochs),
+# exactly 4x fewer image H2D bytes than the float32 wire, the fused
+# Pallas train-ingest parity gate, and a leak-free close()
+input-smoke:
+	$(PY) tests/input_smoke.py
+
+# the input-pipeline unit suite alone (wire parity, train_ingest
+# interpret parity + fallback, staging-pool reuse bounds, goodput
+# timers, abandoned-epoch cleanup, donation safety)
+input-test:
+	$(PY) -m pytest tests/test_input_pipeline.py -q -m input_pipeline
 
 # the edge unit suite alone (selector loop, pipelining, bounded
 # connections + eviction/accept-pause, cache lifecycle, tenant QoS,
@@ -182,6 +198,13 @@ bench-all:
 bench-pipeline:
 	$(PY) bench.py --pipeline
 
+# train-input goodput sweep: {uint8, float32} wire x prefetch depth
+# {1, 2, 4} through the staged DevicePrefetcher — img/s, input stall
+# fraction, H2D bytes/step per cell (docs/PERF.md "Input pipeline");
+# the uint8 wire must show exactly 4x fewer image H2D bytes
+bench-input:
+	$(PY) bench.py --input
+
 train_%:
 	$(PY) -m deep_vision_tpu.cli.train -m $* --data-root $(DATA) \
 		--workdir $(WORKDIR)/$*
@@ -203,8 +226,8 @@ list:
 
 .PHONY: test test-all bench bench-serve bench-serve-sync \
 	bench-serve-scaling bench-serve-wire bench-gateway bench-deploy \
-	serve-smoke \
+	bench-input serve-smoke \
 	serve-multi serve-chaos gateway-smoke gateway-test obs-smoke \
-	edge-smoke edge-test \
+	edge-smoke edge-test input-smoke input-test \
 	obs-test model-smoke model-test quant-smoke quant-test \
 	deploy-smoke deploy-test lint lint-test list

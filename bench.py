@@ -2059,10 +2059,162 @@ def bench_pipeline(num_workers: int = 16, batch: int = 256,
     }
 
 
+def bench_input(batch: int = 64, size: int = 96, steps: int = 24,
+                depths: tuple = (1, 2, 4), workers: tuple = (0,),
+                jpeg_size: int = 160) -> dict:
+    """Train-input goodput sweep: wire dtype × prefetch depth × workers.
+
+    Every cell drives the SAME jitted conv step through a
+    ``DevicePrefetcher`` (data/pipeline.py) and reports the trainer's
+    input-goodput block per cell: sustained img/s, ``input_stall_frac``
+    (fraction of consumer wall time spent waiting on input), and H2D
+    bytes/step split by batch key.  The only things that change between
+    cells are what crosses the wire (uint8 bytes vs host-normalized
+    float32 — 4.0× the image DMA) and how many batches are staged ahead.
+
+    ``workers=0`` cells stream in-memory synthetic classification
+    arrays (pure wire/prefetch plumbing, no decode cost); ``workers>0``
+    cells read synthetic JPEGs through the real ``ImageNetLoader``
+    decode/augment pool, so the depth axis shows whether staging hides
+    a real producer.
+    """
+    import os
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from deep_vision_tpu.data.pipeline import DevicePrefetcher
+    from deep_vision_tpu.parallel import make_mesh
+
+    mesh = make_mesh()
+    n = max(2 * batch, 256)
+
+    from deep_vision_tpu.data.synthetic import synthetic_classification
+
+    data = synthetic_classification(n, size, 3, 10, seed=0)
+    lo, span = data["image"].min(), np.ptp(data["image"]) + 1e-9
+    u8 = np.round((data["image"] - lo) / span * 255).astype(np.uint8)
+    wires = {"uint8": u8, "float32": u8.astype(np.float32) / 255.0}
+    labels = data["label"]
+
+    rng = np.random.default_rng(0)
+    w0 = jnp.asarray(rng.normal(0, 0.1, (3, 3, 3, 16)).astype(np.float32))
+
+    @functools.partial(jax.jit, donate_argnums=(1,))
+    def step(w, b):
+        x = b["image"]
+        if x.dtype == jnp.uint8:
+            x = x.astype(jnp.float32) / 255.0
+        else:
+            x = x.astype(jnp.float32)
+        y = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        return jnp.mean(y * y) + 0.0 * jnp.sum(b["label"])
+
+    def memory_batches(images):
+        for i in range(steps):
+            s = (i * batch) % (n - batch + 1)
+            yield {"image": images[s:s + batch],
+                   "label": labels[s:s + batch]}
+
+    def run_cell(batch_iter_factory, depth):
+        pf = DevicePrefetcher(mesh, depth=depth)
+        try:
+            # warm compile outside the timed window
+            jax.block_until_ready(step(
+                w0, next(iter(batch_iter_factory()))))
+            t0 = time.perf_counter()
+            stream = pf.iterate(batch_iter_factory())
+            last, n_batches = None, 0
+            for b in stream:
+                last = step(w0, b)
+                n_batches += 1
+            jax.block_until_ready(last)
+            dt = time.perf_counter() - t0
+            st = stream.stats()
+        finally:
+            pf.close()
+        per_key = {k: int(v / max(1, n_batches))
+                   for k, v in st["h2d_bytes_by_key"].items()}
+        return {
+            "images_per_sec": round(n_batches * batch / dt, 1),
+            "input_stall_frac": round(st["input_stall_frac"], 4),
+            "h2d_bytes_per_step": int(st["h2d_bytes_per_step"]),
+            "h2d_bytes_per_step_by_key": per_key,
+            "batches": n_batches,
+        }
+
+    cells = []
+    tmp = None
+    try:
+        for nw in workers:
+            if nw == 0:
+                for wire, images in wires.items():
+                    for depth in depths:
+                        cell = run_cell(
+                            lambda im=images: memory_batches(im), depth)
+                        cell.update(wire=wire, depth=depth, workers=0)
+                        cells.append(cell)
+                continue
+            # real decode/augment pool over synthetic JPEGs
+            from deep_vision_tpu.data.imagenet import ImageNetLoader
+
+            if tmp is None:
+                tmp = tempfile.mkdtemp(prefix="bench_input_")
+                root, labels_path, _ = _make_synthetic_imagenet(
+                    tmp, max(2 * batch, 256), jpeg_size)
+            for wire in ("uint8", "float32"):
+                for depth in depths:
+                    loader = ImageNetLoader(
+                        root, labels_path, batch, train=True,
+                        image_size=size, num_workers=nw,
+                        device_normalize=wire == "uint8")
+                    try:
+                        cell = run_cell(lambda ld=loader: iter(ld), depth)
+                    finally:
+                        loader.close()
+                    cell.update(wire=wire, depth=depth, workers=nw)
+                    cells.append(cell)
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def _img_bytes(wire, nw):
+        for c in cells:
+            if c["wire"] == wire and c["workers"] == nw:
+                return c["h2d_bytes_per_step_by_key"].get("image", 0)
+        return 0
+
+    ratios = {nw: round(_img_bytes("float32", nw)
+                        / max(1, _img_bytes("uint8", nw)), 2)
+              for nw in workers}
+    return {
+        "metric": "train_input_goodput",
+        "unit": "images/sec",
+        "batch": batch, "image_size": size, "steps": steps,
+        "backend": jax.default_backend(),
+        "host_cores": os.cpu_count(),
+        # acceptance: uint8 image DMA is exactly 1/4 of the f32 wire
+        "f32_over_u8_image_h2d_ratio": ratios,
+        "cells": cells,
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--pipeline", action="store_true",
                    help="measure host input-pipeline throughput instead")
+    p.add_argument("--input", action="store_true",
+                   help="train-input goodput sweep: wire dtype × prefetch "
+                        "depth × workers → img/s, input_stall_frac, H2D "
+                        "bytes/step (docs/PERF.md 'Input pipeline')")
+    p.add_argument("--input-depths", default="1,2,4",
+                   help="prefetch depths to sweep with --input")
+    p.add_argument("--input-workers", default="0",
+                   help="decode-pool sizes to sweep with --input (0 = "
+                        "in-memory arrays, >0 = ImageNetLoader JPEG pool)")
     p.add_argument("--profile", action="store_true")
     p.add_argument("--batch", type=int, default=None,
                    help="per-chip batch (default: 256 for the ResNet "
@@ -2217,6 +2369,12 @@ def main():
         return
     if args.recipe:
         bench_recipe(batch=args.batch, steps=args.steps)
+        return
+    if args.input:
+        print(json.dumps(bench_input(
+            batch=args.batch or 64, steps=args.steps or 24,
+            depths=tuple(int(d) for d in args.input_depths.split(",")),
+            workers=tuple(int(w) for w in args.input_workers.split(",")))))
         return
     if args.coupled:
         print(json.dumps(bench_coupled(batch=args.batch or 256)))

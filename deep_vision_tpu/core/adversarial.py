@@ -36,8 +36,14 @@ from deep_vision_tpu.parallel import (
 
 class AdversarialTrainer:
     def __init__(self, config: TrainConfig, task, mesh=None,
-                 workdir: str | None = None, upload: str | None = None):
+                 workdir: str | None = None, upload: str | None = None,
+                 preprocess_fn=None):
         self.config = config
+        # optional device-side input preprocessing run INSIDE the jitted
+        # step (the GAN uint8 wire: ops/preprocess.make_gan_preprocess
+        # reverses the (x-127.5)/127.5 scaling as a traced prologue);
+        # signature (batch, rng, train) — same contract as Trainer
+        self.preprocess_fn = preprocess_fn
         if getattr(config, "grad_accum_steps", 1) > 1:
             raise NotImplementedError(
                 "grad_accum_steps applies to the single-optimizer Trainer "
@@ -64,11 +70,48 @@ class AdversarialTrainer:
         self.start_step = 0
         self.guard = DivergenceGuard(config.max_bad_steps)
         self._preempted = False  # SIGTERM → step-boundary save + return
+        # staged input pipeline — same DevicePrefetcher as the Trainer,
+        # used by _epoch_steps for tasks that declare ``prefetch_safe``
+        # (DCGAN: no host exchange between steps; CycleGAN's per-step
+        # ImagePool injection must see the PREVIOUS step's fakes, so
+        # staging its batches ahead would replay stale pools)
+        self.prefetch_depth = max(1, int(getattr(config,
+                                                 "prefetch_depth", 2)))
+        self._prefetcher = None
 
     def init_states(self, sample_batch: dict) -> dict:
+        if self.preprocess_fn is not None:
+            # models must init on what the step actually feeds them
+            # (uint8 wire batches decode inside the jitted step)
+            sample_batch = self.preprocess_fn(
+                sample_batch, jax.random.PRNGKey(0), train=False)
         states = self.task.init_states(
             jax.random.PRNGKey(self.config.seed), sample_batch)
         return {k: replicate(v, self.mesh) for k, v in states.items()}
+
+    def _get_prefetcher(self):
+        if self._prefetcher is None:
+            from deep_vision_tpu.data.pipeline import DevicePrefetcher
+
+            self._prefetcher = DevicePrefetcher(self.mesh,
+                                                depth=self.prefetch_depth)
+        return self._prefetcher
+
+    def _log_input_stats(self, step: int, stats: dict, epoch: int):
+        """Same input-goodput block as Trainer._log_input_stats — both
+        trainers report identical series (docs/OBSERVABILITY.md)."""
+        if not stats or not stats.get("batches"):
+            return
+        self.logger.log_input_block(step, stats)
+        prod = stats.get("producer_ms", {})
+        n = max(1, stats["batches"])
+        print(f"[input] epoch {epoch} stall {stats['input_stall_frac']:.1%} "
+              f"h2d {stats['h2d_bytes_per_step'] / 1e6:.2f} MB/step "
+              f"prep {prod.get('prep_wait', 0.0) / n:.1f} "
+              f"assemble {prod.get('assemble', 0.0) / n:.1f} "
+              f"h2d {prod.get('h2d', 0.0) / n:.1f} ms/batch "
+              f"(pool alloc {stats['pool']['allocated']} "
+              f"reuse {stats['pool']['reused']})", flush=True)
 
     def maybe_resume(self, states: dict) -> dict:
         if self.checkpointer.latest_step() is None:
@@ -85,11 +128,18 @@ class AdversarialTrainer:
         return {k: replicate(v, self.mesh) for k, v in states.items()}
 
     def _guarded_step(self, task_step):
+        preprocess_fn = self.preprocess_fn
+
         def guarded(states, batch, rng):
             """Divergence guard around the task's multi-network step:
             if any loss or any updated network went non-finite, every
             network keeps its previous params/opt_state (GAN updates are
-            coupled — applying half a step would unbalance G vs D)."""
+            coupled — applying half a step would unbalance G vs D).
+            The optional traced preprocess prologue (uint8 wire decode)
+            runs first; it consumes no randomness, so the task sees the
+            SAME rng as the float-wire path."""
+            if preprocess_fn is not None:
+                batch = preprocess_fn(batch, rng, train=True)
             new_states, outputs, metrics = task_step(states, batch, rng)
             ok = all_finite(list(metrics.values())) & all_finite(
                 {k: s.params for k, s in new_states.items()})
@@ -103,8 +153,13 @@ class AdversarialTrainer:
 
     def train_step(self, states, batch, rng):
         if self._jit_step is None:
+            # batch donated alongside the states (argnum 1): prefetched
+            # device batches are single-use, so XLA may reuse their HBM;
+            # host numpy batches (tests, the CycleGAN pool path) are
+            # copied on device_put and unaffected
             self._jit_step = jax.jit(
-                self._guarded_step(self.task.train_step), donate_argnums=0)
+                self._guarded_step(self.task.train_step),
+                donate_argnums=(0, 1))
         return self._jit_step(states, shard_batch(batch, self.mesh), rng)
 
     def train_multi(self, states, stacked, rng):
@@ -155,6 +210,10 @@ class AdversarialTrainer:
                                     sample_hook)
         finally:
             restore()
+            # abandoned epochs must not leave a producer thread parked on
+            # the queue or device batches pinned in it
+            if self._prefetcher is not None:
+                self._prefetcher.close()
 
     def _preempt_save(self, step, states, epoch):
         self.checkpointer.save_tree(
@@ -223,22 +282,41 @@ class AdversarialTrainer:
 
     def _epoch_steps(self, train_data, states, rng, step, epoch, meter):
         """Per-step dispatch with the host_prepare/host_update exchange
-        between steps (the CycleGAN ImagePool contract)."""
+        between steps (the CycleGAN ImagePool contract).
+
+        Tasks that declare ``prefetch_safe`` (host_prepare is stateless —
+        DCGAN) ride the staged ``DevicePrefetcher``: host_prepare runs
+        producer-side before staging, batches arrive already on device,
+        and the epoch reports the same input-goodput block as the
+        Trainer.  Pool-coupled tasks (CycleGAN) keep direct per-step
+        iteration — their host_prepare must see the fakes ``host_update``
+        harvested from the IMMEDIATELY previous step, which depth-k
+        staging would replay stale."""
         cfg = self.config
-        for batch in train_data:
-            rng, step_rng = jax.random.split(rng)
-            batch = self.task.host_prepare(batch)
-            states, outputs, metrics = self.train_step(
-                states, batch, step_rng)
-            self.task.host_update(outputs)
-            meter.update(len(next(iter(batch.values()))))
-            step += 1
-            if step % cfg.log_every_steps == 0:
-                self._log_step(epoch, step, metrics, meter)
-            if self._preempted:
-                self._preempt_save(step, states, epoch)
-                return states, rng, step, True
-        return states, rng, step, False
+        stream = None
+        if getattr(self.task, "prefetch_safe", False):
+            stream = self._get_prefetcher().iterate(
+                train_data, host_transform=self.task.host_prepare)
+        try:
+            for batch in (stream if stream is not None else train_data):
+                rng, step_rng = jax.random.split(rng)
+                if stream is None:
+                    batch = self.task.host_prepare(batch)
+                bs = len(next(iter(batch.values())))
+                states, outputs, metrics = self.train_step(
+                    states, batch, step_rng)
+                self.task.host_update(outputs)
+                meter.update(bs)
+                step += 1
+                if step % cfg.log_every_steps == 0:
+                    self._log_step(epoch, step, metrics, meter)
+                if self._preempted:
+                    self._preempt_save(step, states, epoch)
+                    return states, rng, step, True
+            return states, rng, step, False
+        finally:
+            if stream is not None:
+                self._log_input_stats(step, stream.stats(), epoch)
 
     def _epoch_scan(self, train_data, states, rng, step, epoch, K, meter):
         """K-step-per-dispatch epoch for scan_safe tasks: host batches are

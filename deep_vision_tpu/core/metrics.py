@@ -87,6 +87,23 @@ class MetricLogger:
             tb.scalars(metrics, step)  # one batched event + one flush
             tb.flush()
 
+    def log_input_block(self, step: int, stats: dict):
+        """The trainer's per-epoch input-goodput block (docs/OBSERVABILITY.md
+        "Trainer input-goodput series"): stall fraction, H2D traffic, and
+        per-stage producer timers from ``DevicePrefetcher`` epoch stats.
+        Exporters prefix these with ``dvt_train_`` (e.g.
+        ``dvt_train_input_stall_frac``)."""
+        n = max(1, int(stats.get("batches", 0)))
+        prod = stats.get("producer_ms", {})
+        self.log_dict(step, {
+            "input_stall_frac": float(stats.get("input_stall_frac", 0.0)),
+            "input_h2d_bytes_per_step":
+                float(stats.get("h2d_bytes_per_step", 0.0)),
+            "input_prep_wait_ms": float(prod.get("prep_wait", 0.0)) / n,
+            "input_assemble_ms": float(prod.get("assemble", 0.0)) / n,
+            "input_h2d_ms": float(prod.get("h2d", 0.0)) / n,
+        })
+
     def latest(self, name: str) -> float | None:
         s = self.history.get(name)
         return s["values"][-1] if s and s["values"] else None

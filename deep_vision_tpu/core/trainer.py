@@ -122,6 +122,13 @@ class Trainer:
         # workdir/profile (the reference had only throughput prints —
         # SURVEY §5 tracing; TPU-native answer is a jax.profiler trace)
         self.profile_steps: tuple[int, int] | None = None
+        # staged input pipeline (data/pipeline.DevicePrefetcher): built
+        # lazily on the first train epoch, persists across epochs so the
+        # host staging pool reuses its buffers, closed by fit()'s finally
+        # path so abandoned epochs leak neither thread nor device batches
+        self.prefetch_depth = max(1, int(getattr(config,
+                                                 "prefetch_depth", 2)))
+        self._prefetcher = None
 
     # ------------------------------------------------------------------ init
 
@@ -359,7 +366,12 @@ class Trainer:
                     extra["weight"] = batch["weight"]
             return sums, extra
 
-        self._jit_train_step = jax.jit(train_step, donate_argnums=0)
+        # donate the BATCH too (argnum 1): the prefetcher's device batches
+        # are consumed exactly once, so XLA may overwrite their HBM in
+        # place — input buffers stop double-counting against HBM headroom.
+        # Host numpy batches (tests, direct callers) are unaffected:
+        # donation only claims committed jax.Arrays.
+        self._jit_train_step = jax.jit(train_step, donate_argnums=(0, 1))
         self._jit_eval_step = jax.jit(eval_step)
 
         # multi-step dispatch (config.scan_steps > 1): K steps per device
@@ -378,7 +390,7 @@ class Trainer:
                 return jax.lax.scan(body, state, batches, unroll=2)
 
             self._jit_train_multi = jax.jit(multi_train_step,
-                                            donate_argnums=0)
+                                            donate_argnums=(0, 1))
 
     def train_step(self, state, batch):
         if self._jit_train_step is None:
@@ -447,10 +459,33 @@ class Trainer:
             out.update(ev)
         return out
 
+    def _get_prefetcher(self):
+        if self._prefetcher is None:
+            from deep_vision_tpu.data.pipeline import DevicePrefetcher
+
+            self._prefetcher = DevicePrefetcher(self.mesh,
+                                                depth=self.prefetch_depth)
+        return self._prefetcher
+
+    def _log_input_stats(self, step: int, stats: dict, epoch: int):
+        """The input-goodput block: epoch-level stall fraction + per-step
+        H2D traffic from the prefetcher's stage timers, logged to the
+        MetricLogger series and echoed as one epoch line."""
+        if not stats or not stats.get("batches"):
+            return
+        self.logger.log_input_block(step, stats)
+        prod = stats.get("producer_ms", {})
+        n = max(1, stats["batches"])
+        print(f"[input] epoch {epoch} stall {stats['input_stall_frac']:.1%} "
+              f"h2d {stats['h2d_bytes_per_step'] / 1e6:.2f} MB/step "
+              f"prep {prod.get('prep_wait', 0.0) / n:.1f} "
+              f"assemble {prod.get('assemble', 0.0) / n:.1f} "
+              f"h2d {prod.get('h2d', 0.0) / n:.1f} ms/batch "
+              f"(pool alloc {stats['pool']['allocated']} "
+              f"reuse {stats['pool']['reused']})", flush=True)
+
     def train_epoch(self, state: TrainState, train_data: Iterable,
                     epoch: int) -> TrainState:
-        from deep_vision_tpu.data.loader import prefetch_to_device
-
         cfg = self.config
         if getattr(cfg, "scan_steps", 1) > 1:
             return self._train_epoch_scan(state, train_data, epoch)
@@ -458,9 +493,12 @@ class Trainer:
         pending = None  # async metric fetch: log step N-1 while N runs
         profiling = self.profile_steps if epoch == self.start_epoch else None
         trace_active = False
-        # H2D double buffer: batch N+1 transfers while step N computes
-        # (shard_batch in train_step is a no-op on already-placed arrays)
-        for i, batch in enumerate(prefetch_to_device(train_data, self.mesh)):
+        # staged input pipeline: batch N+1 assembles/stages/transfers on
+        # the producer thread while step N computes; the stream yields
+        # already-placed device batches (shard_batch in train_step is a
+        # no-op on them) that the jitted step consumes via donation
+        stream = self._get_prefetcher().iterate(train_data)
+        for i, batch in enumerate(stream):
             if profiling is not None:
                 if i == profiling[0]:
                     jax.profiler.start_trace(
@@ -499,6 +537,7 @@ class Trainer:
             self.logger.log_dict(int(state.step),
                                  {f"train_{k}": v for k, v in m.items()})
         self.logger.log("images_per_sec", int(state.step), meter.images_per_sec)
+        self._log_input_stats(int(state.step), stream.stats(), epoch)
         return state
 
     def _train_epoch_scan(self, state: TrainState, train_data: Iterable,
@@ -589,6 +628,11 @@ class Trainer:
                                     best)
         finally:
             restore_handler()
+            # abandoned epochs (preemption, divergence abort, exception)
+            # must not leave a producer thread parked on the queue or
+            # device batches pinned in it
+            if self._prefetcher is not None:
+                self._prefetcher.close()
 
     def _install_preempt_handler(self):
         self._preempted = False  # stale flag must not abort a fresh fit()

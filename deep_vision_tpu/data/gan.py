@@ -13,10 +13,22 @@ from typing import Iterator, Sequence
 import numpy as np
 
 
+def to_uint8_wire(x: np.ndarray) -> np.ndarray:
+    """float [-1, 1] images → uint8 0–255 (the GAN wire inverse of
+    ``(x - 127.5)/127.5``): what the loaders ship when
+    ``device_normalize`` keeps the reverse scaling as a traced device
+    prologue (ops/preprocess.make_gan_preprocess)."""
+    return np.clip(np.round((x + 1.0) * 127.5), 0, 255).astype(np.uint8)
+
+
 def mnist_gan_data(root: str | None = None, n_synthetic: int = 2048,
-                   seed: int = 0) -> np.ndarray:
+                   seed: int = 0,
+                   device_normalize: bool = False) -> np.ndarray:
     """(N, 28, 28, 1) float32 in [-1, 1]; falls back to synthetic digits
-    when no MNIST directory is given."""
+    when no MNIST directory is given.  ``device_normalize=True`` keeps
+    the uint8 wire instead — raw 0–255 bytes, with the (x-127.5)/127.5
+    scaling deferred to the traced prologue — so the DCGAN loop's host
+    batches and H2D DMA carry 1 byte/pixel like detection/pose."""
     if root:
         from deep_vision_tpu.data.mnist import load_idx_images
 
@@ -36,6 +48,8 @@ def mnist_gan_data(root: str | None = None, n_synthetic: int = 2048,
         images = (images - images.min()) / (np.ptp(images) + 1e-9) * 255.0
         images = images[..., 0]
     x = images.astype(np.float32)[..., None] if images.ndim == 3 else images
+    if device_normalize:
+        return np.clip(np.round(x), 0, 255).astype(np.uint8)
     return (x - 127.5) / 127.5
 
 
@@ -88,13 +102,19 @@ class UnpairedLoader:
             yield {"image_a": self.a[ia[s]], "image_b": self.b[ib[s]]}
 
 
-def synthetic_unpaired(n: int, image_size: int = 64, seed: int = 0
+def synthetic_unpaired(n: int, image_size: int = 64, seed: int = 0,
+                       device_normalize: bool = False
                        ) -> tuple[np.ndarray, np.ndarray]:
-    """Two translatable domains: same shapes, opposite color casts."""
+    """Two translatable domains: same shapes, opposite color casts.
+    ``device_normalize=True`` ships both domains as uint8 wire batches
+    (reverse scaling runs as the traced GAN prologue)."""
     rng = np.random.default_rng(seed)
     base = rng.uniform(-0.2, 0.2, size=(2 * n, image_size, image_size, 3))
     ys, xs = np.mgrid[0:image_size, 0:image_size] / image_size
     pattern = np.sin(6.28 * ys)[..., None] * np.array([1.0, -1.0, 0.5])
     a = np.clip(base[:n] + pattern * 0.6 + [0.3, -0.3, 0.0], -1, 1)
     b = np.clip(base[n:] - pattern * 0.6 + [-0.3, 0.3, 0.0], -1, 1)
-    return a.astype(np.float32), b.astype(np.float32)
+    a, b = a.astype(np.float32), b.astype(np.float32)
+    if device_normalize:
+        return to_uint8_wire(a), to_uint8_wire(b)
+    return a, b

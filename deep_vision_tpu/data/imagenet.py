@@ -254,8 +254,11 @@ def _worker_load(args) -> tuple[np.ndarray, np.int32]:
 class ImageNetLoader:
     """Sharded, multiprocess, epoch-reshuffled batch iterator.
 
-    Yields {"image": (B,H,W,3) f32, "label": (B,) i32} host batches; compose
-    with ``prefetch_to_device`` for the H2D double buffer.
+    Yields {"image": (B,H,W,3), "label": (B,) i32} host batches — uint8
+    images with ``device_normalize`` (the 1-byte/pixel train wire; the
+    jitter/normalize runs as the jitted step's traced prologue), float32
+    otherwise.  Compose with ``data.pipeline.DevicePrefetcher`` (or the
+    legacy ``prefetch_to_device`` shim) for staged H2D.
     """
 
     def __init__(self, root_dir: str | None, labels_file: str | None,
@@ -298,6 +301,9 @@ class ImageNetLoader:
                          image_size=image_size, resize=resize,
                          device_normalize=device_normalize,
                          preprocessing=preprocessing)
+        #: what this loader ships per pixel — the input-goodput logs and
+        #: bench.py --input report H2D traffic against this
+        self.wire_dtype = np.uint8 if device_normalize else np.float32
         if isinstance(self.ds, ImageNetRecords):
             self._cfg["entries"] = self.ds.entries
         else:

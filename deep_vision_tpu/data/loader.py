@@ -9,13 +9,9 @@ already being transferred H2D, so HBM never waits on the host.
 
 from __future__ import annotations
 
-import queue
-import threading
 from typing import Any, Callable, Iterable, Iterator
 
 import numpy as np
-
-from deep_vision_tpu.parallel import shard_batch
 
 
 def pad_eval_indices(idx: np.ndarray, start: int, batch_size: int
@@ -203,29 +199,21 @@ class ArrayLoader:
 
 
 def prefetch_to_device(iterable: Iterable, mesh, depth: int = 2) -> Iterator:
-    """Background-thread device_put pipeline (the double-buffer).
+    """Background device_put pipeline (the double-buffer) — legacy shim.
 
-    Producer exceptions (decode errors, shard divisibility) re-raise in the
-    consumer — a dead producer must abort the epoch, not truncate it."""
-    q: queue.Queue = queue.Queue(maxsize=depth)
-    _END = object()
-    _ERR = object()
+    Now a thin generator over :class:`deep_vision_tpu.data.pipeline.DevicePrefetcher`
+    so the old call sites keep their contract (producer exceptions re-raise
+    in the consumer — a dead producer must abort the epoch, not truncate it)
+    while gaining the staged path's fix for the producer-thread leak: when
+    the consumer abandons iteration early (preemption, divergence abort,
+    mid-epoch exception) the generator's ``finally`` closes the epoch, which
+    unblocks the producer's bounded put and joins the thread instead of
+    leaving it parked on ``q.put`` forever with batches pinned in the queue.
+    """
+    from deep_vision_tpu.data.pipeline import DevicePrefetcher
 
-    def producer():
-        try:
-            for item in iterable:
-                q.put(shard_batch(item, mesh))
-        except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
-            q.put((_ERR, e))
-        else:
-            q.put(_END)
-
-    t = threading.Thread(target=producer, daemon=True)
-    t.start()
-    while True:
-        item = q.get()
-        if item is _END:
-            break
-        if isinstance(item, tuple) and len(item) == 2 and item[0] is _ERR:
-            raise item[1]
-        yield item
+    pf = DevicePrefetcher(mesh, depth=depth)
+    try:
+        yield from pf.iterate(iterable)
+    finally:
+        pf.close()

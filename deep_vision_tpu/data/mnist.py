@@ -39,6 +39,14 @@ def load_idx_labels(path: str) -> np.ndarray:
     return np.frombuffer(b, np.uint8, count, offset=8).astype(np.int32)
 
 
+def pad_uint8(images: np.ndarray) -> np.ndarray:
+    """uint8 (N,28,28) → uint8 NHWC (N,32,32,1): the geometric half of
+    ``preprocess`` only — the wire stays 1 byte/pixel and the float
+    normalize runs as a traced device prologue
+    (ops/preprocess.make_mnist_preprocess)."""
+    return np.pad(images, ((0, 0), (2, 2), (2, 2)), "constant")[..., None]
+
+
 def preprocess(images: np.ndarray, mean: float = MEAN, std: float = STD) -> np.ndarray:
     """uint8 (N,28,28) → normalized float32 NHWC (N,32,32,1)."""
     x = np.pad(images, ((0, 0), (2, 2), (2, 2)), "constant")
@@ -47,7 +55,13 @@ def preprocess(images: np.ndarray, mean: float = MEAN, std: float = STD) -> np.n
     return x[..., None]
 
 
-def load_mnist(root: str, split: str = "train") -> dict[str, np.ndarray]:
+def load_mnist(root: str, split: str = "train",
+               device_normalize: bool = False) -> dict[str, np.ndarray]:
+    """``device_normalize=True`` keeps the uint8 wire: images stay raw
+    0–255 bytes (zero-padded to 32×32 — padding is dtype-agnostic) and
+    the /255 + standardize runs on device inside the jitted step, so
+    host batches, the prefetch queue, and the H2D DMA carry 4× fewer
+    bytes.  False is the legacy host-normalized float32 path."""
     prefix = "train" if split == "train" else "t10k"
     names = [f"{prefix}-images-idx3-ubyte", f"{prefix}-labels-idx1-ubyte"]
     paths = []
@@ -59,8 +73,9 @@ def load_mnist(root: str, split: str = "train") -> dict[str, np.ndarray]:
                 break
         else:
             raise FileNotFoundError(f"{name}[.gz] not under {root}")
-    return {"image": preprocess(load_idx_images(paths[0])),
-            "label": load_idx_labels(paths[1])}
+    raw = load_idx_images(paths[0])
+    image = pad_uint8(raw) if device_normalize else preprocess(raw)
+    return {"image": image, "label": load_idx_labels(paths[1])}
 
 
 def synthetic_mnist(n: int = 512, seed: int = 0, num_classes: int = 10

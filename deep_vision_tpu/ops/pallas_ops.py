@@ -180,6 +180,200 @@ def ingest_parity_ok(shape: tuple, kind: str, act_scale: float,
     return ok
 
 
+def _gray_matrix(W: int, C: int, l_pad: int) -> np.ndarray:
+    """(lanes_p, lanes_p) matrix turning the (rows, W·C) view into its
+    per-pixel grayscale broadcast: ``(x @ G)[r, p·C+j] = Σ_i x[r, p·C+i]·
+    GRAY[i]`` — the ``(x * GRAY).sum(-1)`` of the XLA jitter, expressed as
+    a matmul so the kernel never needs an in-block (rows, W, C) reshape
+    (MXU-friendly; pad lanes are zero columns so they stay zero)."""
+    from deep_vision_tpu.ops.preprocess import _GRAY
+
+    gray = (np.asarray(_GRAY, np.float32) if C == 3
+            else np.full((C,), 1.0 / C, np.float32))  # C=1: identity → no-op
+    lanes = W * C
+    g = np.zeros((lanes + l_pad, lanes + l_pad), np.float32)
+    pix = np.arange(W) * C
+    for ci in range(C):
+        for cj in range(C):
+            g[pix + ci, pix + cj] = gray[ci]
+    return g
+
+
+def _train_ingest_kernel(x_ref, s_ref, mean_ref, std_ref, g_ref, out_ref):
+    # dvtlint: traced
+    # one (TILE_R, lanes) block: decode + the full color-jitter chain +
+    # normalize, with the three per-image jitter factors and the
+    # post-brightness image mean prebaked into per-ROW scalars (every row
+    # of image i carries the same (fb, fc, fs, m) — computed in-trace by
+    # train_ingest_factors, so no cross-row reduction happens in-kernel)
+    x = x_ref[...].astype(jnp.float32) / 255.0
+    fb = s_ref[:, 0:1]
+    fc = s_ref[:, 1:2]
+    fs = s_ref[:, 2:3]
+    m = s_ref[:, 3:4]
+    x = x * fb                     # brightness
+    x = (x - m) * fc + m           # contrast about the per-image mean
+    gray = jnp.dot(x, g_ref[...], preferred_element_type=jnp.float32)
+    x = gray + (x - gray) * fs     # saturation toward per-pixel gray
+    x = jnp.clip(x, 0.0, 1.0)
+    out_ref[...] = (x - mean_ref[...]) / std_ref[...]
+
+
+def train_ingest_factors(x, rng, brightness: float = 0.2,
+                         contrast: float = 0.2, saturation: float = 0.2):
+    # dvtlint: traced
+    """Per-image jitter scalars (B, 4) = [fb, fc, fs, m] for the fused
+    train-ingest kernel — the SAME rng split order and draw shapes as
+    ops/preprocess.jitter_normalize, so both paths consume identical
+    random factors from one key.  ``m`` is the post-brightness image mean
+    the contrast op pivots about: brightness is a pure scale, so
+    ``mean(fb·x) == fb·mean(x)`` and the (B,)-output mean over the uint8
+    input is the only extra HBM pass the fused path pays."""
+    b = x.shape[0]
+    kb, kc, ks = jax.random.split(rng, 3)
+    fb = jax.random.uniform(kb, (b, 1, 1, 1),
+                            minval=max(0.0, 1 - brightness),
+                            maxval=1 + brightness).reshape(b)
+    fc = jax.random.uniform(kc, (b, 1, 1, 1),
+                            minval=max(0.0, 1 - contrast),
+                            maxval=1 + contrast).reshape(b)
+    fs = jax.random.uniform(ks, (b, 1, 1, 1),
+                            minval=max(0.0, 1 - saturation),
+                            maxval=1 + saturation).reshape(b)
+    m = fb * jnp.mean(x.astype(jnp.float32) / 255.0, axis=(1, 2, 3))
+    return jnp.stack([fb, fc, fs, m], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("kind", "interpret"))
+def train_ingest(x, factors, kind: str = "imagenet",
+                 interpret: bool = False):
+    """uint8 NHWC train batch + (B, 4) jitter factors → jittered,
+    normalized float32 — ``serve_ingest`` extended with the train-time
+    color-jitter chain (brightness → contrast → saturation → clip) fused
+    into the same single VMEM pass, so the f32 HWC intermediate the XLA
+    ``jitter_normalize`` materializes in HBM between ops never exists.
+
+    Same (B·H, W·C) row view as ``serve_ingest``; the per-image factor
+    quadruple is repeated per row (every row of image i shares it) and
+    saturation's per-pixel gray is a matmul against a prebaked
+    block-diagonal matrix (no in-kernel reshape).  CPU tests run with
+    ``interpret=True``; real use goes through the per-shape parity gate
+    (``train_ingest_parity_ok``) with jitter_normalize as the fallback.
+    """
+    B, H, W, C = x.shape
+    mean_c, std_c = _ingest_norm_constants(kind, C)
+    rows, lanes = B * H, W * C
+    r_pad = (-rows) % INGEST_TILE_R
+    l_pad = (-lanes) % LANE
+    rows_p, lanes_p = rows + r_pad, lanes + l_pad
+    x2 = jnp.pad(x.reshape(rows, lanes), ((0, r_pad), (0, l_pad)))
+    s_rows = jnp.pad(jnp.repeat(factors.astype(jnp.float32), H, axis=0),
+                     ((0, r_pad), (0, 0)))
+    mean_row = np.pad(np.tile(mean_c, W), (0, l_pad))[None, :]
+    std_row = np.pad(np.tile(std_c, W), (0, l_pad),
+                     constant_values=1.0)[None, :]
+    out = pl.pallas_call(
+        _train_ingest_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows_p, lanes_p), jnp.float32),
+        grid=(rows_p // INGEST_TILE_R,),
+        in_specs=[
+            pl.BlockSpec((INGEST_TILE_R, lanes_p), lambda r: (r, 0)),
+            pl.BlockSpec((INGEST_TILE_R, 4), lambda r: (r, 0)),
+            pl.BlockSpec((1, lanes_p), lambda r: (0, 0)),
+            pl.BlockSpec((1, lanes_p), lambda r: (0, 0)),
+            pl.BlockSpec((lanes_p, lanes_p), lambda r: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((INGEST_TILE_R, lanes_p),
+                               lambda r: (r, 0)),
+        interpret=interpret,
+    )(x2, s_rows, jnp.asarray(mean_row, jnp.float32),
+      jnp.asarray(std_row, jnp.float32),
+      jnp.asarray(_gray_matrix(W, C, l_pad)))
+    return out[:rows, :lanes].reshape(B, H, W, C)
+
+
+def train_ingest_auto(x, factors, kind: str = "imagenet"):
+    """Pallas on TPU; interpret-mode elsewhere (tests, CPU dryruns)."""
+    on_tpu = jax.default_backend() == "tpu"
+    return train_ingest(x, factors, kind, interpret=not on_tpu)
+
+
+def train_ingest_sharded(x, factors, mesh, kind: str = "imagenet"):
+    """:func:`train_ingest_auto` under a sharded mesh — same shard_map
+    escape hatch as ``best_iou_max_sharded`` (``pallas_call`` has no
+    GSPMD rule; the jitter chain is per-image independent, and the
+    factors were drawn GLOBALLY before the shard_map so per-image
+    randomness matches the unsharded path bit-for-bit)."""
+    from jax.sharding import PartitionSpec as P
+
+    from deep_vision_tpu.parallel.mesh import DATA_AXIS
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    fn = functools.partial(train_ingest_auto, kind=kind)
+    spec = P(DATA_AXIS)
+    try:
+        wrapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec),
+                            out_specs=spec, check_vma=False)
+    except TypeError:  # older jax without check_vma
+        wrapped = shard_map(fn, mesh=mesh, in_specs=(spec, spec),
+                            out_specs=spec)
+    return wrapped(x, factors)
+
+
+_TRAIN_INGEST_PARITY_CACHE: dict[tuple, bool] = {}
+
+
+def train_ingest_parity_ok(shape: tuple, kind: str = "imagenet",
+                           brightness: float = 0.2, contrast: float = 0.2,
+                           saturation: float = 0.2,
+                           interpret: bool = False,
+                           tol: float = 1e-4) -> bool:
+    """One-batch parity check of the fused train-ingest kernel vs the XLA
+    ``jitter_normalize`` path, gated per (shape, kind, jitter params)
+    before the trainer's preprocess_fn selects the Pallas path (the PR 10
+    ``ingest_parity_ok`` pattern: Mosaic lowering is environment- and
+    shape-sensitive, so a compile failure or numeric divergence beyond
+    ``tol`` falls back to XLA — never a silent accuracy change)."""
+    from deep_vision_tpu.ops.preprocess import jitter_normalize
+
+    key = (tuple(shape), kind,
+           round(float(brightness), 6), round(float(contrast), 6),
+           round(float(saturation), 6))
+    if key in _TRAIN_INGEST_PARITY_CACHE and not interpret:
+        return _TRAIN_INGEST_PARITY_CACHE[key]
+    try:
+        B, H, W, C = shape
+        raw = np.random.RandomState(11).randint(0, 256, shape, np.uint8)
+        rng = jax.random.PRNGKey(23)
+        mean_c, std_c = _ingest_norm_constants(kind, C)
+        factors = train_ingest_factors(jnp.asarray(raw), rng,
+                                       brightness, contrast, saturation)
+        got = np.asarray(jax.device_get(
+            train_ingest(jnp.asarray(raw), factors, kind,
+                         interpret=interpret)))
+        want = np.asarray(jax.device_get(jitter_normalize(
+            jnp.asarray(raw), rng, True, mean=mean_c, std=std_c,
+            brightness=brightness, contrast=contrast,
+            saturation=saturation)))
+        err = float(np.abs(got - want).max())
+        ok = err <= tol
+        if not ok:
+            print(f"[pallas] train-ingest parity FAILED (max err {err:.2e})"
+                  " — falling back to the XLA jitter_normalize prologue")
+    except Exception as e:  # noqa: BLE001 — compile/runtime failure → XLA fallback
+        print(f"[pallas] train-ingest kernel unavailable "
+              f"({type(e).__name__}: {e}) — falling back to the XLA "
+              f"jitter_normalize prologue")
+        ok = False
+    if not interpret:
+        _TRAIN_INGEST_PARITY_CACHE[key] = ok
+    return ok
+
+
 def _best_iou_kernel(pred_ref, gt_ref, mask_ref, out_ref):
     # blocks carry the FULL batch (out tiling rule: the sublane dim of the
     # (B, N) output block must equal B); grid runs over N tiles only.

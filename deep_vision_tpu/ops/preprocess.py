@@ -164,18 +164,92 @@ def make_scale_preprocess():
 
 
 def make_imagenet_preprocess(brightness: float = 0.2, contrast: float = 0.2,
-                             saturation: float = 0.2):
+                             saturation: float = 0.2,
+                             use_fused: bool = False,
+                             fused_shape: tuple | None = None,
+                             mesh=None):
     """Trainer ``preprocess_fn``: applied to uint8 image batches inside the
-    jitted step; float batches (host-normalized path) pass through."""
+    jitted step; float batches (host-normalized path) pass through.
 
-    def fn(batch: dict, rng, train: bool) -> dict:
+    With ``use_fused`` and a concrete ``fused_shape`` (the global
+    (B, H, W, C) train batch), the train-time jitter chain goes through
+    the fused Pallas ``train_ingest`` kernel instead of the multi-op XLA
+    ``jitter_normalize`` — but only after the one-batch parity gate for
+    that exact shape passes (ops/pallas_ops.train_ingest_parity_ok); a
+    failed gate or kernel compile silently selects XLA, never a silent
+    accuracy change.  On a multi-device ``mesh`` the kernel runs under
+    shard_map per batch shard with globally-drawn factors.  The eval
+    path is always the plain normalize (no jitter — nothing to fuse).
+    """
+    fused = False
+    if use_fused and fused_shape is not None:
+        from deep_vision_tpu.ops.pallas_ops import train_ingest_parity_ok
+
+        on_tpu = jax.default_backend() == "tpu"
+        fused = train_ingest_parity_ok(
+            tuple(fused_shape), "imagenet", brightness, contrast,
+            saturation, interpret=not on_tpu)
+    multi = mesh is not None and mesh.devices.size > 1
+
+    # dvtlint: hot
+    def fn(batch: dict, rng, train: bool) -> dict:  # dvtlint: traced
         img = batch["image"]
         if img.dtype != jnp.uint8:
             return batch
         out = dict(batch)
-        out["image"] = jitter_normalize(
-            img, rng, train, brightness=brightness, contrast=contrast,
-            saturation=saturation)
+        if fused and train:
+            from deep_vision_tpu.ops.pallas_ops import (
+                train_ingest_auto, train_ingest_factors,
+                train_ingest_sharded)
+
+            factors = train_ingest_factors(img, rng, brightness, contrast,
+                                           saturation)
+            if multi:
+                out["image"] = train_ingest_sharded(img, factors, mesh)
+            else:
+                out["image"] = train_ingest_auto(img, factors)
+        else:
+            out["image"] = jitter_normalize(
+                img, rng, train, brightness=brightness, contrast=contrast,
+                saturation=saturation)
+        return out
+
+    fn.fused = fused  # introspectable: tests + CLI log which path won
+    return fn
+
+
+def make_mnist_preprocess():
+    """Trainer ``preprocess_fn`` for the grayscale classification path:
+    uint8 wire batches (data/mnist.load_mnist ``device_normalize=True``)
+    standardize with the MNIST stats inside the jitted step — the H2D
+    carried 1 byte/pixel and XLA fuses the normalize into the first
+    conv's read; float batches (host-normalized) pass through."""
+
+    def fn(batch: dict, rng, train: bool) -> dict:  # dvtlint: traced
+        img = batch["image"]
+        if img.dtype != jnp.uint8:
+            return batch
+        out = dict(batch)
+        out["image"] = serve_normalize(img, "mnist")
+        return out
+
+    return fn
+
+
+def make_gan_preprocess():
+    """Trainer ``preprocess_fn`` for the GAN tasks (DCGAN/CycleGAN): the
+    reference pipelines ship float32 in [-1, 1] (``(x - 127.5)/127.5``);
+    the uint8 wire defers exactly that scaling to a traced prologue, so
+    the host batches, prefetch queue, and H2D DMA carry 1 byte/pixel.
+    Applies to every ``image*`` key (``image``, ``image_a``, ``image_b``
+    — the unpaired loader carries two domains); float keys and non-image
+    keys (pooled fakes, masks) pass through untouched."""
+
+    def fn(batch: dict, rng, train: bool) -> dict:  # dvtlint: traced
+        out = dict(batch)
+        for key, val in batch.items():
+            if key.startswith("image") and val.dtype == jnp.uint8:
+                out[key] = val.astype(jnp.float32) / 127.5 - 1.0
         return out
 
     return fn

@@ -80,6 +80,9 @@ class DCGANTask:
     # no host state between steps → the AdversarialTrainer may scan K
     # steps per dispatch (core/adversarial.py train_multi)
     scan_safe = True
+    # host_prepare is stateless (identity) → batches may be staged ahead
+    # by the DevicePrefetcher (core/adversarial.py _epoch_steps)
+    prefetch_safe = True
 
     def __init__(self, generator, discriminator, latent_dim: int = 100,
                  opt: OptimizerConfig | None = None):
@@ -159,6 +162,9 @@ class CycleGANTask:
     # the per-step host ImagePool exchange (host_prepare/host_update)
     # is semantic — scanning would replay stale pools, so: per-step
     scan_safe = False
+    # same hazard for the staged DevicePrefetcher: host_prepare draws
+    # from the pool, so batches staged ahead would see it stale
+    prefetch_safe = False
 
     LAMBDA_CYCLE = 10.0  # train.py:16
     LAMBDA_ID = 5.0      # train.py:17

@@ -1,0 +1,112 @@
+"""`make input-smoke` (runs inside `make serve-smoke`): the staged
+train-input pipeline end to end on whatever backend is present —
+uint8 batches through a DevicePrefetcher into a donated jitted step
+for two epochs (identical losses: donation never exposes a clobbered
+buffer), the uint8-vs-float32 wire showing exactly 4x fewer image H2D
+bytes, the fused Pallas train-ingest parity gate selecting a path and
+matching the XLA jitter chain on the same batch, and a clean close()
+— producer thread gone, staging-pool allocation bounded by depth.
+Run directly, not under pytest."""
+
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+# plain script (not pytest): make the repo root importable when invoked
+# as `python tests/input_smoke.py` from the checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from deep_vision_tpu.data.pipeline import DevicePrefetcher  # noqa: E402
+from deep_vision_tpu.ops import preprocess  # noqa: E402
+from deep_vision_tpu.parallel import make_mesh  # noqa: E402
+
+BATCH, SIZE, STEPS, DEPTH = 8, 32, 10, 2
+
+
+def batches(dtype):
+    rng = np.random.default_rng(0)
+    for _ in range(STEPS):
+        img = rng.integers(0, 256, (BATCH, SIZE, SIZE, 3), dtype=np.uint8)
+        lbl = rng.integers(0, 10, (BATCH,), dtype=np.int32)
+        if dtype == np.float32:
+            img = img.astype(np.float32) / 255.0
+        yield {"image": img, "label": lbl}
+
+
+def main():
+    mesh = make_mesh({"data": 1}, devices=jax.devices()[:1])
+
+    def loss_of(batch):
+        x = batch["image"]
+        if x.dtype == jnp.uint8:
+            x = x.astype(jnp.float32) / 255.0
+        return jnp.sum(x * x) + jnp.sum(batch["label"])
+
+    step = jax.jit(loss_of, donate_argnums=(0,))
+
+    # -- donation safety: two identical epochs, identical losses --------
+    pf = DevicePrefetcher(mesh, depth=DEPTH)
+    per_epoch, epoch_stats = [], []
+    for _ in range(2):
+        stream = pf.iterate(batches(np.uint8))
+        per_epoch.append([float(step(b)) for b in stream])
+        epoch_stats.append(stream.stats())
+    assert per_epoch[0] == per_epoch[1], \
+        f"donated epochs diverged: {per_epoch[0][:3]} vs {per_epoch[1][:3]}"
+    u8 = epoch_stats[-1]  # stats are per-epoch; the pool persists
+    assert u8["batches"] == STEPS
+    # staging allocation is bounded by depth, not epoch length
+    assert u8["pool"]["allocated"] <= (DEPTH + 2) * 2, u8["pool"]
+    assert u8["pool"]["reused"] > 0, u8["pool"]
+    print(f"[input-smoke] u8 wire: {u8['batches']} batches, "
+          f"stall {u8['input_stall_frac']:.2f}, "
+          f"h2d {u8['h2d_bytes_per_step']} B/step, pool {u8['pool']}")
+
+    # -- wire comparison: uint8 images move exactly 4x fewer bytes ------
+    f32 = DevicePrefetcher(mesh, depth=DEPTH)
+    for b in f32.iterate(batches(np.float32)):
+        jax.block_until_ready(b)
+    s32 = f32.stats()
+    ratio = (s32["h2d_bytes_by_key"]["image"]
+             / u8["h2d_bytes_by_key"]["image"])
+    assert ratio == 4.0, f"f32/u8 image H2D ratio {ratio} != 4.0"
+    print(f"[input-smoke] image H2D f32/u8 ratio {ratio} (exact)")
+
+    # -- fused train-ingest: gate decides, output matches XLA chain -----
+    shape = (BATCH, SIZE, SIZE, 3)
+    fused_fn = preprocess.make_imagenet_preprocess(
+        use_fused=True, fused_shape=shape, mesh=mesh)
+    xla_fn = preprocess.make_imagenet_preprocess()
+    img = np.random.default_rng(1).integers(0, 256, shape, dtype=np.uint8)
+    rng = jax.random.PRNGKey(7)
+    out_f = fused_fn({"image": jnp.asarray(img)}, rng, train=True)["image"]
+    out_x = xla_fn({"image": jnp.asarray(img)}, rng, train=True)["image"]
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_x),
+                               rtol=1e-4, atol=1e-4)
+    print(f"[input-smoke] train ingest: "
+          f"{'fused pallas' if fused_fn.fused else 'xla'} "
+          f"(parity vs XLA jitter chain OK)")
+
+    # -- close(): producer threads gone, nothing left running ----------
+    pf.close()
+    f32.close()
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if not any(t.name.startswith("dvt-prefetch") for t in
+                   threading.enumerate()):
+            break
+        time.sleep(0.05)
+    leaked = [t.name for t in threading.enumerate()
+              if t.name.startswith("dvt-prefetch")]
+    assert not leaked, f"producer threads leaked: {leaked}"
+    print("[input-smoke] OK")
+
+
+if __name__ == "__main__":
+    main()
