@@ -48,6 +48,7 @@ serve-smoke: lint lint-test
 	$(PY) tests/deploy_smoke.py
 	$(PY) tests/gateway_smoke.py
 	$(PY) tests/obs_smoke.py
+	$(PY) tests/mesh_smoke.py
 
 # the async HTTP edge end to end over real sockets: keep-alive reuse
 # visible in the connection counters, a content-addressed cache hit
@@ -127,6 +128,19 @@ obs-smoke:
 obs-test:
 	$(PY) -m pytest tests/test_obs.py -q -m obs
 
+# the 2-D data×model mesh wiring end to end: cli.serve with a forced
+# 2×2 mesh over 4 virtual host devices, fault-injected — 200s through
+# bisect-retry, mesh shape + per-chip shard bytes in healthz/stats
+# (strictly below the replicated footprint), and every /metrics line
+# parsed including dvt_serve_mesh_shape / dvt_serve_param_shard_bytes
+mesh-smoke:
+	$(PY) tests/mesh_smoke.py
+
+# the mesh unit suite alone (partition rules, strict tables, fallback
+# sharder, mesh-cell parity, per-chip pricing, sharded cache spill)
+mesh-test:
+	$(PY) -m pytest tests/test_mesh_serving.py -q -m mesh
+
 # the cross-host failover contract end to end: 2 backend serve
 # SUBPROCESSES behind the in-process gateway, fault-injected load
 # through the gateway, a real SIGKILL of one backend mid-run (zero
@@ -167,6 +181,13 @@ bench-serve-sync:
 # multi-chip hardware, routing overhead on a single shared device
 bench-serve-scaling:
 	$(PY) bench.py --serve --serve-devices 8
+
+# mesh-cell sweep: 1x1 / 4x1 / 1x4 / 2x2 data x model cells over 4
+# (forced) host devices — img/s, p99, and per-chip param_shard_bytes
+# per cell (docs/PERF.md "Mesh scaling"); the 1x4 cell must report
+# per-chip bytes strictly below the replicated footprint
+bench-serve-mesh:
+	$(PY) bench.py --serve-mesh 4
 
 # wire-format comparison: {float32, uint8} wire x {float32, bfloat16,
 # int8} compute — p50/p95/p99, img/s, H2D bytes/batch, and resident
@@ -225,9 +246,11 @@ list:
 	$(PY) -m deep_vision_tpu.cli.train --list -m x
 
 .PHONY: test test-all bench bench-serve bench-serve-sync \
-	bench-serve-scaling bench-serve-wire bench-gateway bench-deploy \
+	bench-serve-scaling bench-serve-mesh bench-serve-wire \
+	bench-gateway bench-deploy \
 	bench-input serve-smoke \
 	serve-multi serve-chaos gateway-smoke gateway-test obs-smoke \
 	edge-smoke edge-test input-smoke input-test \
 	obs-test model-smoke model-test quant-smoke quant-test \
+	mesh-smoke mesh-test \
 	deploy-smoke deploy-test lint lint-test list

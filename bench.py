@@ -507,6 +507,8 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
                 max_wait_ms: float = 2.0, pipeline_depth: int = 2,
                 faults: str = "", fault_seed: int = 0,
                 serve_devices: int = 1,
+                serve_mesh: tuple | None = None,
+                mesh_min_shard_dim: int = 1024,
                 wire_dtype: str = "float32",
                 infer_dtype: str = "float32",
                 calib_batches: int = 2,
@@ -536,6 +538,13 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
     routing counters; ``bench.py --serve --serve-devices N`` sweeps
     replica counts 1, 2, 4, ... N and emits the device-scaling table
     (docs/PERF.md).
+
+    ``serve_mesh=(D, M)`` instead builds ONE engine on a D×M
+    data×model mesh (registry ``for_mesh``): batches split D ways,
+    params shard M ways (first-divisible-axis fallback at
+    ``mesh_min_shard_dim``), and the JSON gains ``mesh`` /
+    ``param_shard_bytes`` / ``param_global_bytes`` — the per-chip HBM
+    column of the ``--serve-mesh`` sweep (``bench_serve_mesh``).
 
     ``wire_dtype``/``infer_dtype`` select the serving wire format and
     on-device compute dtype (docs/SERVING.md); the JSON records both
@@ -582,7 +591,21 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
         img = np.random.RandomState(0).randn(
             *sm.input_shape).astype(np.float32)
     tracer = Tracer(enabled=trace)
-    if serve_devices > 1:
+    if serve_mesh is not None:
+        from deep_vision_tpu.parallel.mesh import make_mesh
+        from deep_vision_tpu.serve.engine import sharded_buckets
+        from deep_vision_tpu.serve.replicas import local_devices
+
+        n_data, n_model = int(serve_mesh[0]), int(serve_mesh[1])
+        mesh = make_mesh({"data": n_data, "model": n_model},
+                         devices=local_devices(n_data * n_model))
+        engine_ctx = BatchingEngine(
+            sm.for_mesh(mesh, min_shard_dim=mesh_min_shard_dim),
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            buckets=sharded_buckets(max_batch, n_data),
+            pipeline_depth=pipeline_depth,
+            faults=FaultPlane(faults, fault_seed), tracer=tracer)
+    elif serve_devices > 1:
         from deep_vision_tpu.serve.replicas import (ReplicatedEngine,
                                                     local_devices)
 
@@ -711,6 +734,10 @@ def bench_serve(model_name: str = "lenet5", loads: tuple = (1, 8),
         out["stages"] = {"stage_ms_avg": tr.get("stage_ms_avg"),
                          "traces_finished": tr.get("finished"),
                          "slow_sampled": tr.get("slow_sampled")}
+    if serve_mesh is not None:
+        out["mesh"] = stats.get("mesh_shape")
+        out["param_shard_bytes"] = stats.get("param_shard_bytes")
+        out["param_global_bytes"] = stats.get("param_global_bytes")
     if "replicas" in stats:
         out["serve_devices"] = serve_devices
         out["replicas"] = [
@@ -751,6 +778,46 @@ def bench_serve_scaling(serve_devices: int, **kwargs) -> dict:
     for row in table:
         row["speedup_vs_1"] = round(row["img_per_sec"] / base, 2)
     last["scaling"] = table
+    return last
+
+
+def bench_serve_mesh(mesh_devices: int = 4,
+                     mesh_min_shard_dim: int = 64, **kwargs) -> dict:
+    """Mesh-cell sweep (``bench.py --serve-mesh N``; docs/PERF.md
+    "Mesh scaling"): the serve bench across the 1×1 baseline, the pure
+    data-parallel N×1, the pure model-parallel 1×N, and the squarest
+    2-D D×M factorization of N — img/s, p99, and per-chip
+    ``param_shard_bytes`` per cell, so the throughput cost and HBM
+    saving of each layout are measured side by side.  On forced host
+    devices the throughput columns measure GSPMD partitioning overhead
+    on one shared chip (the HBM column is layout-true everywhere);
+    real ICI separates the cells.  ``mesh_min_shard_dim`` defaults low
+    (64) so the zoo's small models actually shard — production keeps
+    the registry's 1024 floor."""
+    n = int(mesh_devices)
+    cells = [(1, 1), (n, 1), (1, n)]
+    d = max((k for k in range(2, n) if n % k == 0 and k * k <= n),
+            default=None)
+    if d is not None:
+        cells.append((max(d, n // d), min(d, n // d)))
+    table, last = [], None
+    for n_data, n_model in cells:
+        last = bench_serve(serve_mesh=(n_data, n_model),
+                           mesh_min_shard_dim=mesh_min_shard_dim,
+                           **kwargs)
+        top = last["loads"][-1]
+        shard = last.get("param_shard_bytes")
+        glob = last.get("param_global_bytes")
+        table.append({
+            "mesh": f"{n_data}x{n_model}",
+            "img_per_sec": top["img_per_sec"],
+            "p50_ms": top["p50_ms"], "p99_ms": top["p99_ms"],
+            "errors": top["errors"],
+            "param_shard_bytes": shard,
+            "param_global_bytes": glob,
+            "hbm_frac_of_replicated": round(shard / glob, 4)
+            if shard and glob else None})
+    last["mesh_sweep"] = table
     return last
 
 
@@ -2341,6 +2408,13 @@ def main():
                         "counts 1, 2, 4, ... N and emit the scaling "
                         "table (img/s + p99 per count) plus the "
                         "per-replica block of the widest run")
+    p.add_argument("--serve-mesh", type=int, default=0,
+                   help="mesh-cell sweep over N devices: 1×1 baseline, "
+                        "N×1 data-parallel, 1×N model-parallel, and "
+                        "the squarest 2-D data×model cell — img/s, "
+                        "p99, per-chip param_shard_bytes per cell "
+                        "(docs/PERF.md \"Mesh scaling\"); forces N "
+                        "host devices when the platform exposes fewer")
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="measure the train step with the params-EMA "
                         "update in it (the Trainer's --ema-decay)")
@@ -2429,7 +2503,7 @@ def main():
             pipeline_depth=args.serve_pipeline_depth,
             backends=args.gateway_backends)))
         return
-    if args.serve:
+    if args.serve or args.serve_mesh:
         serve_kwargs = dict(
             model_name=args.serve_model,
             loads=tuple(int(c) for c in args.serve_loads.split(",")),
@@ -2437,7 +2511,20 @@ def main():
             pipeline_depth=args.serve_pipeline_depth,
             faults=args.faults, fault_seed=args.fault_seed,
             trace=not args.serve_no_trace)
-        if args.serve_obs:
+        if args.serve_mesh:
+            # the sweep needs N addressable devices — force host
+            # devices before the backend initializes (the --deploy
+            # trick), honoring an operator-set XLA_FLAGS
+            import os
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "--xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{args.serve_mesh}").strip()
+            print(json.dumps(bench_serve_mesh(
+                args.serve_mesh, wire_dtype=args.wire_dtype,
+                infer_dtype=args.infer_dtype, **serve_kwargs)))
+        elif args.serve_obs:
             print(json.dumps(bench_serve_obs(**serve_kwargs)))
         elif args.serve_wire:
             print(json.dumps(bench_serve_wire(**serve_kwargs)))

@@ -75,6 +75,23 @@ def _edge_kwargs(args):
         qos=TenantQoS.parse(qos_spec) if qos_spec else None)
 
 
+def _parse_mesh_arg(spec: str) -> tuple[int, int]:
+    """``--mesh D,M`` (data,model) → (D, M); a single value N means
+    N,1 — pure batch sharding, same as --shard-batches over N."""
+    parts = [s.strip() for s in str(spec).split(",") if s.strip()]
+    try:
+        sizes = [int(s) for s in parts]
+    except ValueError:
+        sizes = []
+    if len(sizes) == 1:
+        sizes.append(1)
+    if len(sizes) != 2 or any(n < 1 for n in sizes):
+        raise ValueError(
+            f"--mesh '{spec}': expected 'data,model' positive axis "
+            "sizes (e.g. '2,2', '4,1', '1,4')")
+    return sizes[0], sizes[1]
+
+
 def build_server(args):
     """argparse namespace → (engine, ServeServer); shared with the smoke
     test so `make serve-smoke` boots exactly the production wiring.
@@ -85,7 +102,11 @@ def build_server(args):
     the single-engine path byte-for-byte); ``--shard-batches`` instead
     builds ONE engine whose padded batches span the data axis of a mesh
     over those devices (mutually exclusive by construction — replication
-    parallelizes many small batches, sharding one large batch)."""
+    parallelizes many small batches, sharding one large batch);
+    ``--mesh D,M`` generalizes to a 2-D data×model mesh — batches split
+    D ways while the partition rules (``--partition-rules``) lay the
+    params over the M-chip model axis (docs/SERVING.md "2-D mesh
+    serving")."""
     from deep_vision_tpu.obs.trace import Tracer
     from deep_vision_tpu.serve.admission import AdmissionController
     from deep_vision_tpu.serve.engine import BatchingEngine, sharded_buckets
@@ -139,7 +160,24 @@ def build_server(args):
         if fault_spec else None  # None → engine reads DVT_SERVE_FAULTS
     serve_devices = int(getattr(args, "serve_devices", 1))
     shard_batches = bool(getattr(args, "shard_batches", False))
-    if shard_batches:
+    mesh_arg = getattr(args, "mesh", None)
+    if mesh_arg and shard_batches:
+        raise ValueError("--mesh subsumes --shard-batches (a D×1 mesh "
+                         "IS batch sharding); pass one")
+    if mesh_arg:
+        n_data, n_model = _parse_mesh_arg(mesh_arg)
+        try:
+            devices = local_devices(n_data * n_model)
+        except ValueError:
+            # re-raise under the flag the operator actually typed
+            import jax
+
+            raise ValueError(
+                f"--mesh {n_data},{n_model} needs "
+                f"{n_data * n_model} device(s); only "
+                f"{len(jax.local_devices())} local device(s) present "
+                f"— shrink an axis or add hosts") from None
+    elif shard_batches:
         # shard over N devices (0/1 → every local device)
         devices = local_devices(serve_devices if serve_devices > 1
                                 else None)
@@ -167,7 +205,31 @@ def build_server(args):
         dead_after=getattr(args, "dead_after", 5),
         admission=AdmissionController(max_queue=args.max_queue,
                                       max_wait_ms=args.max_wait_ms))
-    if shard_batches:
+    if mesh_arg:
+        # 2-D data×model serving: batches split over ``data``, params
+        # laid out over ``model`` by the partition rules — buckets key
+        # off the DATA-axis size only (docs/SERVING.md "2-D mesh
+        # serving")
+        from deep_vision_tpu.parallel.mesh import make_mesh
+        from deep_vision_tpu.parallel.partition import (
+            parse_partition_rules,
+        )
+
+        mesh = make_mesh({"data": n_data, "model": n_model},
+                         devices=devices)
+        rules_arg = getattr(args, "partition_rules", None)
+        rules = parse_partition_rules(rules_arg) if rules_arg else None
+        if engine_kwargs["buckets"] is None:
+            engine_kwargs["buckets"] = sharded_buckets(
+                args.max_batch, n_data)
+        engine = BatchingEngine(
+            sm.for_mesh(mesh, partition_rules=rules,
+                        strict=bool(getattr(args, "partition_strict",
+                                            False)),
+                        min_shard_dim=int(getattr(
+                            args, "partition_min_dim", 1024) or 1024)),
+            **engine_kwargs)
+    elif shard_batches:
         from deep_vision_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh({"data": len(devices)}, devices=devices)
@@ -233,6 +295,10 @@ def _build_plane_server(args, registry, wire_dtype: str,
     if getattr(args, "shard_batches", False):
         raise ValueError("--shard-batches is single-model only; "
                          "--models replicates per engine instead "
+                         "(--serve-devices N)")
+    if getattr(args, "mesh", None):
+        raise ValueError("--mesh is single-model only; --models "
+                         "replicates per engine instead "
                          "(--serve-devices N)")
     min_replicas = int(getattr(args, "min_replicas", 0) or 0)
     max_replicas = int(getattr(args, "max_replicas", 0) or 0)
@@ -444,6 +510,31 @@ def main(argv=None):
                         "--serve-devices devices (0/1 = all) — one "
                         "logical big batch uses every chip; buckets "
                         "become multiples of the device count")
+    p.add_argument("--mesh", default=None,
+                   help="2-D data×model serving mesh as 'D,M' axis "
+                        "sizes (needs D×M local devices): batches "
+                        "split D ways over data, params shard M ways "
+                        "over model per --partition-rules; buckets "
+                        "become multiples of D (subsumes "
+                        "--shard-batches: 'N,1' is pure batch "
+                        "sharding)")
+    p.add_argument("--partition-rules", default=None,
+                   help="how --mesh lays params over the model axis: "
+                        "a built-in table name ('classifier', 'gan') "
+                        "or ';'-separated regex=axes entries matched "
+                        "against /-joined param paths, e.g. "
+                        "'head/kernel=-,model;.*=' (default: shard "
+                        "the first dim ≥1024 divisible by the model "
+                        "axis, replicate the rest)")
+    p.add_argument("--partition-strict", action="store_true",
+                   help="every param leaf must match exactly one "
+                        "--partition-rules entry (layout drift fails "
+                        "at load, not silently at runtime)")
+    p.add_argument("--partition-min-dim", type=int, default=1024,
+                   help="fallback sharder only touches dims >= this "
+                        "(small leaves replicate — sharding them "
+                        "trades ICI latency for no HBM win); lower "
+                        "it for small test models")
     p.add_argument("--warmup", action="store_true",
                    help="compile every bucket before accepting traffic")
     p.add_argument("--verbose", action="store_true",
@@ -633,7 +724,18 @@ def main(argv=None):
               + ", ".join(r.model.placement_desc() or "default"
                           for r in engine.replicas))
     elif getattr(engine.model, "placement", None) is not None:
-        print(f"[serve] sharded batches: {engine.model.placement_desc()}")
+        mesh_shape = engine.model.mesh_shape() \
+            if hasattr(engine.model, "mesh_shape") else None
+        if mesh_shape and mesh_shape.get("model", 1) > 1:
+            print(f"[serve] 2-D mesh "
+                  f"{mesh_shape.get('data', 1)}×"
+                  f"{mesh_shape.get('model', 1)} data×model: "
+                  f"{engine.model.placement_desc()}; per-chip params "
+                  f"{engine.model.param_bytes():,} B of "
+                  f"{engine.model.param_global_bytes():,} B logical")
+        else:
+            print("[serve] sharded batches: "
+                  f"{engine.model.placement_desc()}")
     if engine.faults.enabled:
         print(f"[serve] FAULT INJECTION ACTIVE: '{engine.faults.spec}' "
               f"(seed {engine.faults.seed})")

@@ -60,7 +60,10 @@ def peak_flops_per_s(device_kind: str | None = None) -> float:
 def compiled_flops(compiled) -> float | None:
     """FLOPs of one executable per XLA's cost analysis (honest MFU
     numerator — no hand-derived constants); None when the backend
-    doesn't report it."""
+    doesn't report it.  On a GSPMD-sharded executable the analysis
+    covers ONE partition's program — per-shard FLOPs — which is exactly
+    the per-chip numerator the meter wants against its per-chip peak
+    (a 2×2 mesh running 4 shards shows the same MFU each chip does)."""
     try:
         cost = compiled.cost_analysis()
         ca = cost[0] if isinstance(cost, (list, tuple)) else cost
@@ -69,7 +72,8 @@ def compiled_flops(compiled) -> float | None:
         return None
 
 
-def params_flops_lower_bound(variables, batch: int) -> float:
+def params_flops_lower_bound(variables, batch: int,
+                             devices: int = 1) -> float:
     """The documented fallback: 2 × param count × batch (one
     multiply-add per weight per image — exact for dense layers, a lower
     bound for convolutions, which reuse each weight spatially).
@@ -77,7 +81,12 @@ def params_flops_lower_bound(variables, batch: int) -> float:
     Counts float leaves AND int8 leaves: a quantized variables tree
     (serve/quant.py) stores its conv/dense kernels as int8, but each
     dequantized weight still does one MAC per image — excluding them
-    would collapse the int8 serving-MFU numerator to biases+scales."""
+    would collapse the int8 serving-MFU numerator to biases+scales.
+
+    ``devices`` keeps the per-chip semantics on mesh views: the global
+    2·params·batch work divides across the mesh, matching what
+    ``compiled_flops`` reports for one partition of a sharded
+    executable (the meter's peak is per chip)."""
     import jax
     import numpy as np
 
@@ -89,7 +98,7 @@ def params_flops_lower_bound(variables, batch: int) -> float:
 
     n = sum(int(np.prod(a.shape))
             for a in jax.tree_util.tree_leaves(variables) if _counts(a))
-    return 2.0 * n * batch
+    return 2.0 * n * batch / max(1, int(devices))
 
 
 def round_mfu(mfu: float | None) -> float | None:

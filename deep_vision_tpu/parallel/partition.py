@@ -1,47 +1,265 @@
-"""Parameter partitioning rules for the ``model`` mesh axis (tensor
-parallelism).
+"""Parameter partitioning for the ``model`` mesh axis (tensor parallelism).
 
 The reference has no tensor parallelism at all (SURVEY §2.5 — its only
 strategy is single-host data parallelism), so this is TPU-native headroom,
-not a port: wide trailing dimensions (the ImageNet classifier head, late-stage
-2048-channel convs, GAN projection layers) shard over ``model``; everything
-else replicates.  GSPMD then inserts the all-gathers/reduce-scatters over ICI.
+not a port.  Two mechanisms, layered:
+
+  * **Regex rule tables** (``match_partition_rules``): an ordered list of
+    ``(regex, PartitionSpec)`` pairs matched with ``re.search`` against
+    each leaf's ``/``-joined path (``params/head/kernel``).  First match
+    wins; ``strict=True`` additionally demands every leaf match EXACTLY
+    one rule — the reviewable, exact-layout mode for production models.
+    Per-model tables for the zoo's wide layers (the ImageNet classifier
+    head, late 2048-channel convs, GAN projections) live in
+    ``RULE_TABLES`` / ``rules_for``.
+  * **First-divisible-axis fallback** (``first_divisible_spec``): when no
+    table is given, shard the FIRST dim — scanning trailing→leading, so
+    output features keep priority — whose size is ≥ ``min_shard_dim``
+    and divisible by the ``model`` axis.  A leaf whose trailing dim is
+    large but indivisible is no longer silently replicated: an earlier
+    divisible dim is sharded instead, and anything left fully replicated
+    above the threshold is LOGGED (no silent caps).
+
+Everything else replicates; GSPMD then inserts the all-gathers /
+reduce-scatters over ICI.  ``serve/registry.for_mesh`` consumes the
+resulting sharding pytree to lay serving weights across a 2-D
+``data × model`` mesh (docs/SERVING.md "2-D mesh serving").
 """
 
 from __future__ import annotations
 
-from typing import Any
+import re
+from typing import Any, Sequence
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deep_vision_tpu.obs.log import event, get_logger
 from deep_vision_tpu.parallel.mesh import MODEL_AXIS
 
+_log = get_logger("dvt.parallel.partition")
 
-def param_partition_spec(params: Any, mesh: Mesh, min_shard_dim: int = 1024
-                         ) -> Any:
-    """PartitionSpec pytree: shard a kernel's trailing (output-feature) dim
-    over ``model`` when it is large and divisible; replicate the rest."""
-    n_model = mesh.shape.get(MODEL_AXIS, 1)
 
-    def spec(x):
-        if (n_model > 1 and hasattr(x, "ndim") and x.ndim >= 2
-                and x.shape[-1] >= min_shard_dim
-                and x.shape[-1] % n_model == 0):
-            return P(*([None] * (x.ndim - 1)), MODEL_AXIS)
+def leaf_paths(tree: Any) -> list[tuple[str, Any]]:
+    """``/``-joined leaf names paired with leaves, in tree-flatten order
+    (``params/Dense_0/kernel``) — the namespace the rule regexes match."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        parts = []
+        for k in path:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        out.append(("/".join(parts), leaf))
+    return out
+
+
+def _is_scalar(leaf) -> bool:
+    shape = getattr(leaf, "shape", ())
+    return len(shape) == 0 or int(np.prod(shape)) == 1
+
+
+def match_partition_rules(rules: Sequence[tuple[str, P]], params: Any,
+                          *, strict: bool = False) -> Any:
+    """Map an ordered ``(regex, PartitionSpec)`` table over ``params``.
+
+    Each leaf's ``/``-joined path is matched with ``re.search``.  Scalars
+    (and 1-element leaves) always replicate — no rule needed.  Default:
+    first match wins, an unmatched leaf replicates.  ``strict=True`` is
+    the exact-layout contract: every non-scalar leaf must match EXACTLY
+    one rule — zero matches or an overlap raise ``ValueError`` naming
+    the leaf and the offending rules, so a table that drifted from the
+    checkpoint layout fails loudly at load, not silently at runtime.
+    """
+    compiled = [(re.compile(pat), pat, spec) for pat, spec in rules]
+    specs = []
+    for name, leaf in leaf_paths(params):
+        if _is_scalar(leaf):
+            specs.append(P())
+            continue
+        hits = [(pat, spec) for rx, pat, spec in compiled
+                if rx.search(name)]
+        if strict and len(hits) != 1:
+            if not hits:
+                raise ValueError(
+                    f"strict partition rules: leaf '{name}' "
+                    f"{tuple(getattr(leaf, 'shape', ()))} matches no rule "
+                    f"(table: {[pat for _, pat, _ in compiled]})")
+            raise ValueError(
+                f"strict partition rules: leaf '{name}' matches "
+                f"{len(hits)} rules {[pat for pat, _ in hits]} — each "
+                f"leaf must match exactly one")
+        specs.append(hits[0][1] if hits else P())
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(treedef, specs)
+
+
+def first_divisible_spec(shape: tuple, n_model: int,
+                         min_shard_dim: int = 1024) -> P:
+    """The fallback sharder: shard the first dim (trailing→leading, so
+    output features keep priority) that is ≥ ``min_shard_dim`` AND
+    divisible by the ``model`` axis; replicate when none qualifies."""
+    if n_model <= 1 or len(shape) < 2:
         return P()
+    for dim in reversed(range(len(shape))):
+        if shape[dim] >= min_shard_dim and shape[dim] % n_model == 0:
+            spec = [None] * len(shape)
+            spec[dim] = MODEL_AXIS
+            return P(*spec)
+    return P()
 
-    return jax.tree_util.tree_map(spec, params)
+
+def param_partition_spec(params: Any, mesh: Mesh,
+                         min_shard_dim: int = 1024,
+                         rules: Sequence[tuple[str, P]] | None = None,
+                         strict: bool = False) -> Any:
+    """PartitionSpec pytree for ``params`` on ``mesh``.
+
+    With ``rules``, the regex table decides (``match_partition_rules``).
+    Without, the first-divisible-axis fallback shards wide leaves over
+    ``model``.  Either way, every big leaf (≥ ``min_shard_dim`` trailing
+    dim) left fully replicated is logged with its shape and the reason —
+    replicated HBM is a capacity decision the operator should see, never
+    a silent cap."""
+    n_model = mesh.shape.get(MODEL_AXIS, 1)
+    if rules is not None:
+        specs = match_partition_rules(rules, params, strict=strict)
+    else:
+        treedef = jax.tree_util.tree_structure(params)
+        specs = jax.tree_util.tree_unflatten(
+            treedef,
+            [first_divisible_spec(tuple(getattr(leaf, "shape", ())),
+                                  n_model, min_shard_dim)
+             for _, leaf in leaf_paths(params)])
+    if n_model > 1:
+        left_replicated = [
+            (name, tuple(leaf.shape))
+            for (name, leaf), (_, spec) in zip(leaf_paths(params),
+                                               leaf_paths(specs))
+            if spec == P() and not _is_scalar(leaf)
+            and max(leaf.shape) >= min_shard_dim]
+        for name, shape in left_replicated:
+            event(_log, "partition_replicated", leaf=name,
+                  shape=list(shape), model_axis=n_model,
+                  reason="no dim >= min_shard_dim divisible by the "
+                         "model axis" if rules is None
+                  else "rule table replicates it")
+    return specs
 
 
-def param_shardings(params: Any, mesh: Mesh, min_shard_dim: int = 1024) -> Any:
+def param_shardings(params: Any, mesh: Mesh, min_shard_dim: int = 1024,
+                    rules: Sequence[tuple[str, P]] | None = None,
+                    strict: bool = False) -> Any:
+    """NamedSharding pytree (same structure as ``params``)."""
     return jax.tree_util.tree_map(
         lambda s: NamedSharding(mesh, s),
-        param_partition_spec(params, mesh, min_shard_dim),
+        param_partition_spec(params, mesh, min_shard_dim,
+                             rules=rules, strict=strict),
         is_leaf=lambda x: isinstance(x, P))
 
 
-def shard_params(params: Any, mesh: Mesh, min_shard_dim: int = 1024) -> Any:
+def shard_variables(tree: Any, shardings: Any) -> Any:
+    """Place a host variables pytree according to a sharding pytree.
+
+    Single-process: one ``device_put`` (jax accepts a pytree of
+    shardings).  Multi-process pods build each global array from the
+    (identical) host value via ``make_array_from_callback`` — every
+    process holds the full restore, so each addressable shard slices
+    its piece locally, no cross-host transfer."""
+    if jax.process_count() > 1:
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.make_array_from_callback(
+                np.asarray(x).shape, s,
+                lambda idx, x=x: np.asarray(x)[idx]),
+            tree, shardings)
+    return jax.device_put(tree, shardings)
+
+
+def shard_params(params: Any, mesh: Mesh, min_shard_dim: int = 1024,
+                 rules: Sequence[tuple[str, P]] | None = None,
+                 strict: bool = False) -> Any:
     """device_put params according to the partition rules."""
-    return jax.tree_util.tree_map(
-        jax.device_put, params, param_shardings(params, mesh, min_shard_dim))
+    return shard_variables(
+        params, param_shardings(params, mesh, min_shard_dim,
+                                rules=rules, strict=strict))
+
+
+#: Per-model-family rule tables for the zoo's wide layers.  Regexes
+#: target Flax param paths (``params/<module>/kernel``).  The tables
+#: shard output-feature dims over ``model``; the catch-all replicate
+#: rule covers norm/bias/BN stats under first-match-wins.  These are
+#: NON-STRICT tables: the catch-all overlaps every specific rule, so
+#: ``strict=True`` (exactly-one-match) rejects them by construction —
+#: a strict production table must be written disjoint.
+RULE_TABLES: dict[str, list[tuple[str, P]]] = {
+    # ImageNet-style classifiers (ResNet/VGG/LeNet...): the classifier
+    # head's output dim (1000-way) and the late wide convs / dense
+    # layers carry most of the bytes — shard their trailing dim
+    "classifier": [
+        (r"(head|classifier|logits|fc\d*|Dense_\d+)/kernel$",
+         P(None, MODEL_AXIS)),
+        (r"conv.*/kernel$", P(None, None, None, MODEL_AXIS)),
+        (r".*", P()),
+    ],
+    # GANs (DCGAN/CycleGAN): the generator's latent projection and the
+    # discriminator's final dense are the wide matmuls
+    "gan": [
+        (r"(proj|project|Dense_\d+|fc\d*)/kernel$",
+         P(None, MODEL_AXIS)),
+        (r"(Conv|ConvTranspose).*/kernel$",
+         P(None, None, None, MODEL_AXIS)),
+        (r".*", P()),
+    ],
+}
+
+
+def rules_for(task: str | None) -> list[tuple[str, P]] | None:
+    """The rule table for a serving task family (None = use the
+    first-divisible-axis fallback sharder)."""
+    if task is None:
+        return None
+    if task in ("gan", "generation", "cyclegan", "dcgan"):
+        return RULE_TABLES["gan"]
+    if task in ("classification", "classify"):
+        return RULE_TABLES["classifier"]
+    return None
+
+
+def parse_partition_rules(spec: str) -> list[tuple[str, P]]:
+    """CLI syntax for ``--partition-rules``: ``;``-separated
+    ``regex=axes`` entries, where ``axes`` is a ``,``-separated axis
+    name per dim (``-`` or empty = replicate that dim) and an empty
+    right-hand side replicates the whole leaf.  A bare table name
+    (``classifier``/``gan``) selects the built-in table.
+
+        head/kernel=-,model;conv.*/kernel=-,-,-,model;.*=
+    """
+    spec = spec.strip()
+    if spec in RULE_TABLES:
+        return RULE_TABLES[spec]
+    rules: list[tuple[str, P]] = []
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if "=" not in entry:
+            raise ValueError(
+                f"partition rule '{entry}': expected regex=axes "
+                "(e.g. 'head/kernel=-,model') or a table name "
+                f"{sorted(RULE_TABLES)}")
+        pat, _, axes = entry.partition("=")
+        axes = axes.strip()
+        if not axes:
+            rules.append((pat.strip(), P()))
+            continue
+        dims = [None if a.strip() in ("", "-", "None") else a.strip()
+                for a in axes.split(",")]
+        rules.append((pat.strip(), P(*dims)))
+    if not rules:
+        raise ValueError(f"partition rules '{spec}': no entries")
+    return rules

@@ -102,12 +102,35 @@ def power_of_two_buckets(max_batch: int) -> list[int]:
 
 
 def sharded_buckets(max_batch: int, num_devices: int) -> list[int]:
-    """Bucket ladder for the sharded big-batch path (``--shard-batches``):
-    every bucket a multiple of ``num_devices`` so the padded mega-batch
-    lays evenly across the mesh's data axis — n, 2n, 4n, ... max."""
+    """Bucket ladder for the sharded big-batch path (``--shard-batches``
+    and mesh serving — pass the DATA-axis size, not the chip count: a
+    2×2 data×model mesh splits each batch 2 ways): every bucket a
+    multiple of ``num_devices`` so the padded mega-batch lays evenly
+    across the mesh's data axis — n, 2n, 4n, ... max."""
     n = max(1, int(num_devices))
     top = max(1, max_batch // n)
     return [n * b for b in power_of_two_buckets(top)]
+
+
+def device_hbm_headroom() -> int | None:
+    """Per-chip free HBM bytes (``bytes_limit - bytes_in_use`` from the
+    runtime's memory_stats), advertised through /v1/healthz so the
+    gateway's fleet table can place models by capacity.  None where the
+    backend doesn't report (host CPU devices) — absence means unknown,
+    never zero."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if not stats:
+            return None
+        limit = stats.get("bytes_limit")
+        used = stats.get("bytes_in_use")
+        if limit is None or used is None:
+            return None
+        return int(limit) - int(used)
+    except Exception:  # noqa: BLE001 — memory_stats is best-effort, backend-specific
+        return None
 
 
 class _Request:
@@ -985,6 +1008,14 @@ class BatchingEngine:
         rep["can_serve"] = rep["state"] == "ok"
         rep["placement"] = self.model.placement_desc() \
             if hasattr(self.model, "placement_desc") else None
+        # mesh advertisement for the gateway's fleet table: how this
+        # engine's weights are laid out and how much per-chip HBM is
+        # left (None on backends without memory_stats, i.e. CPU)
+        rep["mesh_shape"] = self.model.mesh_shape() \
+            if hasattr(self.model, "mesh_shape") else None
+        rep["param_shard_bytes"] = self.model.param_bytes() \
+            if hasattr(self.model, "param_bytes") else None
+        rep["hbm_headroom_bytes"] = device_hbm_headroom()
         with self._lock:
             rep["inflight"] = self._inflight
             rep["batch_failures"] = self.batch_failures
@@ -1027,9 +1058,18 @@ class BatchingEngine:
                                           "float32"),
                    # the served weights' byte footprint (int8 models
                    # report the true quantized size — bench.py's
-                   # weight-HBM pricing and the /metrics gauge)
+                   # weight-HBM pricing and the /metrics gauge).
+                   # param_bytes is PER-CHIP on mesh views: a leaf
+                   # split over ``model`` prices its addressable shard
                    "weight_hbm_bytes": self.model.param_bytes()
                    if hasattr(self.model, "param_bytes") else None,
+                   "param_shard_bytes": self.model.param_bytes()
+                   if hasattr(self.model, "param_bytes") else None,
+                   "param_global_bytes": self.model.param_global_bytes()
+                   if hasattr(self.model, "param_global_bytes")
+                   else None,
+                   "mesh_shape": self.model.mesh_shape()
+                   if hasattr(self.model, "mesh_shape") else None,
                    "pipeline": {
                        "depth": self.pipeline_depth,
                        "inflight": self._inflight,

@@ -154,6 +154,10 @@ class Backend:
         # payload); empty until the first 200 probe — an empty list
         # routes everything, so a pre-probe gateway still forwards
         self.models: list[str] = []  # guarded-by: _lock
+        # per-engine mesh advertisement from the healthz payload —
+        # {engine: {mesh_shape, param_shard_bytes, hbm_headroom_bytes}}
+        # — the gateway's capacity view of this backend's chips
+        self.mesh: dict = {}  # guarded-by: _lock
         # keep-alive connection pool for forwarding: connections check
         # out per exchange and return unless the response closed them.
         # Its own leaf lock — pool operations never nest under _lock.
@@ -306,13 +310,16 @@ class Backend:
             self._failure_locked(err, time.monotonic()
                                  if now is None else now)
 
-    def probe_ok(self, now: float, models: list[str] | None = None):
+    def probe_ok(self, now: float, models: list[str] | None = None,
+                 mesh: dict | None = None):
         with self._lock:
             self.probes += 1
             self.last_probe_at = now
             self.unavailable = None
             if models is not None:
                 self.models = list(models)
+            if mesh is not None:
+                self.mesh = dict(mesh)
             self.consecutive_failures = 0
             if self.breaker == CLOSED:
                 self.state = OK
@@ -367,7 +374,8 @@ class Backend:
                 "last_probe_age_s": round(now - self.last_probe_at, 4)
                 if self.last_probe_at is not None else None,
                 "last_error": self.last_error,
-                "models": list(self.models)}
+                "models": list(self.models),
+                "mesh": dict(self.mesh)}
 
 
 class _Outcome:
@@ -499,13 +507,28 @@ class Gateway:
                 continue
             if status == 200:
                 models = None
+                mesh = None
                 try:
                     doc = json.loads(payload)
                     if isinstance(doc.get("models"), list):
                         models = [str(m) for m in doc["models"]]
+                    # mesh advertisement: each engine's health report
+                    # carries its weight layout + per-chip headroom —
+                    # the fleet capacity table in gateway /v1/stats
+                    engines = doc.get("engines")
+                    if isinstance(engines, dict):
+                        mesh = {
+                            str(en): {
+                                "mesh_shape": rep.get("mesh_shape"),
+                                "param_shard_bytes":
+                                    rep.get("param_shard_bytes"),
+                                "hbm_headroom_bytes":
+                                    rep.get("hbm_headroom_bytes")}
+                            for en, rep in engines.items()
+                            if isinstance(rep, dict)}
                 except (ValueError, AttributeError):
                     pass
-                b.probe_ok(now, models=models)
+                b.probe_ok(now, models=models, mesh=mesh)
             else:
                 reason = "unavailable"
                 try:
@@ -952,10 +975,19 @@ class Gateway:
                     except (KeyError, ValueError, TypeError):
                         pass  # malformed or mismatched bins: skip
                 ent = per_model.setdefault(
-                    name, {"served": 0, "submitted": 0, "backends": []})
+                    name, {"served": 0, "submitted": 0, "backends": [],
+                           "mesh": {}})
                 ent["served"] += int(mstats.get("served") or 0)
                 ent["submitted"] += int(mstats.get("submitted") or 0)
                 ent["backends"].append(bname)
+                # per-backend weight layout: the fleet capacity table —
+                # which cells shard (per-chip bytes < global) and which
+                # replicate, straight from each engine's stats
+                ent["mesh"][bname] = {
+                    "mesh_shape": mstats.get("mesh_shape"),
+                    "param_shard_bytes": mstats.get("param_shard_bytes"),
+                    "param_global_bytes":
+                        mstats.get("param_global_bytes")}
                 m = mstats.get("mfu") or {}
                 flops += float(m.get("flops_total") or 0.0)
                 secs += float(m.get("compute_s") or 0.0)

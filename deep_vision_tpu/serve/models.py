@@ -118,11 +118,25 @@ class WeightCache:
         """Put ``model`` (a CheckpointServingModel) under residency
         management.  Its current variables count as resident; admitting
         them may evict others immediately when the budget is already
-        full."""
+        full.
+
+        The accounting unit is ``model.param_bytes()`` — PER-CHIP
+        addressable shard bytes: a mesh view whose leaves are split
+        over ``model`` charges the budget only what one chip actually
+        holds (the budget is per-chip HBM), while unsharded models
+        price at full size exactly as before.  Spill/re-admit round-
+        trips the sharded layout: eviction ``device_get``s (gathering
+        shards to full host values), re-admit ``device_put``s against
+        the view's sharding pytree — bit-identical, zero recompiles.
+        Minimal duck-typed models without ``param_bytes`` price at the
+        raw leaf-bytes sum (necessarily unsharded)."""
         import jax
 
-        nbytes = int(sum(a.nbytes for a in
-                         jax.tree_util.tree_leaves(model._variables)))
+        if hasattr(model, "param_bytes"):
+            nbytes = int(model.param_bytes())
+        else:
+            nbytes = int(sum(a.nbytes for a in
+                             jax.tree_util.tree_leaves(model._variables)))
         with self._lock:
             self._entries[id(model)] = {
                 "model": model, "nbytes": nbytes, "resident": True,

@@ -20,7 +20,14 @@ bytes that would cost accuracy for no footprint win) and every
 is an opaque pytree to everything downstream: the WeightCache's
 spill/re-admit (serve/models.py) and ``for_device``/``for_mesh`` views
 are leaf-wise ``tree_map``s, so int8 leaves round-trip bit-identically,
-and ``param_bytes()`` reports the true ~0.26× footprint for free.
+and ``param_bytes()`` reports the true ~0.26× footprint for free — on
+a 2-D mesh view, the per-chip int8 shard.  Model-parallel layouts
+compose: kernels quantize per-OUT-channel, so sharding a kernel's
+trailing ``cout`` over ``model`` (the rule tables' and fallback
+sharder's choice) splits the int8 leaf while its 1-D scale vector
+replicates — the in-trace ``w_i8 * scale`` broadcast stays local to
+each shard, no extra collectives.  Strict rule tables must still cover
+the ``param_scales/...`` paths (the built-ins' catch-all does).
 
 Calibration runs a held-out batch (or a deterministic synthetic one)
 through an instrumented forward (``capture_intermediates``) to collect

@@ -37,10 +37,14 @@ Multi-device placement (serve/replicas.py, docs/SERVING.md):
     i.e. registry load time) and every bucket program is AOT-compiled
     pinned to it via sharded ``ShapeDtypeStruct``s — N replica views of
     one checkpoint share the host restore but own their device copies;
-  * ``for_mesh(mesh)`` returns a data-sharded VIEW for the big-batch
-    path: variables replicated over the mesh, bucket programs compiled
-    with the batch dim laid across the ``data`` axis, so one logical
-    padded mega-batch uses every chip (``--shard-batches``).
+  * ``for_mesh(mesh)`` returns a mesh-sharded VIEW: bucket programs
+    compiled with the batch dim laid across the ``data`` axis, so one
+    logical padded mega-batch uses every chip (``--shard-batches``).
+    On a 2-D ``data × model`` mesh the variables are additionally laid
+    out by the regex partition rules (parallel/partition.py) — each
+    chip holds only its addressable shard of the wide leaves, GSPMD
+    inserts the ICI collectives, and ``param_bytes()`` prices the
+    per-chip shard (``--mesh data,model`` + ``--partition-rules``).
 """
 
 from __future__ import annotations
@@ -119,7 +123,10 @@ class ServingModel:
         version has drained, so versions retained for observability (or
         versioned ``registry.get``) cost host RAM, never HBM.  A later
         call still works — jax re-transfers host arrays on use — it is
-        just no longer resident."""
+        just no longer resident.  For mesh views ``device_get`` GATHERS
+        every sharded leaf into its full logical host value first, so
+        the spill is a complete checkpoint-equivalent copy whatever the
+        device layout was."""
         variables = getattr(self, "_variables", None)
         if variables is None:
             return
@@ -129,19 +136,75 @@ class ServingModel:
             np.asarray, jax.device_get(variables))
 
     def param_bytes(self) -> int:
-        """Total bytes of the variable tree (the weight cache's HBM
-        accounting unit for this model) — for int8 models this is the
-        true quantized footprint (~0.26× f32: int8 kernels + f32
-        scales/biases), so the cache admits ~4× more versions per
-        budget."""
+        """PER-CHIP addressable bytes of the variable tree (the weight
+        cache's HBM accounting unit for this model) — for int8 models
+        this is the true quantized footprint (~0.26× f32: int8 kernels
+        + f32 scales/biases), and for a model-sharded mesh view each
+        leaf is priced at its ``shard_shape``, not the global logical
+        size: a leaf split 4-way over ``model`` costs a chip a quarter
+        of its bytes, and eviction budgets/spill decisions must see
+        that.  Unsharded/replicated leaves price at full size, so
+        single-device behavior is unchanged."""
         variables = getattr(self, "_variables", None)
         if variables is None:
             return 0
         import jax
 
-        # .nbytes is metadata on both jax and numpy arrays — no D2H
-        return int(sum(a.nbytes for a in
-                       jax.tree_util.tree_leaves(variables)))
+        shardings = self._leaf_shardings()
+        leaves = jax.tree_util.tree_leaves(variables)
+        total = 0
+        for i, a in enumerate(leaves):
+            s = None
+            if isinstance(a, jax.Array):
+                s = a.sharding
+            elif shardings is not None:
+                # spilled host copy: the view's sharding tree still
+                # describes how it lives on devices when re-admitted
+                s = shardings[i]
+            if s is not None:
+                shard = s.shard_shape(tuple(a.shape))
+                total += int(np.prod(shard)) * int(a.dtype.itemsize)
+            else:
+                # .nbytes is metadata on jax and numpy arrays — no D2H
+                total += int(a.nbytes)
+        return total
+
+    def param_global_bytes(self) -> int:
+        """Logical full-tree bytes (what replication would cost one
+        chip) — the denominator for the sharding saving surfaced in
+        /v1/stats next to the per-chip ``param_bytes()``."""
+        variables = getattr(self, "_variables", None)
+        if variables is None:
+            return 0
+        import jax
+
+        return int(sum(int(np.prod(a.shape)) * int(a.dtype.itemsize)
+                       for a in jax.tree_util.tree_leaves(variables)))
+
+    def _leaf_shardings(self):
+        """``_var_sharding`` flattened to a per-leaf list (None when no
+        sharding view applies): single-Sharding views broadcast, mesh
+        views carry a pytree congruent with ``_variables``."""
+        import jax
+
+        vs = getattr(self, "_var_sharding", None)
+        if vs is None:
+            return None
+        if isinstance(vs, jax.sharding.Sharding):
+            n = len(jax.tree_util.tree_leaves(
+                getattr(self, "_variables", None)))
+            return [vs] * n
+        return jax.tree_util.tree_leaves(
+            vs, is_leaf=lambda x: isinstance(x, jax.sharding.Sharding))
+
+    def mesh_shape(self) -> dict | None:
+        """``{"data": D, "model": M}`` for mesh views, None otherwise —
+        advertised through engine stats → /v1/healthz → the gateway's
+        fleet table."""
+        mesh = getattr(self, "_mesh", None)
+        if mesh is None:
+            return None
+        return {str(k): int(v) for k, v in mesh.shape.items()}
 
     def placement_desc(self) -> str | None:
         """Human-readable placement for stats/health (None = default)."""
@@ -164,6 +227,7 @@ class ServingModel:
                 "wire_dtype": str(self.wire_dtype),
                 "infer_dtype": self.infer_dtype,
                 "placement": self.placement_desc(),
+                "mesh": self.mesh_shape(),
                 "restored_step": self.restored_step,
                 "restore_fallback": self.restore_fallback,
                 "restored_mtime": self.restored_mtime,
@@ -289,15 +353,25 @@ class CheckpointServingModel(ServingModel):
         view._variables = jax.device_put(self._variables, sharding)
         return view
 
-    def for_mesh(self, mesh) -> "CheckpointServingModel":
-        """Data-sharded big-batch view (``--shard-batches``): variables
-        replicated over ``mesh``, bucket programs compiled with the
-        batch dim split across the ``data`` axis — one logical padded
-        mega-batch spans every chip.  Buckets must be divisible by the
-        data-axis size (compile_bucket enforces it)."""
+    def for_mesh(self, mesh, partition_rules=None, strict: bool = False,
+                 min_shard_dim: int = 1024) -> "CheckpointServingModel":
+        """Mesh-sharded view: bucket programs compiled with the batch
+        dim split across the ``data`` axis, and — on a 2-D
+        ``data × model`` mesh — variables laid out by the partition
+        rules (parallel/partition.py) so each chip holds only its
+        addressable shard of the wide leaves; GSPMD inserts the ICI
+        collectives the layout implies.  On a 1-D data mesh (legacy
+        ``--shard-batches``) variables replicate, exactly as before.
+
+        ``partition_rules`` is an ordered ``(regex, PartitionSpec)``
+        table (``match_partition_rules``); None = the first-divisible-
+        axis fallback sharder.  ``strict`` demands every leaf match
+        exactly one rule.  Buckets must be divisible by the data-axis
+        size (compile_bucket enforces it, naming both axes)."""
         import copy
 
         from deep_vision_tpu.parallel.mesh import (
+            MODEL_AXIS,
             batch_sharding,
             replicate,
             replicated_sharding,
@@ -305,8 +379,24 @@ class CheckpointServingModel(ServingModel):
 
         view = copy.copy(self)
         view.placement = batch_sharding(mesh, ndim=1 + len(self.input_shape))
-        view._var_sharding = replicated_sharding(mesh)
-        view._variables = replicate(self._variables, mesh)
+        n_model = mesh.shape.get(MODEL_AXIS, 1)
+        if n_model > 1 or partition_rules is not None:
+            from deep_vision_tpu.parallel.partition import (
+                param_shardings,
+                shard_variables,
+            )
+
+            # pytree of NamedShardings, congruent with _variables —
+            # compile_bucket's v_spec and the WeightCache's re-admit
+            # device_put both consume it leaf-for-leaf
+            shardings = param_shardings(
+                self._variables, mesh, min_shard_dim,
+                rules=partition_rules, strict=strict)
+            view._var_sharding = shardings
+            view._variables = shard_variables(self._variables, shardings)
+        else:
+            view._var_sharding = replicated_sharding(mesh)
+            view._variables = replicate(self._variables, mesh)
         view._mesh = mesh
         return view
 
@@ -315,12 +405,22 @@ class CheckpointServingModel(ServingModel):
         import jax.numpy as jnp
 
         if getattr(self, "_mesh", None) is not None:
-            n = self._mesh.shape["data"]
-            if batch % n != 0:
+            from deep_vision_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+            n_data = self._mesh.shape.get(DATA_AXIS, 1)
+            n_model = self._mesh.shape.get(MODEL_AXIS, 1)
+            if batch % n_data != 0:
+                # only the batch dim splits over ``data``; ``model``
+                # constrains nothing here but belongs in the message —
+                # the operator picked one mesh, the error should name it
+                nearest = max(n_data,
+                              ((batch + n_data - 1) // n_data) * n_data)
                 raise ValueError(
                     f"sharded serving of '{self.name}': bucket {batch} "
-                    f"not divisible by the {n}-device data axis — use "
-                    f"buckets that are multiples of {n} "
+                    f"not divisible by the data axis of the "
+                    f"{n_data}×{n_model} data×model mesh — "
+                    f"nearest usable bucket is {nearest}; use buckets "
+                    f"that are multiples of {n_data} "
                     f"(engine.sharded_buckets)")
 
         from deep_vision_tpu.ops.preprocess import (
@@ -383,10 +483,20 @@ class CheckpointServingModel(ServingModel):
 
         x_spec = jax.ShapeDtypeStruct((batch, *self.input_shape),
                                       wire, sharding=self.placement)
-        v_spec = jax.tree_util.tree_map(
-            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
-                                           sharding=self._var_sharding),
-            self._variables)
+        var_sharding = self._var_sharding
+        if var_sharding is not None and \
+                not isinstance(var_sharding, jax.sharding.Sharding):
+            # mesh view: per-leaf sharding pytree (partition rules) —
+            # each leaf's spec carries ITS layout into the AOT compile
+            v_spec = jax.tree_util.tree_map(
+                lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                                  sharding=s),
+                self._variables, var_sharding)
+        else:
+            v_spec = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                               sharding=var_sharding),
+                self._variables)
         # AOT lower+compile: the engine's bucket dict is the jit cache,
         # so a served shape can never hit a surprise trace mid-request.
         # The image buffer is donated — each padded batch's device
@@ -429,14 +539,20 @@ class CheckpointServingModel(ServingModel):
             params_flops_lower_bound,
         )
 
+        mesh = getattr(self, "_mesh", None)
+        n_mesh = int(np.prod(list(mesh.shape.values()))) if mesh else 1
         flops = compiled_flops(compiled)
         if flops is not None:
+            # sharded executables cost-analyze ONE partition — already
+            # the per-chip numerator the meter's per-chip peak expects
             call.cost_flops = flops
-            call.flops_source = "xla_cost_analysis"
+            call.flops_source = ("xla_cost_analysis_per_shard"
+                                 if n_mesh > 1 else "xla_cost_analysis")
         else:
             call.cost_flops = params_flops_lower_bound(
-                self._variables, batch)
-            call.flops_source = "params_lower_bound"
+                self._variables, batch, devices=n_mesh)
+            call.flops_source = ("params_lower_bound_per_shard"
+                                 if n_mesh > 1 else "params_lower_bound")
         return call
 
 

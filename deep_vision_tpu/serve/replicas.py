@@ -74,7 +74,11 @@ from deep_vision_tpu.obs.log import event, get_logger
 from deep_vision_tpu.obs.mfu import MfuMeter
 from deep_vision_tpu.obs.trace import Tracer
 from deep_vision_tpu.serve.admission import AdmissionController, Shed
-from deep_vision_tpu.serve.engine import BatchingEngine, _Request
+from deep_vision_tpu.serve.engine import (
+    BatchingEngine,
+    _Request,
+    device_hbm_headroom,
+)
 from deep_vision_tpu.serve.faults import FaultPlane, KillThread
 from deep_vision_tpu.serve.health import DEAD, OK, EngineHealth
 
@@ -652,6 +656,15 @@ class ReplicatedEngine:
         ages = [a for r in replicas.values() if not r.get("retired")
                 if (a := r.get("last_batch_age_s")) is not None]
         rep["last_batch_age_s"] = min(ages) if ages else None
+        # same mesh-advertisement keys as a single engine's report so
+        # the gateway probe reads either shape (replica views are
+        # single-device: mesh_shape stays None unless the base model
+        # was built for a mesh)
+        rep["mesh_shape"] = self.model.mesh_shape() \
+            if hasattr(self.model, "mesh_shape") else None
+        rep["param_shard_bytes"] = self.model.param_bytes() \
+            if hasattr(self.model, "param_bytes") else None
+        rep["hbm_headroom_bytes"] = device_hbm_headroom()
         if self.faults.enabled:
             rep["faults"] = self.faults.stats()
         return rep
@@ -703,6 +716,18 @@ class ReplicatedEngine:
                    "wire_dtype": str(self.wire_dtype),
                    "infer_dtype": getattr(self.model, "infer_dtype",
                                           "float32"),
+                   # per-chip weight pricing, same keys as the single
+                   # engine (each replica holds its own full copy —
+                   # this is ONE replica's footprint, not the sum)
+                   "weight_hbm_bytes": self.model.param_bytes()
+                   if hasattr(self.model, "param_bytes") else None,
+                   "param_shard_bytes": self.model.param_bytes()
+                   if hasattr(self.model, "param_bytes") else None,
+                   "param_global_bytes": self.model.param_global_bytes()
+                   if hasattr(self.model, "param_global_bytes")
+                   else None,
+                   "mesh_shape": self.model.mesh_shape()
+                   if hasattr(self.model, "mesh_shape") else None,
                    "routing": {
                        "policy": "least_outstanding_work",
                        "replicas": len(self.replicas),
