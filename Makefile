@@ -49,6 +49,7 @@ serve-smoke: lint lint-test
 	$(PY) tests/gateway_smoke.py
 	$(PY) tests/obs_smoke.py
 	$(PY) tests/mesh_smoke.py
+	$(PY) tests/workload_smoke.py
 
 # the async HTTP edge end to end over real sockets: keep-alive reuse
 # visible in the connection counters, a content-addressed cache hit
@@ -97,6 +98,20 @@ quant-test:
 # /v1/stats, every /metrics line parsed (dvt_serve_model_up + cache)
 model-smoke:
 	$(PY) tests/model_smoke.py
+
+# workload-generic serving end to end: pose + DCGAN behind the plane
+# over real HTTP (fault-injected), the heatmap-decode / uint8-image
+# epilogues compiled into the bucket programs, registry-driven verb
+# routing (unknown verbs 404 with the supported list), a reload ->
+# canary -> operator-promote rollout under live pose load with zero
+# client errors, and dvt_serve_d2h_bytes_total per workload on /metrics
+workload-smoke:
+	$(PY) tests/workload_smoke.py
+
+# the workload adapter unit suite alone (decode parity, epilogue D2H
+# accounting, the exact 4x generate D2H win, cache/verb/agree gates)
+workload-test:
+	$(PY) -m pytest tests/test_workloads.py -q -m serve
 
 # the continuous train->deploy loop end to end: a real async-Orbax
 # checkpoint published mid-load auto-deploys through debounce -> gate
@@ -252,5 +267,6 @@ list:
 	serve-multi serve-chaos gateway-smoke gateway-test obs-smoke \
 	edge-smoke edge-test input-smoke input-test \
 	obs-test model-smoke model-test quant-smoke quant-test \
+	workload-smoke workload-test \
 	mesh-smoke mesh-test \
 	deploy-smoke deploy-test lint lint-test list

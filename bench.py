@@ -891,24 +891,31 @@ def bench_serve_obs(**kwargs) -> dict:
     return on
 
 
-def bench_serve_mix(models: tuple = ("lenet5", "yolov3_toy"),
+def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
+                                     "dcgan"),
                     loads: tuple = (8,), duration_s: float = 2.0,
                     max_batch: int = 8, max_wait_ms: float = 2.0,
                     pipeline_depth: int = 2,
                     hbm_budget_mb: float = 0.0,
                     zipf_s: float = 1.1, **_ignored) -> dict:
-    """Multi-model serving mix (``bench.py --serve-mix``): every model
-    in ``models`` deployed behind one control plane
+    """Mixed-WORKLOAD serving mix (``bench.py --serve-mix``): every
+    model in ``models`` deployed behind one control plane
     (serve/models.py) sharing a weight cache, closed-loop clients
     picking a model per request from a Zipf-ish popularity
     distribution (weight ∝ 1/rank^s in list order — the first model
     is the hot one, the tail is the long tail that keeps getting
-    evicted).  The JSON reports per-model p50/p95/p99 + img/s per
-    load point and the cache's hit rate / eviction / spill counters,
-    so the latency tax of serving more models than the HBM budget
-    holds is a tracked number, not folklore (docs/SERVING.md "Model
-    lifecycle & weight cache").  ``hbm_budget_mb`` is the experiment
-    knob: 0 = uncapped (baseline), small enough to hold one model =
+    evicted).  The default mix spans three workloads — classify
+    (lenet5), pose (hourglass_toy), generate (dcgan) — so the bench
+    exercises the workload adapters' input codecs (latent vectors for
+    DCGAN) and fused epilogues (serve/workloads.py).  The JSON
+    reports per-model/per-workload p50/p95/p99 + img/s per load
+    point, per-engine D2H bytes/batch (where generate's on-device
+    uint8 encode shows its 4× output-wire win), and the weight
+    cache's hit rate / eviction / spill counters, so the latency tax
+    of serving more models than the HBM budget holds is a tracked
+    number, not folklore (docs/SERVING.md "Model lifecycle & weight
+    cache", "Workloads").  ``hbm_budget_mb`` is the experiment knob:
+    0 = uncapped (baseline), small enough to hold one model =
     worst-case thrash."""
     import sys
     import tempfile
@@ -953,8 +960,16 @@ def bench_serve_mix(models: tuple = ("lenet5", "yolov3_toy"),
                     cfg, td, log=lambda m: print(m, file=sys.stderr))
             sm = CheckpointServingModel(name, cfg, model, state)
             plane.deploy(sm)
-            imgs[name] = np.random.RandomState(0).randn(
-                *sm.input_shape).astype(np.float32)
+            # workload-aware input synthesis: the serving input shape
+            # may be a latent vector (generate) and the wire dtype is
+            # the model's, not assumed float32
+            wire = np.dtype(str(sm.wire_dtype))
+            rng0 = np.random.RandomState(0)
+            if wire.kind in "ui":
+                imgs[name] = rng0.randint(
+                    0, 256, sm.input_shape).astype(wire)
+            else:
+                imgs[name] = rng0.randn(*sm.input_shape).astype(wire)
         plane.warmup()  # compiles excluded from every load point
 
         # Zipf-ish popularity: weight ∝ 1/rank^s in `models` order
@@ -1033,6 +1048,7 @@ def bench_serve_mix(models: tuple = ("lenet5", "yolov3_toy"),
                     row["models"][name] = {"requests": 0}
                     continue
                 row["models"][name] = {
+                    "workload": registry.get(name).workload.verb,
                     "requests": int(len(lat)),
                     "share": round(len(lat) / max(1, total), 3),
                     "p50_ms": round(float(np.percentile(lat, 50)), 2),
@@ -1065,10 +1081,20 @@ def bench_serve_mix(models: tuple = ("lenet5", "yolov3_toy"),
                "models": cstats["models"]},
            "plane": stats["plane"],
            "engines": {
-               name: {"batches": m["engine"]["batches"],
+               name: {"workload": m["engine"].get("workload"),
+                      "batches": m["engine"]["batches"],
                       "compiles": m["engine"]["compiles"],
                       "served": m["engine"]["served"],
-                      "admitted": m["engine"]["admission"]["admitted"]}
+                      "admitted": m["engine"]["admission"]["admitted"],
+                      # D2H payload of the bulk device_get, per batch
+                      # and per served image — generate's fused uint8
+                      # epilogue is 4× smaller than an f32 output here
+                      "d2h_bytes_per_batch": round(
+                          m["engine"]["pipeline"]["d2h_bytes"]
+                          / max(1, m["engine"]["batches"]), 1),
+                      "d2h_bytes_per_img": round(
+                          m["engine"]["pipeline"]["d2h_bytes"]
+                          / max(1, m["engine"]["served"]), 1)}
                for name, m in stats["models"].items()},
            "device_kind": jax.devices()[0].device_kind}
     return out
@@ -2353,14 +2379,17 @@ def main():
                    help="on-device compute dtype for a single --serve "
                         "run (outputs stay float32)")
     p.add_argument("--serve-mix", action="store_true",
-                   help="multi-model mix bench: every --serve-mix-models "
-                        "config behind one control plane sharing a "
-                        "--hbm-budget-mb weight cache, Zipf-distributed "
-                        "model popularity; per-model p99 + cache hit "
-                        "rate per load point (docs/SERVING.md)")
-    p.add_argument("--serve-mix-models", default="lenet5,yolov3_toy",
+                   help="mixed-workload mix bench: every "
+                        "--serve-mix-models config behind one control "
+                        "plane sharing a --hbm-budget-mb weight cache, "
+                        "Zipf-distributed model popularity; per-model/"
+                        "per-workload p99 + D2H bytes/batch + cache "
+                        "hit rate per load point (docs/SERVING.md)")
+    p.add_argument("--serve-mix-models",
+                   default="lenet5,hourglass_toy,dcgan",
                    help="comma-separated configs for --serve-mix "
-                        "(list order = popularity rank)")
+                        "(list order = popularity rank; default spans "
+                        "classify/pose/generate workloads)")
     p.add_argument("--hbm-budget-mb", type=float, default=0.0,
                    help="weight-cache device-byte budget for "
                         "--serve-mix (0 = uncapped)")

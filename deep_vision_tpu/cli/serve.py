@@ -203,8 +203,13 @@ def build_server(args):
         retry_budget=getattr(args, "retry_budget", 16),
         degraded_after=getattr(args, "degraded_after", 1),
         dead_after=getattr(args, "dead_after", 5),
-        admission=AdmissionController(max_queue=args.max_queue,
-                                      max_wait_ms=args.max_wait_ms))
+        # per-workload SLO class (serve/workloads.py): the operator's
+        # --max-queue capped by the model's workload — generative
+        # batches hold the device longer, so their class bounds the
+        # queue tighter (shed early, not after stacked deadline misses)
+        admission=AdmissionController(
+            max_queue=sm.workload.slo.bound_queue(args.max_queue),
+            max_wait_ms=args.max_wait_ms))
     if mesh_arg:
         # 2-D data×model serving: batches split over ``data``, params
         # laid out over ``model`` by the partition rules — buckets key
@@ -343,8 +348,17 @@ def _build_plane_server(args, registry, wire_dtype: str,
     def admission_for(name: str) -> AdmissionController:
         adm = admissions.get(name)
         if adm is None:
+            # the model's workload SLO class caps the queue bound
+            # (serve/workloads.py); registry lookup can only miss for
+            # engines built outside the plane's deploy path — keep the
+            # operator's bound there
+            try:
+                max_queue = registry.get(name).workload.slo.bound_queue(
+                    args.max_queue)
+            except (KeyError, AttributeError):
+                max_queue = args.max_queue
             adm = admissions[name] = AdmissionController(
-                max_queue=args.max_queue,
+                max_queue=max_queue,
                 max_wait_ms=args.max_wait_ms, name=name)
         return adm
 

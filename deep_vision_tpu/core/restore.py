@@ -49,6 +49,24 @@ def params_digest(params) -> str:
     return h.hexdigest()
 
 
+def serving_input_shape(cfg, model=None) -> tuple:
+    """Per-example input shape inference traces need for ``cfg``.
+
+    Almost every zoo config takes images — (H, W, C) from the config —
+    but the latent-in generative models invert that: ``DCGANGenerator``
+    maps a latent vector to an image, and its Dense kernel shapes derive
+    from the *latent* width, so initializing with an image-shaped zeros
+    batch (what ``load_state`` did before the generate workload existed)
+    would build params the trainer's checkpoints can't restore into
+    (tasks/gan.py inits with ``(1, latent_dim)``).  Pass ``model`` when
+    one is already built to avoid a second ``cfg.model()``."""
+    if getattr(cfg, "task", "") == "gan_dcgan":
+        if model is None:
+            model = cfg.model()
+        return (int(getattr(model, "latent_dim", 100)),)
+    return (cfg.image_size, cfg.image_size, cfg.channels)
+
+
 #: substring Orbax stamps on its atomic-rename staging artifacts
 #: (``<step>.orbax-checkpoint-tmp-<ts>`` dirs, and item-level tmp dirs
 #: inside a step while an async save is materializing it)
@@ -143,7 +161,7 @@ def load_state(cfg, workdir, *, log=print, tag: str = "restore",
     if info is None:
         info = {}
     model = cfg.model()
-    x = jnp.zeros((1, cfg.image_size, cfg.image_size, cfg.channels))
+    x = jnp.zeros((1, *serving_input_shape(cfg, model)))
 
     def fresh_state():
         variables = jax.jit(functools.partial(model.init, train=False))(

@@ -24,8 +24,9 @@ from deep_vision_tpu.data.transforms import IMAGENET_MEAN, IMAGENET_STD
 _GRAY = jnp.asarray([0.299, 0.587, 0.114])
 
 #: normalization families the serving wire supports (docs/SERVING.md
-#: "Wire format & inference dtype"); "unit" is plain [0,1] scaling
-SERVE_KINDS = ("imagenet", "mnist", "unit")
+#: "Wire format & inference dtype"); "unit" is plain [0,1] scaling,
+#: "gan" the reference GAN pipelines' [-1,1] scaling
+SERVE_KINDS = ("imagenet", "mnist", "unit", "gan")
 
 
 def serve_preprocess_kind(task: str, channels: int) -> str:
@@ -33,10 +34,14 @@ def serve_preprocess_kind(task: str, channels: int) -> str:
     derived from config metadata so the device prologue matches the
     host path that trained the model: classification RGB models were
     trained on ImageNet-standardized inputs (data/transforms.py),
-    grayscale classification on MNIST stats (data/mnist.py), and the
-    detection/pose/GAN tasks on plain [0,1] images."""
+    grayscale classification on MNIST stats (data/mnist.py), the
+    detection/pose tasks on plain [0,1] images, and the GAN tasks on
+    [-1,1] images (``make_gan_preprocess`` — the image-in CycleGAN
+    serving wire reuses exactly that scaling)."""
     if task == "classification":
         return "mnist" if channels == 1 else "imagenet"
+    if str(task).startswith("gan_"):
+        return "gan"
     return "unit"
 
 
@@ -48,12 +53,16 @@ def serve_normalize(x, kind: str):  # dvtlint: traced
     if kind not in SERVE_KINDS:
         raise ValueError(f"unknown serve preprocess kind '{kind}' "
                          f"(have {SERVE_KINDS})")
+    if kind == "gan":
+        # GAN convention: (x - 127.5)/127.5, same op as the trainer's
+        # make_gan_preprocess — NOT the /255-then-standardize chain
+        return x.astype(jnp.float32) / 127.5 - 1.0
     x = x.astype(jnp.float32) / 255.0
     if kind == "imagenet":
         return (x - jnp.asarray(IMAGENET_MEAN)) / jnp.asarray(IMAGENET_STD)
     if kind == "mnist":
         return (x - MNIST_MEAN) / MNIST_STD
-    return x  # "unit": [0,1] inputs (YOLO/CenterNet/hourglass/GANs)
+    return x  # "unit": [0,1] inputs (YOLO/CenterNet/hourglass)
 
 
 def make_serve_preprocess(kind: str, wire_dtype, compute_dtype=jnp.float32):
@@ -96,9 +105,12 @@ def make_int8_ingest(kind: str, wire_dtype, act_scale: float,
     so the wire bytes never materialize as an f32 HWC tensor in HBM —
     unless ``use_pallas`` is False (the XLA fallback kept for parity
     testing, or a failed on-TPU parity gate).  A float wire was
-    normalized by the client, so only the quantize runs."""
+    normalized by the client, so only the quantize runs.  The "gan"
+    kind always takes the XLA path — the fused kernel's constant table
+    (ops/pallas_ops._ingest_norm_constants) only bakes the mean/std
+    families, and int8 generative serving is untested territory."""
     wire_is_int = jnp.issubdtype(jnp.dtype(wire_dtype), jnp.integer)
-    if wire_is_int and use_pallas:
+    if wire_is_int and use_pallas and kind != "gan":
         from deep_vision_tpu.ops.pallas_ops import serve_ingest_auto
 
         def fn(x):  # dvtlint: traced

@@ -337,6 +337,14 @@ class BatchingEngine:
         self.h2d_transfers = 0  # guarded-by: _lock
         self.h2d_bytes = 0  # guarded-by: _lock
         self.h2d_bytes_by_bucket: dict[int, int] = {}  # guarded-by: _lock
+        # D2H accounting: bytes the bulk per-batch device_get moved
+        # back to the host — the output-side mirror of h2d_bytes.  For
+        # the generate workload (uint8 epilogue fused into the bucket
+        # programs, serve/workloads.py) this is where the 4× output-
+        # wire win shows up; counted at the pipelined drain and the
+        # synchronous retry path, same as the H2D pair
+        self.d2h_bytes = 0  # guarded-by: _lock
+        self.d2h_bytes_by_bucket: dict[int, int] = {}  # guarded-by: _lock
         # fault-tolerance accounting
         self.batch_failures = 0  # guarded-by: _lock
         self.retry_executions = 0  # guarded-by: _lock
@@ -736,6 +744,9 @@ class BatchingEngine:
             self.padded_images += rec.bucket - n
             self.bulk_transfers += 1
             self.bulk_transfer_bytes += nbytes
+            self.d2h_bytes += nbytes
+            self.d2h_bytes_by_bucket[rec.bucket] = \
+                self.d2h_bytes_by_bucket.get(rec.bucket, 0) + nbytes
         self.throughput.update(n)
         for i, req in enumerate(rec.requests):
             self.latency.record(t_done - req.enqueued_at)
@@ -883,6 +894,9 @@ class BatchingEngine:
             self.padded_images += bucket - n
             self.bulk_transfers += 1
             self.bulk_transfer_bytes += nbytes
+            self.d2h_bytes += nbytes
+            self.d2h_bytes_by_bucket[bucket] = \
+                self.d2h_bytes_by_bucket.get(bucket, 0) + nbytes
         self.throughput.update(n)
         for i, req in enumerate(requests):
             self.latency.record(t_done - req.enqueued_at)
@@ -1053,6 +1067,9 @@ class BatchingEngine:
                    "buckets": list(self.buckets),
                    "compiled_buckets": sorted(self._executables),
                    "max_wait_ms": self.max_wait_s * 1e3,
+                   "workload": getattr(
+                       getattr(self.model, "workload", None),
+                       "verb", None),
                    "wire_dtype": str(self.wire_dtype),
                    "infer_dtype": getattr(self.model, "infer_dtype",
                                           "float32"),
@@ -1080,6 +1097,9 @@ class BatchingEngine:
                        "h2d_bytes": self.h2d_bytes,
                        "h2d_bytes_by_bucket": dict(
                            self.h2d_bytes_by_bucket),
+                       "d2h_bytes": self.d2h_bytes,
+                       "d2h_bytes_by_bucket": dict(
+                           self.d2h_bytes_by_bucket),
                        # host proxy: fraction of the first-dispatch →
                        # last-drain span with an empty in-flight window
                        "device_idle_frac": (

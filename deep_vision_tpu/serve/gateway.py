@@ -2,7 +2,9 @@
 
 ``--serve-devices`` scales one process across its local chips; the next
 scale axis is *processes and hosts*.  The gateway is a thin HTTP front
-tier that proxies ``/v1/classify`` / ``/v1/detect`` across a table of
+tier that proxies every workload inference verb (``/v1/classify``,
+``/v1/detect``, ``/v1/pose``, ``/v1/generate`` — the route table
+derives from ``serve/workloads.py``) across a table of
 backend serve processes (each a full PR 1–5 stack: batcher, pipeline,
 fault plane, deep health) so N backends look like one endpoint that
 survives any single backend dying:
@@ -1167,20 +1169,31 @@ class _GatewayHandler(BaseHTTPRequestHandler):
         self._rid = self.headers.get(REQUEST_ID_HEADER) \
             or new_request_id()
         try:
-            # /v1/models/<name>/classify|detect route on the path's
-            # model (the gateway filters to backends probing that
-            # name); lifecycle verbs forward to EVERY backend serving
-            # it — a reload must reach the whole fleet, not one member
+            # /v1/models/<name>/<verb> routes on the path's model (the
+            # gateway filters to backends probing that name); lifecycle
+            # verbs forward to EVERY backend serving it — a reload must
+            # reach the whole fleet, not one member.  The inference
+            # verb set derives from the workload registry
+            # (serve/workloads.py) — same source as the backends, so
+            # the gateway never 404s a verb a backend would serve
+            from deep_vision_tpu.serve.workloads import (
+                LIFECYCLE_VERBS,
+                infer_paths,
+                infer_verbs,
+            )
+
             parts = path.split("/")
             model_route = (len(parts) == 5 and parts[1] == "v1"
                            and parts[2] == "models")
-            if model_route and parts[4] in ("reload", "promote",
-                                            "rollback"):
+            if model_route and parts[4] in LIFECYCLE_VERBS:
                 self._lifecycle_fanout(gw, parts[3], parts[4])
                 return
-            if path not in ("/v1/classify", "/v1/detect") and not (
-                    model_route and parts[4] in ("classify", "detect")):
-                self._reply(404, {"error": f"no route {self.path}"})
+            if path not in infer_paths() and not (
+                    model_route and parts[4] in infer_verbs()):
+                self._reply(404, {
+                    "error": f"no route {self.path}",
+                    "supported_verbs": sorted(
+                        infer_verbs() + LIFECYCLE_VERBS)})
                 return
             length = int(self.headers.get("Content-Length") or 0)
             if length <= 0:

@@ -26,10 +26,24 @@ Routes (JSON in, JSON out):
     POST /v1/classify  {"pixels": [[...]] | "image_b64": "...",
                         "model"?, "deadline_ms"?, "top_k"?}
     POST /v1/detect    same inputs + "score_threshold"?; YOLO models
-    POST /v1/models/{name}/classify | /detect
+    POST /v1/pose      same image inputs; heatmap models (Stacked
+                       Hourglass) — the traced on-device epilogue
+                       decodes heatmaps to {"keypoints": [{x, y,
+                       score}]} (serve/workloads.py)
+    POST /v1/generate  generative models: latent-in (DCGAN) bodies
+                       carry {"latent": [...]} or {"seed": int}
+                       (deterministic host draw); image-in translation
+                       (CycleGAN) takes the usual image inputs.  The
+                       reply is {"image": {"b64", "shape", "dtype"}} —
+                       raw uint8 bytes encoded ON DEVICE by the fused
+                       epilogue, so the bulk D2H moves 1 byte/pixel
+    POST /v1/models/{name}/classify | /detect | /pose | /generate
                        same bodies with the model named in the PATH —
                        the multi-model route (a body "model" key must
-                       match the path or 400)
+                       match the path or 400).  The verb set derives
+                       from the workload registry (serve/workloads.py);
+                       unknown verbs 404 with the supported list in
+                       the body
     GET  /v1/models    the model table: per name the active version +
                        full version history (step/digest/state) — the
                        control-plane listing when ``cli.serve --models``
@@ -117,6 +131,12 @@ from deep_vision_tpu.obs.trace import REQUEST_ID_HEADER, new_request_id
 from deep_vision_tpu.serve.admission import TENANT_HEADER
 from deep_vision_tpu.serve.cache import ResponseCache, payload_digest
 from deep_vision_tpu.serve.edge import DEFAULT_MAX_CONNECTIONS, EdgeServer
+from deep_vision_tpu.serve.workloads import (
+    LIFECYCLE_VERBS,
+    WORKLOADS,
+    infer_paths,
+    infer_verbs,
+)
 
 DEFAULT_MAX_BODY_BYTES = 32 * 2**20
 
@@ -192,12 +212,16 @@ def _decode_pixels(body: dict, model):
                 return np.ascontiguousarray(eval_transform_u8(
                     arr, size, imagenet_resize_for(size)))
             return eval_transform(arr, size, imagenet_resize_for(size))
-        # detection/pose: [0,1] inputs, not imagenet-normalized
+        # detection/pose/GAN: plain resize, family-specific scaling
         from deep_vision_tpu.data.detection import resize_square
 
         u8 = resize_square(arr, size)
         if wire.kind == "u":
             return np.asarray(u8, np.uint8)
+        if str(model.task).startswith("gan_"):
+            # image-in translation (CycleGAN) trained on [-1,1] inputs
+            # (make_gan_preprocess); the float wire ships them as-is
+            return u8.astype(np.float32) / 127.5 - 1.0
         return u8.astype(np.float32) / 255.0
     raise ServeError(400, "body needs 'pixels' or 'image_b64'")
 
@@ -467,6 +491,12 @@ def _render_engine_metrics(p, name: str, s: dict) -> None:
               help="Staged-batch host-to-device transfers")
     p.counter("dvt_serve_h2d_bytes_total", pipe.get("h2d_bytes"),
               lab, help="Wire-format bytes shipped to the device")
+    wl = s.get("workload")
+    p.counter("dvt_serve_d2h_bytes_total", pipe.get("d2h_bytes"),
+              {**lab, "workload": wl} if wl else lab,
+              help="Output bytes the bulk device_get moved back "
+                   "(generate's fused uint8 epilogue shrinks this 4x); "
+                   "sum by (workload) for the per-workload series")
     for b, ms in (adm.get("exec_ewma_ms_by_bucket") or {}).items():
         p.gauge("dvt_serve_exec_ewma_seconds", ms / 1e3,
                 {**lab, "bucket": b},
@@ -580,22 +610,37 @@ class _Handler(BaseHTTPRequestHandler):
         return model, self.server.engines[model.name]
 
     def _infer_row(self, body: dict, path_model: str | None = None):
-        """Shared classify/detect request path: decode → engine → row."""
+        """Shared inference request path: decode → engine → row.  The
+        model's workload adapter decodes first (DCGAN reads latent/seed
+        from the body); None defers to the generic image decode.  A
+        client that omits ``deadline_ms`` gets the workload's SLO-class
+        default (generate's is longer — output-dominated batches)."""
         model, engine = self._engine(body, path_model)
+        wl = getattr(model, "workload", None)
         if engine.faults.enabled:
             engine.faults.inject("decode")
-        x = _decode_pixels(body, model)
+        x = None
+        if wl is not None:
+            try:
+                x = wl.decode(body, model)
+            except ValueError as e:
+                raise ServeError(400, str(e)) from e
+        if x is None:
+            x = _decode_pixels(body, model)
         if self._span is not None:
             self._span.mark("decode")
+        deadline_ms = body.get("deadline_ms")
+        if deadline_ms is None and wl is not None:
+            deadline_ms = wl.slo.deadline_ms
         plane = getattr(self.server, "plane", None)
         if plane is not None:
             # plane routing: canary/shadow splits + cross-version
             # resubmission happen behind this call, not per-engine
             result = plane.infer(model.name, x,
-                                 deadline_ms=body.get("deadline_ms"),
+                                 deadline_ms=deadline_ms,
                                  span=self._span)
         else:
-            result = engine.infer(x, deadline_ms=body.get("deadline_ms"),
+            result = engine.infer(x, deadline_ms=deadline_ms,
                                   span=self._span)
         from deep_vision_tpu.serve.admission import Shed
         from deep_vision_tpu.serve.faults import Quarantined
@@ -622,8 +667,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _infer_route(self, path: str, body: dict,
                      path_model: str | None, debug: bool) -> bytes:  # dvtlint: hot
-        """The classify/detect POST path with the edge services hooked
-        in — returns the serialized 200 body.  Order matters:
+        """The inference POST path (every workload verb) with the edge
+        services hooked in — returns the serialized 200 body.  Order
+        matters:
 
           1. tenant quota (token bucket) — BEFORE the cache, so a hot
              payload can't make quotas unenforceable;
@@ -649,6 +695,14 @@ class _Handler(BaseHTTPRequestHandler):
             if shed is not None:
                 raise self._shed_429(shed)
         model, engine = self._engine(body, path_model)
+        # the verb names the workload; the model's task must serve it —
+        # checked BEFORE cache and engine so a mis-verbed request never
+        # costs a batch slot (or a poisoned cache entry)
+        wl = WORKLOADS[path.rsplit("/", 1)[-1]]
+        model_wl = getattr(model, "workload", None)
+        if model_wl is not None and model_wl.verb != wl.verb:
+            raise ServeError(400, f"'{model.name}' is a {model.task} "
+                                  f"model; use /v1/{model_wl.verb}")
         cache = getattr(self.server, "response_cache", None)
         key = None
         if cache is not None and not debug \
@@ -678,16 +732,14 @@ class _Handler(BaseHTTPRequestHandler):
                 adm.max_queue if adm is not None else 0)
             if shed is not None:
                 raise self._shed_429(shed)
-        if path == "/v1/classify":
-            payload = self._classify(body, path_model)
-        else:
-            payload = self._detect(body, path_model)
+        _, row = self._infer_row(body, path_model)
+        payload = wl.respond(model, body, row)
         if span is not None:
             span.mark("respond")
             if debug:
                 payload["trace"] = span.to_dict()
         blob = json.dumps(payload).encode()
-        if key is not None:
+        if key is not None and wl.cacheable(len(blob)):
             # during a canary window plane.infer may have routed this
             # request to the CANDIDATE — filing that answer under the
             # active version's digest would poison the cache, so
@@ -823,18 +875,21 @@ class _Handler(BaseHTTPRequestHandler):
             if len(parts) == 5 and parts[1] == "v1" \
                     and parts[2] == "models":
                 path_model, verb = parts[3], parts[4]
-                if verb in ("reload", "promote", "rollback"):
+                if verb in LIFECYCLE_VERBS:
                     self._reply(*self._lifecycle(path_model, verb))
                     return
-                if verb in ("classify", "detect"):
+                if verb in infer_verbs():
                     path = f"/v1/{verb}"
             if len(parts) == 5 and parts[1] == "v1" \
                     and parts[2] == "deploy" and parts[4] == "revert":
                 self._reply(*self._deploy_revert(parts[3]))
                 return
-            if path not in ("/v1/classify", "/v1/detect"):
+            if path not in infer_paths():
                 self._body()  # consistent 400 on empty/oversized bodies
-                self._reply(404, {"error": f"no route {self.path}"})
+                self._reply(404, {
+                    "error": f"no route {self.path}",
+                    "supported_verbs": sorted(
+                        infer_verbs() + LIFECYCLE_VERBS)})
                 return
             body = self._body()
             self._cache_hit = False
@@ -947,43 +1002,9 @@ class _Handler(BaseHTTPRequestHandler):
             return 409, out
         return (500 if status == "failed" else 200), out
 
-    def _classify(self, body: dict, path_model: str | None = None) -> dict:
-        import numpy as np
-
-        model, row = self._infer_row(body, path_model)
-        if model.task != "classification":
-            raise ServeError(400, f"'{model.name}' is a {model.task} "
-                                  f"model; use /v1/detect")
-        logits = np.asarray(row)
-        k = min(int(body.get("top_k", 5)), logits.shape[-1])
-        top = np.argsort(logits)[-k:][::-1]
-        z = np.exp(logits - logits.max())
-        probs = z / z.sum()
-        return {"model": model.name,
-                "top": [{"class": int(c), "prob": float(probs[c]),
-                         "logit": float(logits[c])} for c in top]}
-
-    def _detect(self, body: dict, path_model: str | None = None) -> dict:
-        import jax
-        import numpy as np
-
-        model, row = self._infer_row(body, path_model)
-        if model.task != "detection":
-            raise ServeError(400, f"'{model.name}' is a {model.task} "
-                                  f"model; use /v1/classify")
-        from deep_vision_tpu.tasks.detection import postprocess
-
-        # row is the per-scale head outputs for one image; postprocess
-        # (ops/boxes.py batched NMS) wants a batch dim back
-        outs = jax.tree_util.tree_map(lambda a: a[None], row)
-        boxes, scores, classes, valid = postprocess(
-            outs, model.num_classes,
-            score_threshold=float(body.get("score_threshold", 0.3)))
-        n = int(np.asarray(valid[0]).sum())
-        return {"model": model.name, "detections": [
-            {"box": np.asarray(boxes[0, j]).round(4).tolist(),
-             "score": float(scores[0, j]),
-             "class": int(classes[0, j])} for j in range(n)]}
+    # response building lives on the workload adapters now
+    # (serve/workloads.py respond()) — the old _classify/_detect bodies
+    # moved there verbatim when the verb set became registry-driven
 
 
 class ServeServer:

@@ -631,23 +631,33 @@ class ModelControlPlane:
         sfut.add_done_callback(lambda f: arrived("s", f))
 
     def _compare_shadow(self, mv: ModelVersion, p: Future, s: Future):
-        """Both sides answered: record top-1 agreement, then DISCARD
-        the shadow output (it never reaches a client)."""
+        """Both sides answered: record per-workload agreement, then
+        DISCARD the shadow output (it never reaches a client).  The
+        workload adapter owns the metric (serve/workloads.py): top-1
+        argmax for classify, PCK-style keypoint proximity for pose,
+        output-digest equality for generate; ``agree()`` returning
+        None means "not comparable" (detect pytrees, Shed/Quarantined
+        rows) — discarded without entering the compared count, the
+        same accounting shape as before workloads existed."""
         try:
             pr, sr = p.result(), s.result()
         except Exception:  # noqa: BLE001 — either side failed: nothing to compare
             with self._lock:
                 mv.shadow_discarded += 1
             return
-        comparable = (isinstance(pr, np.ndarray)
-                      and isinstance(sr, np.ndarray)
-                      and pr.shape == sr.shape and pr.ndim >= 1)
+        wl = getattr(mv.model, "workload", None)
+        verdict = None
+        if wl is not None:
+            try:
+                verdict = wl.agree(pr, sr)
+            except Exception:  # noqa: BLE001 — a row the metric can't digest
+                verdict = None
         with self._lock:
             mv.shadow_discarded += 1
-            if not comparable:
+            if verdict is None:
                 return
             mv.shadow_compared += 1
-            if int(np.argmax(pr)) == int(np.argmax(sr)):
+            if verdict:
                 mv.shadow_agreed += 1
 
     # -- reload lifecycle --------------------------------------------------

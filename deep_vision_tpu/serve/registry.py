@@ -85,9 +85,21 @@ class ServingModel:
                              f"(have {INFER_DTYPES})")
         self.name = name
         self.task = task
-        self.input_shape = tuple(input_shape)  # (H, W, C), batch excluded
+        # (H, W, C) for image-in workloads, (latent_dim,) for
+        # latent-in generative models — batch dim always excluded
+        self.input_shape = tuple(input_shape)
         self.num_classes = num_classes
         self.config_name = config_name or name
+        # the workload adapter serving this model's task: verbs,
+        # codec, epilogue, SLO class, agreement metric
+        # (serve/workloads.py — one shared stateless instance per verb)
+        from deep_vision_tpu.serve.workloads import workload_for_task
+
+        self.workload = workload_for_task(task)
+        # wire dtype of the OUTPUT payload when the workload ships one
+        # on-device-encoded (generate: "uint8"); None = small host-side
+        # decode, no output wire contract
+        self.output_wire: str | None = None
         # what the engine stages + transfers (np dtype: the StagingPool
         # buffers and the bulk H2D device_put carry exactly this)
         self.wire_dtype = np.dtype(str(wire_dtype))
@@ -220,12 +232,14 @@ class ServingModel:
 
     def describe(self) -> dict:
         return {"name": self.name, "task": self.task,
+                "workload": self.workload.verb,
                 "input_shape": list(self.input_shape),
                 "num_classes": self.num_classes,
                 "fixed_batch": self.fixed_batch,
                 "donates_inputs": self.donates_inputs,
                 "wire_dtype": str(self.wire_dtype),
                 "infer_dtype": self.infer_dtype,
+                "output_wire": self.output_wire,
                 "placement": self.placement_desc(),
                 "mesh": self.mesh_shape(),
                 "restored_step": self.restored_step,
@@ -246,11 +260,21 @@ class CheckpointServingModel(ServingModel):
                  calib_batches: int = 2,
                  calib_dir: str | None = None,
                  ingest: str = "pallas"):
+        from deep_vision_tpu.serve.workloads import workload_for_task
+
+        # the workload adapter owns the input codec: latent-in
+        # generative models serve a (latent_dim,) float vector, not an
+        # image, and override an operator-requested uint8 wire (a uint8
+        # latent is meaningless); image-in workloads keep the config's
+        # (H, W, C) and the requested wire
+        wl = workload_for_task(cfg.task)
         super().__init__(
             name, task=cfg.task,
-            input_shape=(cfg.image_size, cfg.image_size, cfg.channels),
+            input_shape=wl.serving_input_shape(cfg, model),
             num_classes=cfg.num_classes, config_name=cfg.name,
-            wire_dtype=wire_dtype, infer_dtype=infer_dtype)
+            wire_dtype=wl.wire_dtype_for(cfg, str(wire_dtype)),
+            infer_dtype=infer_dtype)
+        self.output_wire = wl.output_wire(cfg)
         self.cfg = cfg
         # which device-side normalization a uint8 wire needs — derived
         # from the config so it matches the host path the model trained
@@ -437,6 +461,17 @@ class CheckpointServingModel(ServingModel):
                 lambda a: a.astype(jnp.float32)
                 if jnp.issubdtype(a.dtype, jnp.floating) else a, out)
 
+        # workload epilogue (serve/workloads.py), fused into the same
+        # AOT program as the model body — the output-side mirror of the
+        # normalize prologue: pose decodes heatmaps→keypoints on device
+        # (D2H moves K coordinate pairs, not H×W×K heatmaps), generate
+        # encodes [-1,1] floats→uint8 (D2H moves 1 byte/pixel)
+        post = self.workload.make_epilogue(self)
+
+        def _finish(out):  # dvtlint: traced
+            out = _f32_outputs(out)
+            return post(out) if post is not None else out
+
         if self.infer_dtype == "int8":
             # the fused Pallas ingest is the default on the uint8 wire;
             # on real TPUs it must pass the per-shape parity gate first
@@ -444,8 +479,11 @@ class CheckpointServingModel(ServingModel):
             # XLA prologue — NEVER recompiling any other model's
             # retained f32/bf16 bucket programs
             act_scale = float(self.quant.act_scale)
+            # the fused kernel's constant table has no "gan" family —
+            # GAN-kind ingest always takes the XLA prologue
             use_pallas = self.ingest == "pallas" and \
-                jnp.issubdtype(wire, jnp.integer)
+                jnp.issubdtype(wire, jnp.integer) and \
+                self.preprocess_kind != "gan"
             if use_pallas and jax.default_backend() == "tpu":
                 from deep_vision_tpu.ops.pallas_ops import ingest_parity_ok
 
@@ -467,7 +505,7 @@ class CheckpointServingModel(ServingModel):
                 scales = v.pop("param_scales")
                 v["params"] = dequantize_params(v["params"], scales)
                 out = self._model.apply(v, xf, train=False)
-                return _f32_outputs(out)
+                return _finish(out)
         else:
             # traced prologue: a uint8 wire batch is cast + scaled +
             # normalized ON DEVICE (XLA fuses it into the first conv's
@@ -479,7 +517,7 @@ class CheckpointServingModel(ServingModel):
 
             def apply(variables, x):
                 out = self._model.apply(variables, pre(x), train=False)
-                return _f32_outputs(out)
+                return _finish(out)
 
         x_spec = jax.ShapeDtypeStruct((batch, *self.input_shape),
                                       wire, sharding=self.placement)

@@ -1,0 +1,377 @@
+"""Workload adapters: everything the serving tier must know per model
+*kind*, in one object per verb.
+
+Through PR 14 the serving stack special-cased exactly two verbs —
+``/v1/classify`` and ``/v1/detect`` — in ten different places (HTTP
+routing, response builders, shadow comparison, gateway allowlists,
+bench input synthesis).  The zoo is bigger than that: Stacked Hourglass
+pose and DCGAN/CycleGAN generation train fine (tasks/pose.py,
+tasks/gan.py) but had no serving path.  This module replaces the
+hardcoded pair with a registry of ``Workload`` adapters; making the
+next zoo model servable means writing one adapter here instead of
+touching ten files.
+
+Each adapter declares:
+
+- ``verb`` — the route segment (``/v1/<verb>`` and
+  ``/v1/models/{name}/<verb>``), and the key in ``WORKLOADS``;
+- ``slo`` — the workload's service class (deadline + queue bound),
+  consumed by the CLI when it builds each model's
+  ``AdmissionController`` and used as the default ``deadline_ms`` when
+  a client omits one;
+- ``serving_input_shape`` / ``wire_dtype_for`` — the input codec.
+  Generative latent-in models invert the usual contract: the input is
+  a float latent vector (never a uint8 image), so DCGAN forces a
+  float32 wire regardless of the CLI's uint8 default;
+- ``decode`` — optional body → input-array decode (DCGAN reads
+  ``latent``/``seed`` from the JSON body); returning None defers to
+  the generic image decode in serve/http.py;
+- ``make_epilogue`` — an optional *traced* output transform fused into
+  the compiled bucket programs (serve/registry.py), mirroring the PR 5
+  normalize *prologue* on the output side.  Pose decodes heatmaps to
+  keypoints on device (D2H moves K coordinate pairs per image instead
+  of an H×W×K heatmap stack); generate encodes the generator's [-1,1]
+  float output to uint8 on device, so the bulk ``device_get`` moves
+  1 byte/pixel and returns wire-ready bytes — the PR 5/13 uint8-wire
+  win applied in reverse, to the output-dominated traffic shape;
+- ``respond`` — row → JSON response schema (the bodies that used to
+  live in ``_Handler._classify`` / ``_detect``);
+- ``cacheable`` — per-workload response-cache size guard: generated
+  images are large but highly cacheable (same latent → same image),
+  so generate gets a bigger per-entry allowance;
+- ``agree`` — the shadow/canary agreement metric for this workload
+  (serve/models.py ``_compare_shadow``): top-1 for classify, PCK-style
+  keypoint proximity for pose, output-digest equality for generate;
+  None means "not comparable" (detect rows are pyramid pytrees).
+
+Import discipline: this module is imported by the gateway and edge for
+route tables, so module import stays stdlib-only — numpy/jax/tasks
+imports are deferred into the methods that need them.
+"""
+
+from __future__ import annotations
+
+
+class SLO:
+    """A workload's service class: the default per-request deadline and
+    the per-model admission queue bound.
+
+    Deadlines are generous on purpose — they are the *default* for
+    clients that omit ``deadline_ms``, and the first request after a
+    (re)load pays bucket compilation, which takes tens of seconds on a
+    CPU host.  The queue bound caps the CLI's ``--max-queue`` per
+    workload (``bound_queue``): generative batches occupy the device
+    ~an order of magnitude longer than classify batches, so a shorter
+    queue sheds earlier instead of stacking up deadline misses."""
+
+    def __init__(self, name: str, deadline_ms: float, max_queue: int):
+        self.name = name
+        self.deadline_ms = float(deadline_ms)
+        self.max_queue = int(max_queue)
+
+    def bound_queue(self, requested: int) -> int:
+        """The admission queue bound: the operator's ``--max-queue``
+        capped by this workload's class."""
+        return min(int(requested), self.max_queue)
+
+    def describe(self) -> dict:
+        return {"class": self.name, "deadline_ms": self.deadline_ms,
+                "max_queue": self.max_queue}
+
+
+class Workload:
+    """Base adapter: the image-in defaults every subclass overrides
+    piecemeal.  Stateless by design — one shared instance per verb
+    serves every model and every thread (nothing to lock)."""
+
+    verb = ""
+    slo = SLO("interactive", deadline_ms=30_000.0, max_queue=256)
+    #: per-entry response-cache allowance (bytes); ``cacheable`` guard
+    cacheable_bytes = 256 * 1024
+
+    def serving_input_shape(self, cfg, model=None) -> tuple:
+        """Per-example input shape for this (cfg, model) — delegates to
+        core/restore so the restore-time init and the serving buffers
+        can never disagree."""
+        from deep_vision_tpu.core.restore import serving_input_shape
+        return serving_input_shape(cfg, model)
+
+    def wire_dtype_for(self, cfg, requested: str) -> str:
+        """The wire dtype actually used, given what the operator asked
+        for.  Image-in workloads honor the request."""
+        return requested
+
+    def output_wire(self, cfg) -> str | None:
+        """Wire dtype of the *output* side, when the workload ships an
+        output payload (generate's uint8 image encode); None for
+        workloads whose outputs are small host-side decodes."""
+        return None
+
+    def decode(self, body: dict, model):
+        """Body → one input array in the model's wire dtype, or None to
+        defer to the generic image decode (serve/http._decode_pixels).
+        Raise ValueError for malformed payloads (the edge maps it to a
+        400)."""
+        return None
+
+    def make_epilogue(self, model):
+        """Traced output transform fused into the bucket programs after
+        ``_f32_outputs``, or None for no epilogue.  ``model`` is the
+        ServingModel (dtype/attr introspection only — the returned fn
+        must close over nothing that changes across reloads)."""
+        return None
+
+    def respond(self, model, body: dict, row) -> dict:
+        raise NotImplementedError
+
+    def cacheable(self, nbytes: int) -> bool:
+        """Whether a serialized 200 of ``nbytes`` may enter the
+        response cache — the per-workload size guard."""
+        return int(nbytes) <= self.cacheable_bytes
+
+    def agree(self, primary_row, shadow_row):
+        """Shadow/canary agreement verdict: True/False, or None when
+        the rows aren't comparable (counted as discarded, like the
+        pre-workload behavior for detection pytrees)."""
+        return None
+
+    def describe(self) -> dict:
+        return {"verb": self.verb, "slo": self.slo.describe(),
+                "cacheable_bytes": self.cacheable_bytes}
+
+
+class ClassifyWorkload(Workload):
+    verb = "classify"
+    slo = SLO("interactive", deadline_ms=30_000.0, max_queue=256)
+
+    def respond(self, model, body: dict, row) -> dict:
+        import numpy as np
+
+        logits = np.asarray(row)
+        k = min(int(body.get("top_k", 5)), logits.shape[-1])
+        top = np.argsort(logits)[-k:][::-1]
+        z = np.exp(logits - logits.max())
+        probs = z / z.sum()
+        return {"model": model.name,
+                "top": [{"class": int(c), "prob": float(probs[c]),
+                         "logit": float(logits[c])} for c in top]}
+
+    def agree(self, primary_row, shadow_row):
+        import numpy as np
+
+        comparable = (isinstance(primary_row, np.ndarray)
+                      and isinstance(shadow_row, np.ndarray)
+                      and primary_row.shape == shadow_row.shape
+                      and primary_row.ndim >= 1)
+        if not comparable:
+            return None
+        return int(np.argmax(primary_row)) == int(np.argmax(shadow_row))
+
+
+class DetectWorkload(Workload):
+    verb = "detect"
+    slo = SLO("interactive", deadline_ms=30_000.0, max_queue=256)
+
+    def respond(self, model, body: dict, row) -> dict:
+        import jax
+        import numpy as np
+
+        from deep_vision_tpu.tasks.detection import postprocess
+
+        # row is the per-scale head outputs for one image; postprocess
+        # (ops/boxes.py batched NMS) wants a batch dim back
+        outs = jax.tree_util.tree_map(lambda a: a[None], row)
+        boxes, scores, classes, valid = postprocess(
+            outs, model.num_classes,
+            score_threshold=float(body.get("score_threshold", 0.3)))
+        n = int(np.asarray(valid[0]).sum())
+        return {"model": model.name, "detections": [
+            {"box": np.asarray(boxes[0, j]).round(4).tolist(),
+             "score": float(scores[0, j]),
+             "class": int(classes[0, j])} for j in range(n)]}
+
+    # agree: inherited None — pyramid pytrees have no scalar verdict
+    # (matches the pre-workload "not comparable → discarded" behavior)
+
+
+class PoseWorkload(Workload):
+    verb = "pose"
+    slo = SLO("interactive", deadline_ms=30_000.0, max_queue=256)
+    #: shadow agreement: fraction of keypoints within ``pck_px`` heatmap
+    #: pixels that must match for the candidate to count as agreeing
+    pck_px = 2.0
+    pck_min_frac = 0.8
+
+    def make_epilogue(self, model):
+        from deep_vision_tpu.tasks.pose import decode_heatmaps
+
+        def post(out):  # dvtlint: traced
+            # stacked-hourglass apply returns the per-stack heatmap
+            # tuple; serve only decodes the last (most refined) stack
+            hm = out[-1] if isinstance(out, (tuple, list)) else out
+            return decode_heatmaps(hm)
+
+        return post
+
+    def respond(self, model, body: dict, row) -> dict:
+        import numpy as np
+
+        kp = np.asarray(row["keypoints"])
+        sc = np.asarray(row["scores"])
+        return {"model": model.name, "space": "heatmap",
+                "keypoints": [
+                    {"x": float(kp[j, 0]), "y": float(kp[j, 1]),
+                     "score": float(sc[j])} for j in range(kp.shape[0])]}
+
+    def agree(self, primary_row, shadow_row):
+        import numpy as np
+
+        try:
+            pk = np.asarray(primary_row["keypoints"])
+            sk = np.asarray(shadow_row["keypoints"])
+        except (TypeError, KeyError, IndexError):
+            return None  # Shed/Quarantined rows, or a non-pose row
+        if pk.shape != sk.shape or pk.ndim < 2:
+            return None
+        d = np.linalg.norm(pk.astype(np.float32) - sk.astype(np.float32),
+                           axis=-1)
+        return float((d <= self.pck_px).mean()) >= self.pck_min_frac
+
+
+class GenerateWorkload(Workload):
+    verb = "generate"
+    #: generative batches hold the device ~an order of magnitude longer
+    #: than classify batches: longer deadline, shorter queue (shed
+    #: early instead of stacking deadline misses)
+    slo = SLO("batchy", deadline_ms=60_000.0, max_queue=64)
+    #: a 256×256×3 uint8 image is ~260 KB once base64'd — allow it
+    cacheable_bytes = 2 * 2**20
+
+    def serving_input_shape(self, cfg, model=None) -> tuple:
+        from deep_vision_tpu.core.restore import serving_input_shape
+        return serving_input_shape(cfg, model)
+
+    def wire_dtype_for(self, cfg, requested: str) -> str:
+        """Latent-in models (DCGAN) take a float latent vector — a
+        uint8 input wire is meaningless there, so the CLI's uint8
+        default is overridden.  Image-in translation (CycleGAN) keeps
+        the requested wire (uint8 in → "gan" prologue on device)."""
+        if getattr(cfg, "task", "") == "gan_dcgan":
+            return "float32"
+        return requested
+
+    def output_wire(self, cfg) -> str | None:
+        return "uint8"
+
+    def decode(self, body: dict, model):
+        """Latent-in decode: ``latent`` (list of floats, exact shape)
+        or ``seed`` (int — deterministic host-side standard-normal
+        draw, the demo/cache-friendly path; defaults to 0).  Image-in
+        generate models return None → generic image decode."""
+        if len(model.input_shape) != 1:
+            return None
+        import numpy as np
+
+        z = body.get("latent")
+        if z is None:
+            seed = body.get("seed", 0)
+            try:
+                seed = int(seed)
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"bad seed: {seed!r}") from e
+            rng = np.random.default_rng(seed)
+            return rng.standard_normal(model.input_shape).astype(np.float32)
+        try:
+            x = np.asarray(z, np.float32)
+        except (ValueError, TypeError, OverflowError) as e:
+            raise ValueError(f"bad latent payload: {e}") from e
+        if x.shape != model.input_shape:
+            raise ValueError(
+                f"latent shape {list(x.shape)} != model input "
+                f"{list(model.input_shape)}")
+        if not np.isfinite(x).all():
+            raise ValueError("latent contains non-finite values (NaN/Inf)")
+        return x
+
+    def make_epilogue(self, model):
+        """[-1,1] float generator output → uint8 on DEVICE: the D2H
+        copy moves 1 byte/pixel (4× fewer bytes than f32 — the exact
+        mirror of the PR 5 uint8 input wire) and the host hands back
+        wire-ready bytes with zero post-processing.  Skipped when the
+        model's ``output_wire`` was pinned to float32 (the A/B baseline
+        in tests/test_workloads.py)."""
+        if getattr(model, "output_wire", "uint8") == "float32":
+            return None
+        import jax.numpy as jnp
+
+        def post(out):  # dvtlint: traced
+            return jnp.clip(jnp.round((out + 1.0) * 127.5),
+                            0.0, 255.0).astype(jnp.uint8)
+
+        return post
+
+    def respond(self, model, body: dict, row) -> dict:
+        import base64
+
+        import numpy as np
+
+        img = np.ascontiguousarray(np.asarray(row))
+        return {"model": model.name,
+                "image": {"b64": base64.b64encode(img.tobytes()).decode(
+                              "ascii"),
+                          "shape": list(img.shape),
+                          "dtype": str(img.dtype)}}
+
+    def agree(self, primary_row, shadow_row):
+        import hashlib
+
+        import numpy as np
+
+        comparable = (isinstance(primary_row, np.ndarray)
+                      and isinstance(shadow_row, np.ndarray)
+                      and primary_row.shape == shadow_row.shape
+                      and primary_row.dtype == shadow_row.dtype)
+        if not comparable:
+            return None
+
+        def dig(a):
+            return hashlib.blake2b(np.ascontiguousarray(a).tobytes(),
+                                   digest_size=8).hexdigest()
+
+        return dig(primary_row) == dig(shadow_row)
+
+
+#: verb → the shared adapter instance
+WORKLOADS = {w.verb: w for w in (ClassifyWorkload(), DetectWorkload(),
+                                 PoseWorkload(), GenerateWorkload())}
+
+#: config task → verb; unknown tasks fall back to classify so a future
+#: zoo task degrades to the logits-style default instead of crashing
+#: model load (the pre-workload behavior for every non-detection task)
+_TASK_TO_VERB = {
+    "classification": "classify",
+    "detection": "detect",
+    "pose": "pose",
+    "gan_dcgan": "generate",
+    "gan_cyclegan": "generate",
+}
+
+#: operator lifecycle verbs on /v1/models/{name}/<verb> — NOT workload
+#: inference verbs, listed here so every router shares one source
+LIFECYCLE_VERBS = ("reload", "promote", "rollback")
+
+
+def workload_for_task(task: str) -> Workload:
+    """The adapter serving models of config ``task``."""
+    return WORKLOADS[_TASK_TO_VERB.get(str(task), "classify")]
+
+
+def infer_verbs() -> tuple:
+    """Every inference verb, sorted — the route allowlist for the edge
+    and the gateway (unknown verbs 404 with this list in the body)."""
+    return tuple(sorted(WORKLOADS))
+
+
+def infer_paths() -> tuple:
+    """The canonical ``/v1/<verb>`` inference routes."""
+    return tuple(f"/v1/{v}" for v in infer_verbs())

@@ -51,6 +51,42 @@ def heatmap_argmax(heatmaps: np.ndarray) -> np.ndarray:
     return np.stack([idx % w, idx // w], axis=1).astype(np.float32)
 
 
+def decode_heatmaps(heatmaps, refine: bool = True):  # dvtlint: traced
+    """Traced batched heatmap decode: (B, H, W, K) → {"keypoints":
+    (B, K, 2) [x, y] float32, "scores": (B, K) float32}.
+
+    The serving epilogue behind ``/v1/pose`` (serve/workloads.py):
+    fused into the compiled bucket programs so the bulk D2H moves K
+    coordinate pairs per image instead of an H×W×K heatmap stack.
+    ``refine`` adds the standard quarter-pixel offset toward the larger
+    neighbor on each axis (MPII/hourglass post-processing); off, the
+    integer peak matches the host-side ``heatmap_argmax`` exactly
+    (tests/test_workloads.py holds the parity to 1e-6).  Peaks on the
+    heatmap border skip the refinement on that axis — a clipped
+    neighbor gather would compare the peak against itself and shift
+    toward nothing."""
+    b, h, w, k = heatmaps.shape
+    flat = heatmaps.reshape(b, h * w, k)
+    idx = jnp.argmax(flat, axis=1)                      # (B, K)
+    scores = jnp.max(flat, axis=1)
+    xi, yi = idx % w, idx // w
+    x = xi.astype(jnp.float32)
+    y = yi.astype(jnp.float32)
+    if refine:
+        def neighbor(dy, dx):
+            yy = jnp.clip(yi + dy, 0, h - 1)
+            xx = jnp.clip(xi + dx, 0, w - 1)
+            return jnp.take_along_axis(
+                flat, (yy * w + xx)[:, None, :], axis=1)[:, 0, :]
+
+        dx = jnp.sign(neighbor(0, 1) - neighbor(0, -1))
+        dy = jnp.sign(neighbor(1, 0) - neighbor(-1, 0))
+        x = x + 0.25 * dx * ((xi > 0) & (xi < w - 1))
+        y = y + 0.25 * dy * ((yi > 0) & (yi < h - 1))
+    return {"keypoints": jnp.stack([x, y], axis=-1),
+            "scores": scores}
+
+
 def pckh(pred_xy: np.ndarray, true_xy: np.ndarray, visible: np.ndarray,
          head_size: float, alpha: float = 0.5) -> tuple[float, int]:
     """PCKh: fraction of visible keypoints within α·head_size of truth.

@@ -1,0 +1,370 @@
+"""Workload subsystem (CPU, tier-1 fast): the serve/workloads.py
+adapters route verbs, decode latents, fuse the pose/generate epilogues
+into bucket programs, shrink the generate D2H exactly 4× vs a float32
+output wire (the output-side mirror of the PR 5 H2D assertion), cache
+generate payloads, and score shadow agreement per workload.
+
+Heavyweight pieces (hourglass/DCGAN compiles) live in module-scoped
+fixtures so each compiles once for the whole file; the on-device
+decode parity test is pure numpy-vs-traced math, no model."""
+
+import json
+import tempfile
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.serve.engine import BatchingEngine
+from deep_vision_tpu.serve.registry import ModelRegistry
+from deep_vision_tpu.serve.workloads import (
+    LIFECYCLE_VERBS,
+    WORKLOADS,
+    SLO,
+    infer_paths,
+    infer_verbs,
+    workload_for_task,
+)
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def dcgan_serving(tmp_path_factory):
+    reg = ModelRegistry()
+    # empty workdir fixture → deterministic PRNGKey(0) random init;
+    # wire requested uint8 ON PURPOSE: the generate workload must
+    # override it to float32 for the latent input
+    sm = reg.load_checkpoint(
+        "dcgan", str(tmp_path_factory.mktemp("dcgan_workdir")),
+        wire_dtype="uint8")
+    return reg, sm
+
+
+@pytest.fixture(scope="module")
+def hourglass_serving(tmp_path_factory):
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint(
+        "hourglass_toy",
+        str(tmp_path_factory.mktemp("hourglass_workdir")),
+        wire_dtype="uint8")
+    return reg, sm
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+# -- registry / routing ----------------------------------------------------
+
+
+def test_workload_registry_tables():
+    assert set(infer_verbs()) == {"classify", "detect", "pose",
+                                  "generate"}
+    assert infer_paths() == tuple(
+        f"/v1/{v}" for v in sorted(WORKLOADS))
+    assert workload_for_task("classification").verb == "classify"
+    assert workload_for_task("detection").verb == "detect"
+    assert workload_for_task("pose").verb == "pose"
+    assert workload_for_task("gan_dcgan").verb == "generate"
+    assert workload_for_task("gan_cyclegan").verb == "generate"
+    # unknown tasks degrade to the logits-style default, not a crash
+    assert workload_for_task("some_future_task").verb == "classify"
+    assert not set(LIFECYCLE_VERBS) & set(infer_verbs())
+
+
+def test_slo_bound_queue():
+    slo = SLO("batchy", deadline_ms=60_000.0, max_queue=64)
+    assert slo.bound_queue(256) == 64   # workload class caps
+    assert slo.bound_queue(16) == 16    # operator's tighter bound wins
+    assert WORKLOADS["generate"].slo.max_queue < \
+        WORKLOADS["classify"].slo.max_queue
+
+
+# -- pose: traced decode parity + fused epilogue ---------------------------
+
+
+def test_decode_heatmaps_parity_with_host_argmax():
+    """refine=False integer peaks == host heatmap_argmax to 1e-6;
+    refine=True moves each coordinate at most a quarter pixel."""
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.tasks.pose import decode_heatmaps, heatmap_argmax
+
+    hm = np.random.RandomState(0).randn(3, 16, 16, 8).astype(np.float32)
+    dec = decode_heatmaps(jnp.asarray(hm), refine=False)
+    kp = np.asarray(dec["keypoints"])
+    sc = np.asarray(dec["scores"])
+    assert kp.shape == (3, 8, 2) and sc.shape == (3, 8)
+    for i in range(3):
+        np.testing.assert_allclose(kp[i], heatmap_argmax(hm[i]),
+                                   atol=1e-6)
+        np.testing.assert_allclose(sc[i], hm[i].max(axis=(0, 1)),
+                                   atol=1e-6)
+    refined = np.asarray(
+        decode_heatmaps(jnp.asarray(hm), refine=True)["keypoints"])
+    assert np.abs(refined - kp).max() <= 0.25 + 1e-6
+
+
+def test_decode_heatmaps_border_peaks_not_refined():
+    """A peak on the heatmap border skips refinement on that axis —
+    the clipped neighbor gather would compare the peak to itself."""
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.tasks.pose import decode_heatmaps
+
+    hm = np.zeros((1, 8, 8, 2), np.float32)
+    hm[0, 0, 0, 0] = 5.0   # corner: both axes on the border
+    hm[0, 3, 7, 1] = 5.0   # right edge: x on the border, y interior
+    hm[0, 2, 7, 1] = 1.0   # y-neighbor above, to pull the offset
+    kp = np.asarray(decode_heatmaps(jnp.asarray(hm))["keypoints"])[0]
+    assert tuple(kp[0]) == (0.0, 0.0)
+    assert kp[1, 0] == 7.0           # no x refinement on the edge
+    assert kp[1, 1] == pytest.approx(3.0 - 0.25)
+
+
+def test_pose_epilogue_fused_into_bucket_program(hourglass_serving):
+    """The compiled bucket program returns decoded keypoints, not
+    heatmaps — D2H per image is K coordinate pairs + K scores."""
+    _, sm = hourglass_serving
+    assert sm.workload.verb == "pose"
+    with BatchingEngine(sm, buckets=[2], max_wait_ms=2) as eng:
+        img = np.random.RandomState(0).randint(
+            0, 256, (64, 64, 3), np.uint8)
+        row = eng.infer(img, timeout=300)
+        assert set(row) == {"keypoints", "scores"}
+        assert np.asarray(row["keypoints"]).shape == (8, 2)
+        assert np.asarray(row["scores"]).shape == (8,)
+        pipe = eng.stats()["pipeline"]
+        # 8 kp × (2 coords + 1 score) × 4 B × bucket 2 = 192 B/batch —
+        # the 16×16×8 heatmap stack would have been 8192 B/image
+        assert pipe["d2h_bytes"] == 2 * 8 * 3 * 4
+        assert pipe["d2h_bytes_by_bucket"] == {2: 2 * 8 * 3 * 4}
+
+
+# -- generate: latent codec + uint8 output wire ----------------------------
+
+
+def test_dcgan_latent_input_and_wire_override(dcgan_serving):
+    """Latent-in generative serving: input is the (latent_dim,) float
+    vector (the trainer's init shape — image-shaped init would build
+    unrestorable Dense params), and the requested uint8 wire is
+    overridden to float32."""
+    _, sm = dcgan_serving
+    assert sm.workload.verb == "generate"
+    assert sm.input_shape == (100,)
+    assert str(sm.wire_dtype) == "float32"
+    assert sm.output_wire == "uint8"
+    assert sm.describe()["workload"] == "generate"
+    assert sm.describe()["output_wire"] == "uint8"
+
+
+def test_generate_decode_latent_and_seed(dcgan_serving):
+    _, sm = dcgan_serving
+    wl = WORKLOADS["generate"]
+    z = wl.decode({"seed": 7}, sm)
+    assert z.shape == (100,) and z.dtype == np.float32
+    np.testing.assert_array_equal(z, wl.decode({"seed": 7}, sm))
+    explicit = wl.decode({"latent": z.tolist()}, sm)
+    np.testing.assert_allclose(explicit, z, atol=1e-6)
+    with pytest.raises(ValueError, match="latent shape"):
+        wl.decode({"latent": [0.0] * 3}, sm)
+    with pytest.raises(ValueError, match="non-finite"):
+        wl.decode({"latent": [float("nan")] * 100}, sm)
+
+
+def test_generate_d2h_bytes_exactly_4x_smaller(dcgan_serving):
+    """The output-side mirror of the PR 5 H2D assertion: with the
+    fused uint8 epilogue the bulk device_get moves EXACTLY 4× fewer
+    bytes than the float32 output wire, per batch and in total."""
+    import copy
+
+    _, sm = dcgan_serving
+    z = [np.random.RandomState(i).randn(100).astype(np.float32)
+         for i in range(4)]
+    with BatchingEngine(sm, buckets=[4], max_wait_ms=50) as eng:
+        for f in [eng.submit(x) for x in z]:
+            img = np.asarray(f.result(300))
+            assert img.dtype == np.uint8 and img.shape == (28, 28, 1)
+        u8 = eng.stats()["pipeline"]
+    sm_f32 = copy.copy(sm)
+    sm_f32.output_wire = "float32"  # pin the A/B baseline epilogue off
+    with BatchingEngine(sm_f32, buckets=[4], max_wait_ms=50) as eng:
+        for f in [eng.submit(x) for x in z]:
+            assert np.asarray(f.result(300)).dtype == np.float32
+        f32 = eng.stats()["pipeline"]
+    assert u8["d2h_bytes"] == 4 * 28 * 28 * 1          # one uint8 batch
+    assert f32["d2h_bytes"] == 4 * u8["d2h_bytes"]     # exactly 4.0×
+    assert f32["d2h_bytes_by_bucket"][4] == \
+        4 * u8["d2h_bytes_by_bucket"][4]
+
+
+# -- HTTP: routes, response cache, agreement -------------------------------
+
+
+def test_generate_http_roundtrip_and_response_cache(dcgan_serving):
+    """POST /v1/generate over real HTTP: wire-ready uint8 bytes come
+    back base64'd; an identical payload replays from the response
+    cache (X-DVT-Cache: hit) without touching the engine."""
+    from deep_vision_tpu.serve.cache import ResponseCache
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = dcgan_serving
+    eng = BatchingEngine(sm, buckets=[1], max_wait_ms=2).start()
+    cache = ResponseCache(max_bytes=8 * 2**20)
+    srv = ServeServer(reg, {sm.name: eng}, port=0,
+                      response_cache=cache).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        status, headers, out = _post(base + "/v1/generate", {"seed": 3})
+        assert status == 200
+        img = out["image"]
+        assert img["shape"] == [28, 28, 1] and img["dtype"] == "uint8"
+        import base64
+
+        raw = base64.b64decode(img["b64"])
+        assert len(raw) == 28 * 28 * 1  # 1 byte/pixel on the wire
+        served = eng.served
+        status, headers, out2 = _post(base + "/v1/generate", {"seed": 3})
+        assert status == 200
+        assert headers.get("X-DVT-Cache") == "hit"
+        assert out2 == out
+        assert eng.served == served  # hit consumed no engine capacity
+        assert cache.stats()["hits"] == 1
+        # different seed → different payload digest → miss
+        status, headers, out3 = _post(base + "/v1/generate", {"seed": 4})
+        assert headers.get("X-DVT-Cache") != "hit"
+        assert out3["image"]["b64"] != img["b64"]
+    finally:
+        srv.shutdown()
+        eng.stop()
+
+
+def test_unknown_verb_404_lists_supported(dcgan_serving):
+    """Satellite: unknown verbs 404 with the registry-derived verb
+    list in the body — both the flat and the per-model route."""
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = dcgan_serving
+    eng = BatchingEngine(sm, buckets=[1], max_wait_ms=2).start()
+    srv = ServeServer(reg, {sm.name: eng}, port=0).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        for path in ("/v1/frobnicate", "/v1/models/dcgan/frobnicate"):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                _post(base + path, {"seed": 0})
+            assert exc.value.code == 404
+            body = json.loads(exc.value.read())
+            assert body["supported_verbs"] == sorted(
+                infer_verbs() + LIFECYCLE_VERBS)
+        # wrong verb for the model's workload: 400 names the right one
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post(base + "/v1/pose", {"model": "dcgan", "seed": 0})
+        assert exc.value.code == 400
+        assert "/v1/generate" in json.loads(exc.value.read())["error"]
+    finally:
+        srv.shutdown()
+        eng.stop()
+
+
+def test_shadow_agreement_per_workload():
+    """models.py delegates shadow comparison to the workload: top-1
+    for classify, PCK proximity for pose, digest equality for
+    generate, not-comparable for detect and Shed-ish rows."""
+    from deep_vision_tpu.serve.admission import Shed
+
+    cls = WORKLOADS["classify"]
+    a = np.asarray([0.1, 0.9, 0.3], np.float32)
+    b = np.asarray([0.2, 0.8, 0.1], np.float32)
+    c = np.asarray([0.9, 0.1, 0.1], np.float32)
+    assert cls.agree(a, b) is True
+    assert cls.agree(a, c) is False
+    assert cls.agree(a, Shed("x", "y")) is None
+    assert WORKLOADS["detect"].agree(a, a) is None
+
+    pose = WORKLOADS["pose"]
+    kp = {"keypoints": np.zeros((8, 2), np.float32),
+          "scores": np.zeros(8, np.float32)}
+    near = {"keypoints": kp["keypoints"] + 1.0, "scores": kp["scores"]}
+    far = {"keypoints": kp["keypoints"] + 10.0, "scores": kp["scores"]}
+    assert pose.agree(kp, near) is True     # within pck_px
+    assert pose.agree(kp, far) is False
+    assert pose.agree(kp, Shed("x", "y")) is None
+
+    gen = WORKLOADS["generate"]
+    img = np.random.RandomState(0).randint(0, 256, (28, 28, 1),
+                                           np.uint8)
+    assert gen.agree(img, img.copy()) is True
+    other = img.copy()
+    other[0, 0, 0] ^= 1
+    assert gen.agree(img, other) is False
+    assert gen.agree(img, Shed("x", "y")) is None
+
+
+def test_generate_cacheable_guard():
+    gen, cls = WORKLOADS["generate"], WORKLOADS["classify"]
+    big = 512 * 1024
+    assert gen.cacheable(big)        # generated images are large
+    assert not cls.cacheable(big)    # logits responses never are
+    assert not gen.cacheable(gen.cacheable_bytes + 1)
+
+
+def test_gan_serve_preprocess_kind_matches_trainer():
+    """The image-in GAN wire ("gan" kind) scales exactly like the
+    trainer's make_gan_preprocess: (x - 127.5)/127.5."""
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.ops.preprocess import (
+        make_serve_preprocess,
+        serve_normalize,
+        serve_preprocess_kind,
+    )
+
+    assert serve_preprocess_kind("gan_cyclegan", 3) == "gan"
+    assert serve_preprocess_kind("gan_dcgan", 1) == "gan"
+    u8 = np.asarray([[0, 127, 128, 255]], np.uint8)
+    out = np.asarray(serve_normalize(jnp.asarray(u8), "gan"))
+    np.testing.assert_allclose(
+        out, u8.astype(np.float32) / 127.5 - 1.0, atol=1e-6)
+    assert out.min() >= -1.0 and out.max() <= 1.0
+    # a float wire passes through untouched (client shipped [-1,1])
+    pre = make_serve_preprocess("gan", np.float32)
+    x = np.linspace(-1, 1, 8).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(pre(jnp.asarray(x))), x,
+                               atol=1e-6)
+
+
+def test_restore_serving_input_shape():
+    from deep_vision_tpu.core.config import get_config
+    from deep_vision_tpu.core.restore import serving_input_shape
+
+    assert serving_input_shape(get_config("lenet5")) == (32, 32, 1)
+    assert serving_input_shape(get_config("hourglass_toy")) == \
+        (64, 64, 3)
+    assert serving_input_shape(get_config("dcgan")) == (100,)
+
+
+def test_dcgan_load_state_roundtrips_trainer_params(tmp_path):
+    """load_state's latent-shaped init builds the SAME param tree the
+    trainer does (DCGANTask.init_states inits G with a (1, latent_dim)
+    z) — an image-shaped init would build Dense kernels a trainer
+    checkpoint could never restore into."""
+    import jax
+    import jax.numpy as jnp
+
+    from deep_vision_tpu.core.config import get_config
+    from deep_vision_tpu.core.restore import load_state
+
+    cfg = get_config("dcgan")
+    model, state = load_state(cfg, str(tmp_path), log=lambda *a: None)
+    z = jnp.zeros((1, model.latent_dim))
+    g_vars = model.init({"params": jax.random.PRNGKey(0)}, z,
+                        train=False)
+    serve_shapes = jax.tree_util.tree_map(jnp.shape, state.params)
+    train_shapes = jax.tree_util.tree_map(jnp.shape, g_vars["params"])
+    assert serve_shapes == train_shapes
