@@ -50,6 +50,7 @@ serve-smoke: lint lint-test
 	$(PY) tests/obs_smoke.py
 	$(PY) tests/mesh_smoke.py
 	$(PY) tests/workload_smoke.py
+	$(PY) tests/batch_smoke.py
 
 # the async HTTP edge end to end over real sockets: keep-alive reuse
 # visible in the connection counters, a content-addressed cache hit
@@ -112,6 +113,21 @@ workload-smoke:
 # accounting, the exact 4x generate D2H win, cache/verb/agree gates)
 workload-test:
 	$(PY) -m pytest tests/test_workloads.py -q -m serve
+
+# the offline batch tier end to end: a bulk job POSTed over HTTP
+# drains through the trough-filling scheduler while interactive
+# requests keep answering 200, results stream back as chunked ndjson,
+# and a second server over the same --jobs-dir resumes an unfinished
+# job straight from its JSONL checkpoint (docs/BATCH.md)
+batch-smoke:
+	$(PY) tests/batch_smoke.py
+
+# the batch-tier unit suite alone (job store replay + torn tails,
+# priority-band starvation-freedom, restart resume exactly-once,
+# interactive-p99 interference gate, occupancy autoscaling signal,
+# chunked results stream on both HTTP front-ends)
+batch-test:
+	$(PY) -m pytest tests/test_batch.py -q -m batch
 
 # the continuous train->deploy loop end to end: a real async-Orbax
 # checkpoint published mid-load auto-deploys through debounce -> gate
@@ -212,6 +228,13 @@ bench-serve-mesh:
 bench-serve-wire:
 	$(PY) bench.py --serve --serve-wire
 
+# offline batch tier bench: bulk-job drain on the 2x2 mesh cell
+# (batch img/s, occupancy, occupancy-weighted MFU) plus the
+# interactive-vs-batch interference sweep (docs/PERF.md "Batch tier",
+# docs/BATCH.md)
+bench-serve-batch:
+	$(PY) bench.py --serve-batch
+
 # continuous-deploy reaction bench: checkpoint durable -> new version
 # ACTIVE under live load (debounce + gate + canary), plus autoscale
 # scale-up/scale-down reaction (docs/PERF.md "Deploy reaction")
@@ -262,11 +285,11 @@ list:
 
 .PHONY: test test-all bench bench-serve bench-serve-sync \
 	bench-serve-scaling bench-serve-mesh bench-serve-wire \
-	bench-gateway bench-deploy \
+	bench-serve-batch bench-gateway bench-deploy \
 	bench-input serve-smoke \
 	serve-multi serve-chaos gateway-smoke gateway-test obs-smoke \
 	edge-smoke edge-test input-smoke input-test \
 	obs-test model-smoke model-test quant-smoke quant-test \
 	workload-smoke workload-test \
 	mesh-smoke mesh-test \
-	deploy-smoke deploy-test lint lint-test list
+	deploy-smoke deploy-test batch-smoke batch-test lint lint-test list

@@ -821,6 +821,185 @@ def bench_serve_mesh(mesh_devices: int = 4,
     return last
 
 
+def bench_serve_batch(model_name: str = "lenet5", n_images: int = 256,
+                      shard_size: int | None = None, max_batch: int = 8,
+                      max_wait_ms: float = 2.0, pipeline_depth: int = 2,
+                      mesh: tuple = (2, 2),
+                      mesh_min_shard_dim: int = 64,
+                      loads: tuple = (2, 8),
+                      duration_s: float = 2.0) -> dict:
+    """Offline batch tier bench (``bench.py --serve-batch``; docs/PERF.md
+    "Batch tier"): a bulk job drained through the trough-filling
+    scheduler (serve/batch_sched.py) on a forced-host 2×2 data×model
+    mesh engine, two phases:
+
+    1. *Bulk-only drain*: one ``n_images`` job with no interactive
+       traffic — sustained batch img/s, the drain-phase compute
+       occupancy (Δcompute_s / Δwall from the MFU meter, window-free),
+       and the occupancy-weighted MFU — the sustained-throughput
+       figure the batch tier exists to maximize.
+    2. *Interference sweep*: for each closed-loop interactive load C,
+       interactive p50/p99 WITHOUT any batch work vs WITH a bulk job
+       draining behind the priority band — the p99 ratio is the
+       acceptance number (≈1.0: the band admits shards only into
+       troughs), alongside the batch throughput the troughs yielded.
+
+    On forced host devices every cell shares one chip, so absolute
+    img/s undersells real hardware — the occupancy, MFU, and p99-ratio
+    columns are the transferable numbers."""
+    import sys
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from deep_vision_tpu.core.config import get_config
+    from deep_vision_tpu.core.restore import load_state
+    from deep_vision_tpu.obs.mfu import round_mfu
+    from deep_vision_tpu.parallel.mesh import make_mesh
+    from deep_vision_tpu.serve.admission import Shed
+    from deep_vision_tpu.serve.batch_sched import BatchScheduler
+    from deep_vision_tpu.serve.faults import Quarantined
+    from deep_vision_tpu.serve.engine import (BatchingEngine,
+                                              sharded_buckets)
+    from deep_vision_tpu.serve.jobs import JobStore
+    from deep_vision_tpu.serve.registry import CheckpointServingModel
+    from deep_vision_tpu.serve.replicas import local_devices
+
+    cfg = get_config(model_name)
+    with tempfile.TemporaryDirectory() as td:
+        model, state = load_state(cfg, td,
+                                  log=lambda m: print(m, file=sys.stderr))
+    sm = CheckpointServingModel(model_name, cfg, model, state,
+                                wire_dtype="uint8")
+    img = np.random.RandomState(0).randint(
+        0, 256, size=sm.input_shape, dtype=np.uint8)
+    n_data, n_model = int(mesh[0]), int(mesh[1])
+    grid = make_mesh({"data": n_data, "model": n_model},
+                     devices=local_devices(n_data * n_model))
+    shard = int(shard_size or max_batch)
+
+    def manifest(n):
+        return [{"pixels": np.random.RandomState(i).randint(
+            0, 256, size=sm.input_shape).tolist()} for i in range(n)]
+
+    def mfu_snap(engine):
+        m = engine.stats().get("mfu") or {}
+        return (m.get("flops_total") or 0.0, m.get("compute_s") or 0.0,
+                m.get("peak_flops_per_s"))
+
+    with BatchingEngine(
+            sm.for_mesh(grid, min_shard_dim=mesh_min_shard_dim),
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            buckets=sharded_buckets(max_batch, n_data),
+            pipeline_depth=pipeline_depth) as engine:
+        engine.warmup()  # compiles excluded from both phases
+
+        def run_job(n, sched_kwargs=None):
+            store = JobStore(shard_size=shard)
+            sched = BatchScheduler(store, lambda name: (sm, engine),
+                                   interval_s=0.002,
+                                   **(sched_kwargs or {}))
+            jid = store.submit(model_name, sm.workload.verb,
+                               manifest(n))["job_id"]
+            sched.start()
+            return store, sched, jid
+
+        def interactive_window(clients):
+            latencies: list = []
+            errors = [0]
+            lock = threading.Lock()
+            stop_at = time.perf_counter() + duration_s
+
+            def client():
+                local, local_err = [], 0
+                while time.perf_counter() < stop_at:
+                    t0 = time.perf_counter()
+                    r = engine.infer(img, timeout=60)
+                    if isinstance(r, (Shed, Quarantined)):
+                        local_err += 1
+                        continue
+                    local.append(time.perf_counter() - t0)
+                with lock:
+                    latencies.extend(local)
+                    errors[0] += local_err
+
+            threads = [threading.Thread(target=client)
+                       for _ in range(clients)]
+            t_start = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t_start
+            if not latencies:  # every request shed: report the errors
+                return {"requests": 0, "errors": errors[0],
+                        "img_per_sec": 0.0, "p50_ms": None,
+                        "p99_ms": None}
+            lat_ms = np.asarray(latencies) * 1e3
+            return {"requests": len(latencies), "errors": errors[0],
+                    "img_per_sec": round(len(latencies) / elapsed, 1),
+                    "p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+                    "p99_ms": round(float(np.percentile(lat_ms, 99)), 2)}
+
+        # -- phase 1: bulk-only drain ---------------------------------
+        f0, c0, peak = mfu_snap(engine)
+        store, sched, jid = run_job(n_images)
+        t0 = time.perf_counter()
+        while store.status(jid)["state"] not in ("done", "failed"):
+            time.sleep(0.005)
+        drain_s = time.perf_counter() - t0
+        sched.stop()
+        st = store.status(jid)
+        assert st["state"] == "done", st
+        f1, c1, peak = mfu_snap(engine)
+        occ_drain = min(1.0, (c1 - c0) / drain_s) if drain_s > 0 else None
+        mfu_drain = ((f1 - f0) / (c1 - c0)) / peak \
+            if peak and c1 > c0 else None
+        sched_stats = sched.stats()
+        bulk = {
+            "img_per_sec": round(n_images / drain_s, 1),
+            "drain_s": round(drain_s, 3),
+            "occupancy": round(occ_drain, 4)
+            if occ_drain is not None else None,
+            "occupancy_rolling": engine.stats()["pipeline"]["occupancy"],
+            "serving_mfu": round_mfu(mfu_drain)
+            if mfu_drain is not None else None,
+            "mfu_occupancy_weighted": round_mfu(mfu_drain * occ_drain)
+            if mfu_drain is not None and occ_drain is not None else None,
+            "shards_done": sched_stats["shards_done"],
+            "shards_shed": sched_stats["shards_shed"],
+            "deferred": sched_stats["deferred"]}
+
+        # -- phase 2: interactive-vs-batch interference sweep ---------
+        table = []
+        for clients in loads:
+            base = interactive_window(clients)
+            store, sched, jid = run_job(4 * n_images)
+            done_before = store.status(jid)["images_done"]
+            contended = interactive_window(clients)
+            sched.stop()
+            batch_done = store.status(jid)["images_done"] - done_before
+            contended["batch_img_per_sec"] = round(
+                batch_done / duration_s, 1)
+            ratio = None
+            if base["p99_ms"] and contended["p99_ms"]:
+                ratio = round(contended["p99_ms"] / base["p99_ms"], 3)
+            table.append({
+                "clients": clients, "baseline": base,
+                "with_batch": contended, "p99_ratio": ratio})
+        stats = engine.stats()
+    return {"metric": f"serve_batch_{model_name}_img_per_sec",
+            "value": bulk["img_per_sec"], "unit": "img/s",
+            "model": model_name, "mesh": f"{n_data}x{n_model}",
+            "n_images": n_images, "shard_size": shard,
+            "max_batch": max_batch, "buckets": stats["buckets"],
+            "wire_dtype": stats["wire_dtype"],
+            "bulk": bulk, "interference": table,
+            "param_shard_bytes": stats.get("param_shard_bytes"),
+            "device_kind": jax.devices()[0].device_kind}
+
+
 def bench_serve_wire(**kwargs) -> dict:
     """Wire-format comparison sweep (``make bench-serve-wire``): the
     serve bench across all six wire × compute cells — f32/uint8 wire ×
@@ -2444,6 +2623,15 @@ def main():
                         "p99, per-chip param_shard_bytes per cell "
                         "(docs/PERF.md \"Mesh scaling\"); forces N "
                         "host devices when the platform exposes fewer")
+    p.add_argument("--serve-batch", action="store_true",
+                   help="offline batch tier bench on a forced-host 2x2 "
+                        "data×model mesh: bulk-job drain (batch img/s, "
+                        "occupancy, occupancy-weighted MFU) plus the "
+                        "interactive-vs-batch interference sweep over "
+                        "--serve-loads (docs/PERF.md \"Batch tier\", "
+                        "docs/BATCH.md)")
+    p.add_argument("--batch-images", type=int, default=256,
+                   help="bulk-job manifest size for --serve-batch")
     p.add_argument("--ema-decay", type=float, default=0.0,
                    help="measure the train step with the params-EMA "
                         "update in it (the Trainer's --ema-decay)")
@@ -2531,6 +2719,23 @@ def main():
             duration_s=args.serve_duration, max_batch=args.batch or 8,
             pipeline_depth=args.serve_pipeline_depth,
             backends=args.gateway_backends)))
+        return
+    if args.serve_batch:
+        # the 2x2 batch-tier mesh needs 4 addressable devices — force
+        # host devices before the backend initializes (the --serve-mesh
+        # trick), honoring an operator-set XLA_FLAGS
+        import os
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "--xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=4"
+            ).strip()
+        print(json.dumps(bench_serve_batch(
+            model_name=args.serve_model, n_images=args.batch_images,
+            max_batch=args.batch or 8,
+            pipeline_depth=args.serve_pipeline_depth,
+            loads=tuple(int(c) for c in args.serve_loads.split(",")),
+            duration_s=args.serve_duration)))
         return
     if args.serve or args.serve_mesh:
         serve_kwargs = dict(

@@ -36,6 +36,13 @@
     python -m deep_vision_tpu.cli.serve --models lenet5,yolov3_toy \\
         --workdir runs --hbm-budget-mb 512 --canary-frac 0.1
 
+    # offline batch tier: POST bulk job manifests to /v1/jobs; shards
+    # drain through the same engines strictly below interactive
+    # traffic and checkpoint to JSONL so a restarted server resumes
+    # mid-job (docs/BATCH.md)
+    python -m deep_vision_tpu.cli.serve -m resnet50 --workdir runs/r50 \\
+        --jobs-dir runs/r50/jobs
+
     # continuous deploy: watch each model's workdir for new
     # checkpoints, gate them on held-out data, roll out through
     # shadow/canary, and autoscale replicas with demand
@@ -73,6 +80,37 @@ def _edge_kwargs(args):
         response_cache=ResponseCache(int(cache_mb * 2**20))
         if cache_mb > 0 else None,
         qos=TenantQoS.parse(qos_spec) if qos_spec else None)
+
+
+def _batch_tier(args, resolve):
+    """``--jobs-dir`` → (JobStore, started BatchScheduler) or
+    (None, None).
+
+    ``resolve(model_name) -> (model, engine)`` is the routing closure
+    each build path supplies (engines dict or control plane); the
+    scheduler fails a job terminally when it raises KeyError.  The
+    shard size defaults to the engine's max batch — one shard is one
+    full cohort, the unit the trough check reasons about
+    (docs/BATCH.md)."""
+    jobs_dir = getattr(args, "jobs_dir", None)
+    if jobs_dir is None:
+        return None, None
+    from deep_vision_tpu.serve.batch_sched import BatchScheduler
+    from deep_vision_tpu.serve.jobs import JobStore
+
+    shard = int(getattr(args, "batch_shard_size", 0) or 0) \
+        or int(args.max_batch)
+    store = JobStore(jobs_dir or None, shard_size=shard)
+    sched = BatchScheduler(
+        store, resolve,
+        interval_s=float(getattr(args, "batch_interval_ms", 20.0) or
+                         20.0) / 1e3,
+        max_interactive_depth=int(getattr(args, "batch_max_depth", 0)
+                                  or 0),
+        pressure_high_ms=float(getattr(args, "batch_pressure_ms", 10.0)
+                               or 10.0))
+    sched.start()
+    return store, sched
 
 
 def _parse_mesh_arg(spec: str) -> tuple[int, int]:
@@ -251,13 +289,21 @@ def build_server(args):
         print(f"[serve] warming {engine.buckets} ...")
         engine.warmup()
     socket_timeout_s = getattr(args, "socket_timeout_s", 30.0)
+    engines = {sm.name: engine}
+
+    def resolve(name, _engines=engines):
+        eng = _engines[name]  # KeyError → job fails terminally
+        return registry.get(name), eng
+
+    jobs, batch_sched = _batch_tier(args, resolve)
     server = ServeServer(
-        registry, {sm.name: engine}, host=args.host, port=args.port,
+        registry, engines, host=args.host, port=args.port,
         verbose=args.verbose,
         max_body_bytes=int(getattr(args, "max_body_mb", 32) * 2**20),
         socket_timeout_s=socket_timeout_s if socket_timeout_s > 0
         else None,
-        tracer=tracer, **_edge_kwargs(args))
+        tracer=tracer, jobs=jobs, batch_sched=batch_sched,
+        **_edge_kwargs(args))
     return engine, server
 
 
@@ -438,6 +484,14 @@ def _build_plane_server(args, registry, wire_dtype: str,
                                   autoscalers=autoscalers or None)
         pipeline.start()
     socket_timeout_s = getattr(args, "socket_timeout_s", 30.0)
+
+    def resolve(name):
+        # per-shard re-resolution: a hot reload swaps the active
+        # engine and the NEXT shard follows it (KeyError → job fails)
+        model = plane.resolve(name)
+        return model, plane.active_engine(model.name)
+
+    jobs, batch_sched = _batch_tier(args, resolve)
     server = ServeServer(
         registry, plane.active_engines(), host=args.host,
         port=args.port, verbose=args.verbose,
@@ -445,6 +499,7 @@ def _build_plane_server(args, registry, wire_dtype: str,
         socket_timeout_s=socket_timeout_s if socket_timeout_s > 0
         else None,
         tracer=tracer, plane=plane, deploy=pipeline,
+        jobs=jobs, batch_sched=batch_sched,
         **_edge_kwargs(args))
     return plane, server
 
@@ -678,6 +733,31 @@ def main(argv=None):
                         "classes with token-bucket quotas and "
                         "pressure-weighted shedding (docs/SERVING.md; "
                         "empty = off)")
+    # -- offline batch tier (docs/BATCH.md) --
+    p.add_argument("--jobs-dir", default=None,
+                   help="enable the offline batch-inference tier "
+                        "(POST /v1/jobs) and checkpoint job progress "
+                        "as append-only JSONL under this directory — "
+                        "a restarted server resumes unfinished jobs "
+                        "from their last durable shard ('' = enabled "
+                        "but memory-only, no restart durability)")
+    p.add_argument("--batch-shard-size", type=int, default=0,
+                   help="images per batch job shard — the durability "
+                        "AND scheduling unit (0 = --max-batch, one "
+                        "engine cohort; the worst interference any "
+                        "interactive request can see)")
+    p.add_argument("--batch-interval-ms", type=float, default=20.0,
+                   help="batch scheduler poll pacing while deferred "
+                        "behind interactive load")
+    p.add_argument("--batch-max-depth", type=int, default=0,
+                   help="max interactive queue depth at which a batch "
+                        "shard may still be submitted (default 0: any "
+                        "waiting interactive request parks the batch "
+                        "tier)")
+    p.add_argument("--batch-pressure-ms", type=float, default=10.0,
+                   help="interactive pressure ceiling (queue_depth x "
+                        "exec EWMA, ms) for the trough check; above "
+                        "it batch work defers")
     # -- observability (docs/OBSERVABILITY.md) --
     p.add_argument("--log-level", default="info",
                    choices=("debug", "info", "warning", "error"),
@@ -750,6 +830,14 @@ def main(argv=None):
         else:
             print("[serve] sharded batches: "
                   f"{engine.model.placement_desc()}")
+    jobs = getattr(server.httpd, "jobs", None)
+    if jobs is not None:
+        print(f"[serve] batch tier: POST http://{server.host}:"
+              f"{server.port}/v1/jobs "
+              f"(jobs_dir={jobs.root or 'memory-only'}, "
+              f"shard_size={jobs.default_shard_size}, "
+              f"max_depth={args.batch_max_depth}, "
+              f"pressure={args.batch_pressure_ms}ms — docs/BATCH.md)")
     if engine.faults.enabled:
         print(f"[serve] FAULT INJECTION ACTIVE: '{engine.faults.spec}' "
               f"(seed {engine.faults.seed})")
@@ -764,6 +852,12 @@ def main(argv=None):
             # the watcher/autoscaler threads stop BEFORE the engines
             # drain — no scale action or rollout races the shutdown
             deploy.stop()
+        batch_sched = getattr(server.httpd, "batch_sched", None)
+        if batch_sched is not None:
+            # likewise the batch scheduler: no shard submit may race
+            # engine.stop(); in-flight shard results past this point
+            # shed and replay from the JSONL checkpoint on next boot
+            batch_sched.stop()
         server.shutdown()
         engine.stop(drain_deadline=args.drain_deadline)
     return 0

@@ -14,6 +14,19 @@ measurement machinery.  Each tick reads three signals off the
              stopping — scale-down never drops admitted work).
   bounds     live replicas stay in [min_replicas, max_replicas].
 
+Queue pressure is the wrong hot signal for THROUGHPUT workloads
+(ROADMAP): a batchy-SLO engine (the "batchy" service class,
+serve/workloads.py — generative models, and any engine the batch tier
+saturates) runs flat out with an empty queue, because work arrives as
+full cohorts that go straight in-flight.  For those engines the scaler
+switches its hot signal to the engine's rolling compute **occupancy**
+(``engine.occupancy()``, the same measurement the MFU denominator
+uses): occupancy ≥ ``occupancy_high`` sustained for ``up_window`` →
+scale up, and scale-down additionally requires occupancy ≤
+``occupancy_low`` so the gap between two back-to-back shards can't
+read as idle.  Interactive-SLO engines keep the original pressure
+signal unchanged.
+
 Stability is structural, not tuned: the two windows are hysteresis
 (one hot tick can't scale up, one idle tick can't scale down; any
 contrary tick resets the streak), and every action starts a
@@ -45,7 +58,8 @@ class ReplicaAutoscaler:
                  interval_s: float = 0.5, high_water_ms: float = 50.0,
                  up_window: int = 3, down_window: int = 10,
                  cooldown_s: float = 5.0, drain_deadline_s: float = 5.0,
-                 history=None):
+                 occupancy_high: float = 0.75,
+                 occupancy_low: float = 0.2, history=None):
         if min_replicas < 1:
             raise ValueError(f"min_replicas {min_replicas}: need >= 1")
         if max_replicas is not None and max_replicas < min_replicas:
@@ -67,6 +81,8 @@ class ReplicaAutoscaler:
         self.down_window = int(down_window)
         self.cooldown_s = float(cooldown_s)
         self.drain_deadline_s = float(drain_deadline_s)
+        self.occupancy_high = float(occupancy_high)
+        self.occupancy_low = float(occupancy_low)
         self.history = history
         self._up_ticks = 0
         self._down_ticks = 0
@@ -86,13 +102,23 @@ class ReplicaAutoscaler:
 
     def signals(self) -> dict:
         """One coherent-enough snapshot of the engine's load signals."""
-        ewma = self.engine.admission.bucket_ewma_s() or 0.0
-        depth = self.engine._queue.qsize()
+        eng = self.engine
+        ewma = eng.admission.bucket_ewma_s() or 0.0
+        depth = eng._queue.qsize()
+        occ_fn = getattr(eng, "occupancy", None)
+        wl = getattr(getattr(eng, "model", None), "workload", None)
         return {"queue_depth": depth,
                 "exec_ewma_ms": round(ewma * 1e3, 3),
                 "pressure_ms": round(depth * ewma * 1e3, 3),
-                "inflight": self.engine.total_inflight(),
-                "live": self.engine.live_replicas()}
+                "inflight": eng.total_inflight(),
+                "live": eng.live_replicas(),
+                # rolling compute duty cycle; None on engines that
+                # don't measure it (the pressure path still works)
+                "occupancy": occ_fn() if callable(occ_fn) else None,
+                # the signal switch: batchy-SLO engines scale on
+                # occupancy, interactive ones on queue pressure
+                "batchy": getattr(getattr(wl, "slo", None), "name",
+                                  "") == "batchy"}
 
     def tick(self) -> dict | None:
         """One scaling decision; returns the action taken (or None).
@@ -102,12 +128,18 @@ class ReplicaAutoscaler:
         self.ticks += 1
         sig = self.signals()
         live = sig["live"]
-        if sig["pressure_ms"] > self.high_water_ms \
-                and live < self.max_replicas:
+        use_occ = sig["batchy"] and sig["occupancy"] is not None
+        hot = (sig["occupancy"] >= self.occupancy_high) if use_occ \
+            else sig["pressure_ms"] > self.high_water_ms
+        idle = sig["queue_depth"] == 0 and sig["inflight"] == 0
+        if use_occ:
+            # the gap between two back-to-back shards samples as
+            # queue 0 / inflight 0; the rolling window doesn't lie
+            idle = idle and sig["occupancy"] <= self.occupancy_low
+        if hot and live < self.max_replicas:
             self._up_ticks += 1
             self._down_ticks = 0
-        elif sig["queue_depth"] == 0 and sig["inflight"] == 0 \
-                and live > self.min_replicas:
+        elif idle and live > self.min_replicas:
             self._down_ticks += 1
             self._up_ticks = 0
         else:
@@ -148,7 +180,8 @@ class ReplicaAutoscaler:
         if self.history is not None:
             self.history.record(self.name, direction, replica=replica,
                                 live=action["live"],
-                                pressure_ms=sig["pressure_ms"])
+                                pressure_ms=sig["pressure_ms"],
+                                occupancy=sig["occupancy"])
         return action
 
     # -- lifecycle ---------------------------------------------------------
@@ -184,6 +217,8 @@ class ReplicaAutoscaler:
                "high_water_ms": self.high_water_ms,
                "up_window": self.up_window,
                "down_window": self.down_window,
+               "occupancy_high": self.occupancy_high,
+               "occupancy_low": self.occupancy_low,
                "cooldown_s": self.cooldown_s,
                "ticks": self.ticks,
                "scale_ups": self.scale_ups,

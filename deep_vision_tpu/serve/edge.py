@@ -80,14 +80,23 @@ _BODY = "body"   # headers parsed, awaiting Content-Length bytes
 
 
 class _Slot:
-    """One request's ordered response slot on its connection."""
+    """One request's ordered response slot on its connection.
 
-    __slots__ = ("done", "data", "close")
+    Buffered responses (``chunks is None``) fill ``data`` once and flip
+    ``done``.  Streaming responses (HTTP/1.1 chunked transfer) set
+    ``chunks`` to a deque the worker appends framed pieces to while the
+    loop drains the head slot incrementally; ``done`` flips only after
+    the terminating ``0\\r\\n\\r\\n`` frame (or, on a mid-stream handler
+    error, without it — a truncated chunked body is how HTTP signals an
+    incomplete response — with ``close`` set so the connection drops)."""
+
+    __slots__ = ("done", "data", "close", "chunks")
 
     def __init__(self):
         self.done = False
         self.data = b""
         self.close = False
+        self.chunks: deque | None = None
 
 
 class _Conn:
@@ -173,6 +182,10 @@ class EdgeServer:
         self.timeouts_408 = 0
         self.closed_idle = 0
         self.overlong_heads = 0
+        # streaming counters are WORKER-thread writes (unlike the loop
+        # counters above), so they ride the existing response lock
+        self.streams_started = 0  # guarded-by: _ready_lock
+        self.stream_errors = 0  # guarded-by: _ready_lock
         self.draining = False  # handler context default; tiers override
 
     # -- lifecycle (ThreadingHTTPServer-compatible surface) ----------------
@@ -413,14 +426,60 @@ class EdgeServer:
         """Worker thread: run the handler shim, post the response back
         to the loop through the connection's ordered slot."""
         try:
-            data, close = self._handle(method, path, version, headers,
-                                       body, conn.addr)
+            data, close, stream = self._handle(method, path, version,
+                                               headers, body, conn.addr)
         except Exception as e:  # noqa: BLE001 — a handler bug must answer 500, not hang the slot
             data = _plain_response(
                 500, "Internal Server Error", version,
                 {"error": f"{type(e).__name__}: {e}"}, close=True)
             close = True
-        slot.data = data
+            stream = None
+        if stream is None:
+            slot.data = data
+            slot.close = close
+            slot.done = True
+            with self._ready_lock:
+                self._ready.append(conn)
+            self._wake()
+            return
+        self._stream_slot(conn, slot, data, close, stream)
+
+    def _stream_slot(self, conn, slot, head: bytes, close: bool, stream):
+        """Worker thread: pump a chunked response through the slot one
+        frame at a time — the loop flushes each frame as it lands, so a
+        result set larger than any buffer bound streams in O(1) memory.
+        Appends and the loop's poplefts hit opposite ends of the deque
+        (atomic under the GIL — the same ordering contract buffered
+        slots already rely on for ``data``/``done``)."""
+        slot.chunks = deque((head,))
+        with self._ready_lock:
+            self.streams_started += 1
+            self._ready.append(conn)
+        self._wake()
+        try:
+            for piece in stream:
+                if conn.sock is None:
+                    break  # client went away: stop producing
+                if not piece:
+                    continue
+                slot.chunks.append(_chunk_frame(piece))
+                with self._ready_lock:
+                    self._ready.append(conn)
+                self._wake()
+        except Exception:  # noqa: BLE001 — mid-stream generator bug: truncate the chunked body (the HTTP incomplete-response signal) and drop the connection
+            with self._ready_lock:
+                self.stream_errors += 1
+            slot.close = True
+            slot.done = True
+            with self._ready_lock:
+                self._ready.append(conn)
+            self._wake()
+            return
+        finally:
+            close_fn = getattr(stream, "close", None)
+            if close_fn is not None:
+                close_fn()
+        slot.chunks.append(_CHUNK_END)
         slot.close = close
         slot.done = True
         with self._ready_lock:
@@ -447,6 +506,9 @@ class EdgeServer:
         h.headers = headers
         h.rfile = io.BytesIO(body)
         h.wfile = io.BytesIO()
+        # handlers test this to DEFER chunked bodies to the edge loop
+        # (http._Handler._reply_stream) instead of writing them inline
+        h._edge_stream = True
         conn_hdr = (headers.get("Connection") or "").lower()
         h.close_connection = (
             "close" in conn_hdr
@@ -457,9 +519,12 @@ class EdgeServer:
             return _plain_response(
                 501, "Unsupported method", version,
                 {"error": f"Unsupported method ({method!r})"},
-                close=True), True
+                close=True), True, None
         fn()
-        return h.wfile.getvalue(), bool(h.close_connection)
+        # a streaming route leaves head bytes in wfile and the body
+        # generator on h._stream; buffered routes leave _stream unset
+        return (h.wfile.getvalue(), bool(h.close_connection),
+                getattr(h, "_stream", None))
 
     # -- loop-side response delivery ----------------------------------------
 
@@ -477,10 +542,24 @@ class EdgeServer:
 
     def _pump(self, conn: _Conn):  # dvtlint: hot
         """Move completed responses (in request order) into the output
-        buffer, then write greedily."""
-        while conn.pending and conn.pending[0].done:
-            slot = conn.pending.popleft()
-            conn.outbuf += slot.data
+        buffer, then write greedily.  A streaming head slot drains
+        whatever frames its worker has produced so far even while not
+        done — that's what makes chunked responses flow instead of
+        buffering whole — but later slots still wait their turn."""
+        while conn.pending:
+            slot = conn.pending[0]
+            # read done BEFORE draining chunks: the worker appends its
+            # last frame before flipping done, so done-then-drain can
+            # never strand a frame behind a popped slot
+            done = slot.done
+            if slot.chunks is not None:
+                while slot.chunks:
+                    conn.outbuf += slot.chunks.popleft()
+            if not done:
+                break  # head-of-line still executing/streaming
+            if slot.chunks is None:
+                conn.outbuf += slot.data
+            conn.pending.popleft()
             if slot.close:
                 conn.closing = True
                 conn.pending.clear()
@@ -600,7 +679,19 @@ class EdgeServer:
                 "timeouts_408": self.timeouts_408,
                 "closed_idle": self.closed_idle,
                 "overlong_heads": self.overlong_heads,
+                "streams_started": self.streams_started,
+                "stream_errors": self.stream_errors,
                 "workers": self._pool._max_workers}
+
+
+#: chunked transfer terminator (RFC 9112 §7.1): zero-length chunk
+_CHUNK_END = b"0\r\n\r\n"
+
+
+def _chunk_frame(data: bytes) -> bytes:
+    """One HTTP/1.1 chunked-transfer frame: hex length, CRLF, payload,
+    CRLF."""
+    return f"{len(data):x}\r\n".encode("ascii") + data + b"\r\n"
 
 
 def _plain_response(status: int, reason: str, version: str,

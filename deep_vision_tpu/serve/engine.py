@@ -70,6 +70,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 
 import numpy as np
@@ -356,6 +357,13 @@ class BatchingEngine:
         self._first_dispatch: float | None = None  # guarded-by: _lock
         self._last_done: float | None = None  # guarded-by: _lock
         self._idle_s = 0.0  # guarded-by: _lock
+        # compute-occupancy window: (t_done, busy_s) per executed batch,
+        # busy_s being the same compute-stage measurement admission and
+        # the MFU meter consume.  A ROLLING gauge (unlike the span-long
+        # _idle_s proxy): the batch scheduler's trough maths and the
+        # batchy-SLO autoscaler both need "busy lately", not "busy ever"
+        self.occupancy_window_s = 10.0
+        self._busy_events: deque = deque()  # guarded-by: _lock
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -732,6 +740,8 @@ class BatchingEngine:
         with self._lock:
             busy_from = rec.dispatched_at if self._last_done is None \
                 else max(rec.dispatched_at, self._last_done)
+            self._busy_events.append((t_done, t_done - busy_from))
+            self._prune_busy_locked(t_done)
         self.admission.observe_exec(t_done - busy_from, bucket=rec.bucket)
         # the same device-occupancy measurement is the serving-MFU
         # denominator: compute-stage seconds, not queue or drain wait
@@ -897,6 +907,8 @@ class BatchingEngine:
             self.d2h_bytes += nbytes
             self.d2h_bytes_by_bucket[bucket] = \
                 self.d2h_bytes_by_bucket.get(bucket, 0) + nbytes
+            self._busy_events.append((t_done, t_done - t0))
+            self._prune_busy_locked(t_done)
         self.throughput.update(n)
         for i, req in enumerate(requests):
             self.latency.record(t_done - req.enqueued_at)
@@ -1050,7 +1062,27 @@ class BatchingEngine:
         pressure signal (``Queue.qsize`` is already thread-safe)."""
         return self._queue.qsize()
 
+    def _prune_busy_locked(self, now: float) -> None:
+        horizon = now - self.occupancy_window_s
+        while self._busy_events and self._busy_events[0][0] < horizon:
+            self._busy_events.popleft()
+
+    def _occupancy_locked(self, now: float) -> float:
+        self._prune_busy_locked(now)
+        busy = sum(dt for _, dt in self._busy_events)
+        return min(1.0, max(0.0, busy / self.occupancy_window_s))
+
+    def occupancy(self) -> float:
+        """Fraction of the trailing ``occupancy_window_s`` spent in
+        batch execution — the compute-stage duty cycle.  This is the
+        throughput-workload pressure signal (deploy/autoscale.py): a
+        saturated batchy engine shows occupancy →1 with queue depth 0,
+        exactly the state queue-based pressure can't see."""
+        with self._lock:
+            return self._occupancy_locked(time.monotonic())
+
     def stats(self) -> dict:
+        now = time.monotonic()
         with self._lock:
             span = None
             if self._first_dispatch is not None and \
@@ -1104,7 +1136,11 @@ class BatchingEngine:
                        # last-drain span with an empty in-flight window
                        "device_idle_frac": (
                            round(self._idle_s / span, 4)
-                           if span and span > 0 else None)}}
+                           if span and span > 0 else None),
+                       # rolling compute duty cycle (trailing window) —
+                       # the batch-tier/autoscaler signal
+                       "occupancy": round(
+                           self._occupancy_locked(now), 4)}}
         out["pipeline"]["staging"] = self.staging.stats()
         out["latency"] = self.latency.percentiles()
         # full histogram state rides along so upstream aggregators (the

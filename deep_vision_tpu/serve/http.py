@@ -67,6 +67,21 @@ Routes (JSON in, JSON out):
                        lifecycle is in flight or nothing to revert to /
                        500 when the restored version fails to boot
                        (docs/DEPLOY.md runbook)
+    POST /v1/jobs      offline batch tier (serve/jobs.py): submit a
+                       manifest {"items": [<request bodies>], "model"?,
+                       "shard_size"?} → 202 with a job handle; the
+                       trough-filling scheduler (serve/batch_sched.py)
+                       drains it through the engines strictly below
+                       interactive traffic.  503 unless the tier is
+                       wired (cli.serve --jobs-dir)
+    GET  /v1/jobs      job listing (status views, FIFO order)
+    GET  /v1/jobs/{id} one job's status: state, shards done, images
+    GET  /v1/jobs/{id}/results
+                       chunked ndjson stream of the job's completed
+                       results — the contiguous shard prefix, one
+                       {"index": i, ...} line per item plus a trailing
+                       {"status": ...} line; re-issue after completion
+                       for the full set (results are durable)
     POST /v1/drain     zero-downtime shutdown hook: healthz flips to
                        503 ``draining`` IMMEDIATELY (so a gateway or
                        load balancer stops routing here), new requests
@@ -130,7 +145,12 @@ from urllib.parse import parse_qs
 from deep_vision_tpu.obs.trace import REQUEST_ID_HEADER, new_request_id
 from deep_vision_tpu.serve.admission import TENANT_HEADER
 from deep_vision_tpu.serve.cache import ResponseCache, payload_digest
-from deep_vision_tpu.serve.edge import DEFAULT_MAX_CONNECTIONS, EdgeServer
+from deep_vision_tpu.serve.edge import (
+    _CHUNK_END,
+    DEFAULT_MAX_CONNECTIONS,
+    EdgeServer,
+    _chunk_frame,
+)
 from deep_vision_tpu.serve.workloads import (
     LIFECYCLE_VERBS,
     WORKLOADS,
@@ -243,6 +263,8 @@ def render_serve_metrics(stats: dict) -> str:
 
     p = PromText()
     _render_edge_metrics(p, stats)
+    if isinstance(stats.get("batch"), dict):
+        _render_batch_metrics(p, stats["batch"])
     if isinstance(stats.get("models"), dict):
         for name, entry in stats["models"].items():
             if isinstance(entry.get("engine"), dict):
@@ -305,7 +327,7 @@ def render_serve_metrics(stats: dict) -> str:
             _render_deploy_metrics(p, dep)
         return p.render()
     for name, s in stats.items():
-        if name in ("edge", "response_cache", "qos"):
+        if name in ("edge", "response_cache", "qos", "batch"):
             continue  # front-end blocks, rendered above
         _render_engine_metrics(p, name, s)
     return p.render()
@@ -412,6 +434,48 @@ def _render_deploy_metrics(p, dep: dict) -> None:
                   help="Scale actions that raised (cooldown consumed)")
         p.gauge("dvt_deploy_pressure_ms", a.get("pressure_ms"), lab,
                 help="queue_depth × exec EWMA — the scale-up signal")
+        if a.get("occupancy") is not None:
+            p.gauge("dvt_deploy_occupancy", a.get("occupancy"), lab,
+                    help="Engine compute occupancy — the batchy-SLO "
+                         "scale-up signal (queue depth misses "
+                         "throughput saturation)")
+
+
+def _render_batch_metrics(p, batch: dict) -> None:
+    """Emit the offline batch tier's dvt_batch_* series from the
+    ``batch`` stats block (jobs store + trough-filling scheduler +
+    occupancy-weighted MFU; docs/BATCH.md tabulates these)."""
+    jobs = batch.get("jobs") or {}
+    sched = batch.get("scheduler") or {}
+    p.counter("dvt_batch_jobs_submitted_total", jobs.get("submitted"),
+              {}, help="Bulk jobs accepted via POST /v1/jobs")
+    p.counter("dvt_batch_images_total", jobs.get("images_done"), {},
+              help="Images with durable batch results (end-to-end "
+                   "goodput; replayed checkpoint shards count once)")
+    p.counter("dvt_batch_jobs_resumed_total", jobs.get("resumed"), {},
+              help="Unfinished jobs resumed from the JSONL checkpoint "
+                   "at boot")
+    p.counter("dvt_batch_checkpoint_write_errors_total",
+              jobs.get("write_errors"), {},
+              help="Job-ledger appends that failed to reach disk")
+    for state, n in (jobs.get("states") or {}).items():
+        p.gauge("dvt_batch_jobs", n, {"state": state},
+                help="Jobs by lifecycle state")
+    p.counter("dvt_batch_shards_total", sched.get("shards_done"), {},
+              help="Shards drained to a durable record this process")
+    p.counter("dvt_batch_shards_shed_total", sched.get("shards_shed"),
+              {}, help="Whole-shard retries after an engine shed")
+    p.counter("dvt_batch_deferred_total", sched.get("deferred"), {},
+              help="Trough checks that parked batch work behind "
+                   "interactive pressure")
+    p.gauge("dvt_batch_occupancy", sched.get("occupancy"), {},
+            help="Fraction of the trailing window batch shards kept "
+                 "an engine busy (the trough-filling duty cycle)")
+    for mname, v in (batch.get("mfu_occupancy_weighted") or {}).items():
+        p.gauge("dvt_batch_mfu_weighted", v, {"model": mname},
+                help="serving MFU x engine compute occupancy — the "
+                     "sustained-throughput MFU a saturating bulk job "
+                     "should drive toward the interactive peak")
 
 
 def _render_engine_metrics(p, name: str, s: dict) -> None:
@@ -486,6 +550,9 @@ def _render_engine_metrics(p, name: str, s: dict) -> None:
     pipe = s.get("pipeline", {})
     p.gauge("dvt_serve_inflight", pipe.get("inflight"), lab,
             help="Dispatched-but-undrained batches")
+    p.gauge("dvt_serve_occupancy", pipe.get("occupancy"), lab,
+            help="Compute duty cycle over the trailing window — the "
+                 "throughput-workload pressure signal")
     p.counter("dvt_serve_h2d_transfers_total",
               pipe.get("h2d_transfers"), lab,
               help="Staged-batch host-to-device transfers")
@@ -535,6 +602,11 @@ class _Handler(BaseHTTPRequestHandler):
     _rid = None
     _span = None
     _raw_body = None  # raw payload bytes — the cache's content address
+    # chunked-response state: edge._handle sets _edge_stream on its
+    # shim; _reply_stream parks the body generator on _stream for the
+    # event loop to pump (serve/edge.py), or drains inline without it
+    _edge_stream = False
+    _stream = None
 
     # -- plumbing ----------------------------------------------------------
 
@@ -566,6 +638,31 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(blob)
+
+    def _reply_stream(self, status: int, chunks,
+                      ctype: str = "application/x-ndjson",
+                      headers: dict | None = None):
+        """Chunked-transfer reply: ``chunks`` is an iterator of body
+        byte pieces.  Under the selector edge the generator is handed
+        to the event loop, which frames and flushes each piece as the
+        worker produces it — a result set bigger than any buffer bound
+        streams in O(1) memory.  Under the threaded baseline server the
+        same frames drain inline to the real socket."""
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Transfer-Encoding", "chunked")
+        if self._rid is not None:
+            self.send_header(REQUEST_ID_HEADER, self._rid)
+        for k, v in (headers or {}).items():
+            self.send_header(k, str(v))
+        self.end_headers()
+        if getattr(self, "_edge_stream", False):
+            self._stream = chunks
+            return
+        for piece in chunks:
+            if piece:
+                self.wfile.write(_chunk_frame(piece))
+        self.wfile.write(_CHUNK_END)
 
     def _body(self) -> dict:
         length = int(self.headers.get("Content-Length") or 0)
@@ -771,6 +868,107 @@ class _Handler(BaseHTTPRequestHandler):
             out["qos"] = qos.stats()
         return out
 
+    def _add_batch_block(self, stats: dict) -> None:
+        """Attach the offline batch tier's ``batch`` stats block (jobs
+        store + scheduler + occupancy-weighted MFU) when the tier is
+        wired.  Like "edge", the key is reserved: no model may be named
+        "batch".  The weighted MFU multiplies each engine's serving MFU
+        (compute-stage efficiency) by its rolling occupancy (how much
+        of the wall clock that compute actually filled) — the
+        sustained-throughput figure a saturating bulk job should push
+        toward the interactive MFU."""
+        store = getattr(self.server, "jobs", None)
+        if store is None:
+            return
+        sched = getattr(self.server, "batch_sched", None)
+        block = {"jobs": store.stats(),
+                 "scheduler": sched.stats() if sched is not None
+                 else None}
+        models = stats.get("models")
+        if isinstance(models, dict):
+            eng_stats = {n: e.get("engine") for n, e in models.items()}
+        else:
+            eng_stats = {n: s for n, s in stats.items()
+                         if isinstance(s, dict) and "pipeline" in s}
+        from deep_vision_tpu.obs.mfu import round_mfu
+
+        weighted = {}
+        for name, s in eng_stats.items():
+            if not isinstance(s, dict):
+                continue
+            mfu = (s.get("mfu") or {}).get("serving_mfu")
+            occ = (s.get("pipeline") or {}).get("occupancy")
+            if mfu is not None and occ is not None:
+                weighted[name] = round_mfu(mfu * occ)
+        block["mfu_occupancy_weighted"] = weighted
+        stats["batch"] = block
+
+    def _job_results_ndjson(self, job_id: str):
+        """The results stream body: one JSON line per completed item
+        (contiguous shard prefix, manifest order) and a trailing
+        ``{"status": ...}`` line clients use to tell "all results
+        delivered" from "drained so far"."""
+        store = self.server.jobs
+        for idx, item in store.results_items(job_id):
+            yield json.dumps({"index": idx, **item}).encode() + b"\n"
+        yield json.dumps({"status": store.status(job_id)}).encode() \
+            + b"\n"
+
+    def _jobs_get(self, path: str) -> None:
+        store = getattr(self.server, "jobs", None)
+        if store is None:
+            self._reply(503, {"error": "batch jobs are not enabled "
+                                       "(cli.serve --jobs-dir ...)"})
+            return
+        parts = path.split("/")
+        if len(parts) == 3:  # /v1/jobs
+            self._reply(200, {"jobs": store.jobs()})
+            return
+        try:
+            status = store.status(parts[3])
+        except KeyError:
+            self._reply(404, {"error": f"no job '{parts[3]}'"})
+            return
+        if len(parts) == 4:  # /v1/jobs/<id>
+            self._reply(200, status)
+        elif len(parts) == 5 and parts[4] == "results":
+            self._reply_stream(200, self._job_results_ndjson(parts[3]))
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def _jobs_post(self) -> tuple:
+        """POST /v1/jobs → (status, payload): validate the manifest,
+        resolve the target model, persist the job, kick the scheduler.
+        202: the reply is a job HANDLE — results arrive via the
+        trough-filling drain, not this request."""
+        store = getattr(self.server, "jobs", None)
+        if store is None:
+            return 503, {"error": "batch jobs are not enabled "
+                                  "(cli.serve --jobs-dir ...)"}
+        body = self._body()
+        items = body.get("items")
+        if not isinstance(items, list) or not items:
+            raise ServeError(
+                400, "manifest 'items' must be a non-empty list of "
+                     "request bodies")
+        shard_size = body.get("shard_size")
+        if shard_size is not None:
+            try:
+                shard_size = int(shard_size)
+            except (TypeError, ValueError) as e:
+                raise ServeError(
+                    400, f"bad shard_size: {body['shard_size']!r}") from e
+            if shard_size <= 0:
+                raise ServeError(400, "shard_size must be >= 1")
+        model, _ = self._engine(body)
+        wl = getattr(model, "workload", None)
+        verb = wl.verb if wl is not None else "classify"
+        view = store.submit(model.name, verb, items, shard_size)
+        sched = getattr(self.server, "batch_sched", None)
+        if sched is not None:
+            sched.kick()
+        return 202, view
+
     def _live_engines(self) -> dict:
         """name → the engine taking that model's traffic right now:
         the plane's ACTIVE versions when one is wired (a mid-reload
@@ -809,11 +1007,13 @@ class _Handler(BaseHTTPRequestHandler):
                 if deploy is not None:
                     stats["deploy"] = deploy.stats()
                 stats.update(self._edge_blocks())
+                self._add_batch_block(stats)
                 self._reply(200, stats)
                 return
             stats = {name: eng.stats()
                      for name, eng in self.server.engines.items()}
             stats.update(self._edge_blocks())
+            self._add_batch_block(stats)
             self._reply(200, stats)
         elif path == "/v1/models":
             if plane is not None:
@@ -832,10 +1032,13 @@ class _Handler(BaseHTTPRequestHandler):
                 stats = {name: eng.stats()
                          for name, eng in self.server.engines.items()}
             stats.update(self._edge_blocks())
+            self._add_batch_block(stats)
             text = render_serve_metrics(stats)
             self._reply_raw(
                 200, text.encode(),
                 "text/plain; version=0.0.4; charset=utf-8")
+        elif path == "/v1/jobs" or path.startswith("/v1/jobs/"):
+            self._jobs_get(path)
         elif path == "/v1/traces":
             params = parse_qs(query)
             n = int(params.get("n", ["32"])[0])
@@ -867,6 +1070,9 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if path == "/v1/drain":
                 self._reply(200, self._drain())
+                return
+            if path == "/v1/jobs":
+                self._reply(*self._jobs_post())
                 return
             path_model = None
             parts = path.split("/")
@@ -1023,7 +1229,8 @@ class ServeServer:
                  socket_timeout_s: float | None = 30.0,
                  tracer=None, plane=None, deploy=None, edge: bool = True,
                  max_connections: int = DEFAULT_MAX_CONNECTIONS,
-                 http_workers: int = 8, response_cache=None, qos=None):
+                 http_workers: int = 8, response_cache=None, qos=None,
+                 jobs=None, batch_sched=None):
         if edge:
             self.httpd = EdgeServer((host, port), _Handler,
                                     max_connections=max_connections,
@@ -1048,6 +1255,10 @@ class ServeServer:
         # response cache and per-tenant QoS, hooked into _infer_route
         self.httpd.response_cache = response_cache
         self.httpd.qos = qos
+        # offline batch tier (None = off): the job store behind
+        # /v1/jobs and the trough-filling scheduler it kicks
+        self.httpd.jobs = jobs
+        self.httpd.batch_sched = batch_sched
         if tracer is None:
             # share the first engine's tracer so handler-created spans
             # land in the same ring /v1/traces reads

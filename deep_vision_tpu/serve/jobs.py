@@ -1,0 +1,342 @@
+"""Offline batch-inference jobs: manifests in, durable results out.
+
+A *job* is a manifest of N inference items (images, latents, seeds)
+POSTed to ``/v1/jobs`` and drained through the existing serving engines
+by ``serve/batch_sched.py`` — strictly below every interactive tenant
+(docs/BATCH.md).  This module owns the job ledger: the in-memory job
+table the scheduler and the HTTP handlers read, and its append-only
+JSONL checkpoint on disk, one file per job, in the deploy ledger's
+style (deploy/history.py):
+
+  {"kind": "job",   "job": id, "model": ..., "verb": ..., ...}
+  {"kind": "shard", "job": id, "index": 3, "results": [...], ...}
+  {"kind": "done",  "job": id, ...}
+
+Progress is checkpointed at *shard* granularity — a shard record is the
+durability unit.  On restart the store replays every job file, skipping
+torn tails (a half-written line from a crash mid-append parses as
+garbage and is dropped; every complete line before it survives), and
+the scheduler resumes each unfinished job from its first missing shard.
+A shard whose record made it to disk is never re-executed and its
+results are never produced twice; a shard whose record was torn re-runs
+in full, so results land exactly once in the durable log either way.
+
+Lock order: ``JobStore._lock`` is a leaf — file appends happen OUTSIDE
+it (one slow disk must not stall status polls), and no engine or
+scheduler lock is ever taken under it.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+
+from deep_vision_tpu.analysis.sanitizer import new_lock
+from deep_vision_tpu.obs.log import event, get_logger
+
+_log = get_logger("dvt.serve.jobs")
+
+
+class Job:
+    """One bulk job: an immutable manifest plus mutable shard progress.
+
+    ``manifest`` is frozen at submit time and never mutated, so the
+    scheduler may slice it without the store lock; ``results`` /
+    ``images_done`` / ``done`` / ``error`` are guarded by the owning
+    store's ``_lock``."""
+
+    __slots__ = ("job_id", "model", "verb", "manifest", "shard_size",
+                 "n_shards", "results", "images_done", "done", "error",
+                 "created_ts")
+
+    def __init__(self, job_id: str, model: str, verb: str,
+                 manifest: list, shard_size: int,
+                 created_ts: float | None = None):
+        self.job_id = job_id
+        self.model = model
+        self.verb = verb
+        self.manifest = list(manifest)
+        self.shard_size = max(1, int(shard_size))
+        self.n_shards = max(1, math.ceil(len(self.manifest)
+                                         / self.shard_size))
+        self.results: dict[int, list] = {}  # guarded-by: JobStore._lock
+        self.images_done = 0  # guarded-by: JobStore._lock
+        self.done = False  # guarded-by: JobStore._lock
+        self.error: str | None = None  # guarded-by: JobStore._lock
+        self.created_ts = created_ts if created_ts is not None \
+            else time.time()
+
+    def shard_range(self, index: int) -> tuple[int, int]:
+        """[lo, hi) manifest slice for shard ``index``."""
+        lo = index * self.shard_size
+        return lo, min(len(self.manifest), lo + self.shard_size)
+
+    def _state(self) -> str:
+        if self.error:
+            return "failed"
+        if self.done:
+            return "done"
+        return "running" if self.results else "pending"
+
+    def _status_locked(self) -> dict:
+        out = {"job_id": self.job_id, "model": self.model,
+               "verb": self.verb, "state": self._state(),
+               "n_items": len(self.manifest),
+               "shard_size": self.shard_size,
+               "n_shards": self.n_shards,
+               "shards_done": len(self.results),
+               "images_done": self.images_done,
+               "created_ts": round(self.created_ts, 3)}
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+class JobStore:
+    """Job table + append-only JSONL checkpoint (one file per job).
+
+    ``root=None`` runs memory-only (tests, servers started without
+    ``--jobs-dir``): same API, no durability.  With a root, every job
+    submitted, every completed shard, and every terminal transition
+    appends one JSON line to ``<root>/<job_id>.jsonl``; construction
+    replays existing files so a restarted server picks unfinished jobs
+    back up at their first missing shard."""
+
+    def __init__(self, root: str | None = None, *, shard_size: int = 32):
+        self.root = root
+        self.default_shard_size = max(1, int(shard_size))
+        self._lock = new_lock("serve.jobs.JobStore._lock")
+        self._jobs: dict[str, Job] = {}  # guarded-by: _lock
+        self._order: list[str] = []  # FIFO scheduling order, guarded-by: _lock
+        self.submitted = 0  # guarded-by: _lock
+        self.resumed = 0  # jobs replayed unfinished, guarded-by: _lock
+        self.replayed_shards = 0  # guarded-by: _lock
+        self.write_errors = 0  # guarded-by: _lock
+        self.torn_lines = 0  # guarded-by: _lock
+        if root:
+            os.makedirs(root, exist_ok=True)
+            self._load()
+
+    # -- durability ---------------------------------------------------------
+
+    def _path(self, job_id: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in job_id)
+        return os.path.join(self.root, f"{safe}.jsonl")
+
+    def _append(self, job_id: str, record: dict) -> None:
+        # called OUTSIDE self._lock — one slow disk must not stall the
+        # scheduler or a status poll; memory is already updated, and a
+        # lost append only means the shard re-runs after a restart
+        if not self.root:
+            return
+        line = json.dumps(record, default=str) + "\n"
+        try:
+            with open(self._path(job_id), "a", encoding="utf-8") as f:
+                f.write(line)
+        except OSError as e:
+            with self._lock:
+                self.write_errors += 1
+            event(_log, "job_write_error", job=job_id, error=str(e))
+
+    def _load(self) -> None:
+        loaded: list[Job] = []
+        torn = replayed = 0
+        for fname in sorted(os.listdir(self.root)):
+            if not fname.endswith(".jsonl"):
+                continue
+            path = os.path.join(self.root, fname)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    lines = f.readlines()
+                if lines and not lines[-1].endswith("\n"):
+                    # torn tail repair: terminate the half-written line
+                    # now, or the NEXT append would concatenate onto the
+                    # garbage and be swallowed with it
+                    with open(path, "a", encoding="utf-8") as f:
+                        f.write("\n")
+            except OSError:
+                continue
+            job: Job | None = None
+            for raw in lines:
+                raw = raw.strip()
+                if not raw:
+                    continue
+                try:
+                    rec = json.loads(raw)
+                except ValueError:
+                    # torn tail (or mid-file corruption): skip the line,
+                    # keep every complete record around it
+                    torn += 1
+                    continue
+                kind = rec.get("kind")
+                if kind == "job" and job is None:
+                    try:
+                        job = Job(rec["job"], rec["model"], rec["verb"],
+                                  rec["manifest"], rec["shard_size"],
+                                  created_ts=float(rec.get("ts", 0.0)))
+                    except (KeyError, TypeError, ValueError):
+                        break  # unusable header → skip the file
+                elif kind == "shard" and job is not None:
+                    idx = rec.get("index")
+                    res = rec.get("results")
+                    if isinstance(idx, int) and isinstance(res, list) \
+                            and 0 <= idx < job.n_shards \
+                            and idx not in job.results:
+                        job.results[idx] = res
+                        job.images_done += int(rec.get("images",
+                                                       len(res)))
+                        replayed += 1
+                elif kind == "done" and job is not None:
+                    job.done = True
+                elif kind == "failed" and job is not None:
+                    job.error = str(rec.get("reason", "failed"))
+                    job.done = True
+            if job is not None:
+                loaded.append(job)
+        loaded.sort(key=lambda j: (j.created_ts, j.job_id))
+        resumed: list[Job] = []
+        with self._lock:
+            self.torn_lines += torn
+            self.replayed_shards += replayed
+            for job in loaded:
+                self._jobs[job.job_id] = job
+                self._order.append(job.job_id)
+                if not job.done:
+                    self.resumed += 1
+                    resumed.append(job)
+        for job in resumed:
+            event(_log, "job_resumed", job=job.job_id,
+                  model=job.model, shards_done=len(job.results),
+                  n_shards=job.n_shards)
+
+    # -- job API ------------------------------------------------------------
+
+    def submit(self, model: str, verb: str, manifest: list,
+               shard_size: int | None = None) -> dict:
+        """Register a new job; returns its status view (the HTTP job
+        handle).  The job record is durable before this returns."""
+        if not manifest:
+            raise ValueError("empty manifest")
+        job_id = "job-" + os.urandom(8).hex()
+        job = Job(job_id, model, verb, manifest,
+                  shard_size or self.default_shard_size)
+        with self._lock:
+            self._jobs[job_id] = job
+            self._order.append(job_id)
+            self.submitted += 1
+            view = job._status_locked()
+        self._append(job_id, {"kind": "job", "job": job_id,
+                              "model": model, "verb": verb,
+                              "shard_size": job.shard_size,
+                              "n_items": len(job.manifest),
+                              "manifest": job.manifest,
+                              "ts": job.created_ts})
+        event(_log, "job_submitted", job=job_id, model=model, verb=verb,
+              n_items=len(job.manifest), n_shards=job.n_shards)
+        return view
+
+    def status(self, job_id: str) -> dict:
+        with self._lock:
+            return self._jobs[job_id]._status_locked()
+
+    def jobs(self) -> list[dict]:
+        with self._lock:
+            return [self._jobs[jid]._status_locked()
+                    for jid in self._order]
+
+    def get(self, job_id: str) -> Job:
+        with self._lock:
+            return self._jobs[job_id]
+
+    # -- scheduler API ------------------------------------------------------
+
+    def next_shard(self) -> tuple[Job, int] | None:
+        """FIFO: the lowest missing shard of the oldest unfinished job.
+        Lowest-first keeps shard completion in index order, which is
+        what lets the results endpoint stream the completed prefix."""
+        with self._lock:
+            for jid in self._order:
+                job = self._jobs[jid]
+                if job.done:
+                    continue
+                for i in range(job.n_shards):
+                    if i not in job.results:
+                        return job, i
+        return None
+
+    def record_shard(self, job_id: str, index: int, results: list,
+                     images: int) -> bool:
+        """Commit one completed shard: memory under the lock, the JSONL
+        record outside it.  Returns False (and writes nothing) if the
+        shard is already recorded — the exactly-once guard for a
+        replayed or double-run shard."""
+        with self._lock:
+            job = self._jobs[job_id]
+            if index in job.results or job.done:
+                return False
+            job.results[index] = list(results)
+            job.images_done += int(images)
+            finished = len(job.results) == job.n_shards
+        self._append(job_id, {"kind": "shard", "job": job_id,
+                              "index": index, "images": int(images),
+                              "results": list(results),
+                              "ts": time.time()})
+        if finished:
+            with self._lock:
+                job.done = True
+            self._append(job_id, {"kind": "done", "job": job_id,
+                                  "ts": time.time()})
+            event(_log, "job_done", job=job_id,
+                  images=job.images_done, n_shards=job.n_shards)
+        return True
+
+    def fail(self, job_id: str, reason: str) -> None:
+        """Terminal failure (unknown model, engine gone): the job stops
+        scheduling and reports ``failed`` with the reason."""
+        with self._lock:
+            job = self._jobs[job_id]
+            if job.done:
+                return
+            job.error = reason
+            job.done = True
+        self._append(job_id, {"kind": "failed", "job": job_id,
+                              "reason": reason, "ts": time.time()})
+        event(_log, "job_failed", job=job_id, reason=reason)
+
+    def results_items(self, job_id: str):
+        """Completed results in manifest order — the contiguous shard
+        prefix only, so a partially-drained job streams a stable,
+        in-order, never-repeated prefix.  Yields ``(global_index,
+        result_dict)``."""
+        with self._lock:
+            job = self._jobs[job_id]
+            prefix: list[list] = []
+            for i in range(job.n_shards):
+                if i not in job.results:
+                    break
+                prefix.append(job.results[i])
+        idx = 0
+        for shard in prefix:
+            for item in shard:
+                yield idx, item
+                idx += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            states = {"pending": 0, "running": 0, "done": 0, "failed": 0}
+            images = 0
+            for job in self._jobs.values():
+                states[job._state()] += 1
+                images += job.images_done
+            return {"jobs_total": len(self._jobs),
+                    "submitted": self.submitted,
+                    "resumed": self.resumed,
+                    "replayed_shards": self.replayed_shards,
+                    "images_done": images,
+                    "write_errors": self.write_errors,
+                    "torn_lines": self.torn_lines,
+                    "states": states,
+                    "durable": bool(self.root)}

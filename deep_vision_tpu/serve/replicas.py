@@ -675,6 +675,17 @@ class ReplicatedEngine:
         edge QoS pressure signal."""
         return self._queue.qsize()
 
+    def occupancy(self) -> float:
+        """Mean per-replica compute occupancy over live slots — the
+        fleet's duty cycle for the batchy-SLO autoscaler (one busy
+        replica among idle ones reads fractional, as capacity says it
+        should).  Reads ``_retired`` without the lock, like the
+        ``_free_replicas`` divisor (a stale slot flag skews one gauge
+        sample, nothing more)."""
+        occ = [r.occupancy() for i, r in enumerate(self.replicas)
+               if not self._retired[i]]
+        return round(sum(occ) / len(occ), 4) if occ else 0.0
+
     def stats(self) -> dict:
         merged = LatencyHistogram()
         per = []
@@ -761,6 +772,7 @@ class ReplicatedEngine:
             # the single-engine host proxy doesn't compose across
             # replicas (their windows overlap in wall time)
             "device_idle_frac": None,
+            "occupancy": self.occupancy(),
             "staging": {
                 "allocated": sum(r.staging.allocated
                                  for r in self.replicas),

@@ -121,6 +121,31 @@ class Workload:
         must close over nothing that changes across reloads)."""
         return None
 
+    def decode_manifest_item(self, item: dict, model):
+        """One batch-job manifest entry → input array (serve/jobs.py).
+
+        The per-verb manifest codec: the workload's own ``decode``
+        first (generate accepts ``latent``/``seed`` entries), then the
+        generic image decode — the same ``pixels``/``image_b64`` schema
+        an interactive body uses, so a manifest is just a list of
+        request bodies.  Raises ValueError on a malformed entry (the
+        scheduler records it as that item's error result; one bad entry
+        never poisons its shard)."""
+        if not isinstance(item, dict):
+            raise ValueError(
+                f"manifest entry must be an object, got "
+                f"{type(item).__name__}")
+        x = self.decode(item, model)
+        if x is not None:
+            return x
+        # deferred import: http imports this module at its top level
+        from deep_vision_tpu.serve.http import ServeError, _decode_pixels
+
+        try:
+            return _decode_pixels(item, model)
+        except ServeError as e:
+            raise ValueError(str(e)) from e
+
     def respond(self, model, body: dict, row) -> dict:
         raise NotImplementedError
 

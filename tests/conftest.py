@@ -49,7 +49,7 @@ def host_devices():
 # swallowed). Engines/gateways are constructed inside the tests, after this
 # fixture enables the seam, so every lock they create is instrumented.
 _SANITIZED_MARKERS = {"chaos", "gateway", "replicas", "models", "deploy",
-                      "edge", "mesh"}
+                      "edge", "mesh", "batch"}
 
 
 @pytest.fixture(autouse=True)

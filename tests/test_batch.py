@@ -1,0 +1,673 @@
+"""Offline batch-inference tier contract (CPU, tier-1 fast): the job
+store checkpoints progress at shard granularity and replays JSONL
+ledgers (torn tails skipped) so a restarted server resumes mid-job with
+zero duplicated and zero lost results; the trough-filling scheduler is
+a strict priority band below every interactive tenant (starvation-free
+both ways); shed shards retry whole — all-or-nothing results keep
+replay exactly-once; the results endpoint streams the completed prefix
+as chunked ndjson over both HTTP front-ends; and the autoscaler's
+batchy-SLO engines scale on rolling compute occupancy, not queue
+pressure.
+
+Uses LeNet at random init for the real-engine paths (batch correctness
+is about scheduling and durability, not learned weights) and stub
+engines for the pure state-machine tests.  Runs with the lock-order
+sanitizer enabled (conftest fixture keyed on the ``batch`` marker).
+"""
+
+import json
+import queue
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.serve.admission import Shed
+from deep_vision_tpu.serve.batch_sched import BatchScheduler
+from deep_vision_tpu.serve.engine import BatchingEngine
+from deep_vision_tpu.serve.jobs import JobStore
+from deep_vision_tpu.serve.registry import ModelRegistry
+
+pytestmark = pytest.mark.batch
+
+
+@pytest.fixture(scope="module")
+def lenet_serving(tmp_path_factory):
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint(
+        "lenet5", str(tmp_path_factory.mktemp("lenet_workdir")))
+    return reg, sm
+
+
+def _manifest(n, shape=(32, 32, 1)):
+    return [{"pixels":
+             np.random.RandomState(i).randn(*shape).tolist()}
+            for i in range(n)]
+
+
+def _wait(pred, what, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+# -- job store: shard accounting + exactly-once guard ----------------------
+
+
+def test_jobstore_shard_accounting_memory_only():
+    store = JobStore()  # no root: same API, no durability
+    view = store.submit("m", "classify", [{"x": i} for i in range(10)],
+                        shard_size=4)
+    jid = view["job_id"]
+    assert view["state"] == "pending" and view["n_shards"] == 3
+    job, idx = store.next_shard()
+    assert job.job_id == jid and idx == 0
+    assert job.shard_range(0) == (0, 4)
+    assert job.shard_range(2) == (8, 10)  # ragged tail shard
+    assert store.record_shard(jid, 0, [{"y": i} for i in range(4)], 4)
+    # the exactly-once guard: a double-record is refused, not merged
+    assert not store.record_shard(jid, 0, [{"y": 0}] * 4, 4)
+    assert store.status(jid)["images_done"] == 4
+    assert store.next_shard()[1] == 1  # lowest missing shard
+    # results stream only the CONTIGUOUS completed prefix: with shard 2
+    # done but shard 1 missing, only shard 0 is visible
+    assert store.record_shard(jid, 2, [{"y": 8}, {"y": 9}], 2)
+    assert [i for i, _ in store.results_items(jid)] == [0, 1, 2, 3]
+    assert store.record_shard(jid, 1, [{"y": i} for i in range(4, 8)], 4)
+    st = store.status(jid)
+    assert st["state"] == "done" and st["images_done"] == 10
+    items = list(store.results_items(jid))
+    assert [i for i, _ in items] == list(range(10))
+    assert store.next_shard() is None
+    assert store.stats()["states"]["done"] == 1
+    assert not store.stats()["durable"]
+    with pytest.raises(ValueError):
+        store.submit("m", "classify", [])
+
+
+def test_jobstore_restart_replay_and_torn_tail(tmp_path):
+    root = str(tmp_path / "jobs")
+    store = JobStore(root, shard_size=2)
+    jid = store.submit("m", "classify",
+                       [{"x": i} for i in range(6)])["job_id"]
+    store.record_shard(jid, 0, [{"y": 0}, {"y": 1}], 2)
+    store.record_shard(jid, 1, [{"y": 2}, {"y": 3}], 2)
+
+    # restart #1: both durable shards replay, job resumes at shard 2
+    s2 = JobStore(root)
+    assert s2.resumed == 1 and s2.replayed_shards == 2
+    assert s2.status(jid)["images_done"] == 4
+    assert s2.next_shard()[1] == 2
+
+    # a crash mid-append leaves a torn tail: the half-written shard is
+    # dropped (it re-runs), every complete line before it survives
+    path = [p for p in (tmp_path / "jobs").iterdir()
+            if p.suffix == ".jsonl"][0]
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"kind": "shard", "job": "%s", "index": 2, "res' % jid)
+    s3 = JobStore(root)
+    assert s3.torn_lines == 1
+    assert s3.replayed_shards == 2  # the torn shard did NOT apply
+    assert s3.next_shard()[1] == 2
+    s3.record_shard(jid, 2, [{"y": 4}, {"y": 5}], 2)
+    assert s3.status(jid)["state"] == "done"
+
+    # restart #2: terminal state replays; nothing resumes, nothing
+    # re-emits — indices come back exactly once, in manifest order
+    s4 = JobStore(root)
+    assert s4.resumed == 0 and s4.next_shard() is None
+    assert [i for i, _ in s4.results_items(jid)] == list(range(6))
+
+
+# -- scheduler: priority band, retries, terminal failures ------------------
+
+
+class _StubWorkload:
+    verb = "classify"
+
+    def decode_manifest_item(self, item, model):
+        if "x" not in item:
+            raise ValueError("manifest entry needs 'x'")
+        return item["x"]
+
+    def respond(self, model, item, row):
+        return {"y": row}
+
+
+class _StubEngine:
+    """Just the scheduler's surface: a queue-depth signal, an EWMA, and
+    an instantly-resolving submit."""
+
+    def __init__(self):
+        self.queue_depth = 0
+        self.admission = types.SimpleNamespace(
+            bucket_ewma_s=lambda bucket=None: 0.005)
+        self.served = 0
+        self.shed_next = 0  # shed this many submits (shard retry test)
+
+    def submit(self, x):
+        fut: Future = Future()
+        if self.shed_next > 0:
+            self.shed_next -= 1
+            fut.set_result(Shed("queue_full"))
+        else:
+            self.served += 1
+            fut.set_result(x * 2)
+        return fut
+
+
+def _stub_rig(store=None):
+    store = store or JobStore()
+    eng = _StubEngine()
+    model = types.SimpleNamespace(name="stub", workload=_StubWorkload())
+
+    def resolve(name):
+        if name != "stub":
+            raise KeyError(f"unknown model '{name}'")
+        return model, eng
+
+    sched = BatchScheduler(store, resolve, interval_s=0.002)
+    return store, eng, sched
+
+
+def test_scheduler_priority_band_defers_then_drains():
+    """The band in action: any waiting interactive request parks the
+    batch tier outright; the moment the queue drains, shards flow —
+    starvation-freedom in both directions."""
+    store, eng, sched = _stub_rig()
+    jid = store.submit("stub", "classify",
+                       [{"x": i} for i in range(8)],
+                       shard_size=4)["job_id"]
+    eng.queue_depth = 3  # interactive backlog: trough check must fail
+    sched.start()
+    try:
+        _wait(lambda: sched.stats()["deferred"] >= 3, "deferrals")
+        assert sched.stats()["shards_done"] == 0
+        assert store.status(jid)["state"] == "pending"
+        assert eng.served == 0  # parked, not trickling
+        eng.queue_depth = 0  # trough opens
+        sched.kick()
+        _wait(lambda: store.status(jid)["state"] == "done", "job drain")
+    finally:
+        sched.stop()
+    items = list(store.results_items(jid))
+    assert [i for i, _ in items] == list(range(8))
+    assert [r["y"] for _, r in items] == [2 * i for i in range(8)]
+    st = sched.stats()
+    assert st["shards_done"] == 2 and st["images_total"] == 8
+
+
+def test_scheduler_shed_retries_whole_shard_exactly_once():
+    """A shed anywhere in a shard voids the WHOLE attempt: nothing is
+    recorded, the shard re-runs, and the final results hold each index
+    exactly once — the all-or-nothing rule the JSONL replay leans on."""
+    store, eng, sched = _stub_rig()
+    jid = store.submit("stub", "classify",
+                       [{"x": i} for i in range(4)],
+                       shard_size=4)["job_id"]
+    eng.shed_next = 2  # first attempt: 2 of 4 rows shed
+    sched.start()
+    try:
+        _wait(lambda: store.status(jid)["state"] == "done", "retry drain")
+    finally:
+        sched.stop()
+    assert sched.stats()["shards_shed"] >= 1
+    items = list(store.results_items(jid))
+    assert [i for i, _ in items] == list(range(4))
+    assert store.status(jid)["images_done"] == 4
+
+
+def test_scheduler_per_item_error_never_wedges_job():
+    """A malformed manifest entry records as that ITEM's error result;
+    the rest of the shard serves — one poison entry can't wedge a job
+    into eternal retry."""
+    store, eng, sched = _stub_rig()
+    manifest = [{"x": 0}, {"bad": 1}, {"x": 2}]
+    jid = store.submit("stub", "classify", manifest,
+                       shard_size=3)["job_id"]
+    sched.start()
+    try:
+        _wait(lambda: store.status(jid)["state"] == "done", "drain")
+    finally:
+        sched.stop()
+    rows = [r for _, r in store.results_items(jid)]
+    assert rows[0] == {"y": 0} and rows[2] == {"y": 4}
+    assert "bad manifest entry" in rows[1]["error"]
+    assert store.status(jid)["images_done"] == 2  # goodput, not rows
+    assert sched.stats()["decode_errors"] == 1
+
+
+def test_scheduler_unknown_model_fails_job_terminally():
+    store, eng, sched = _stub_rig()
+    jid = store.submit("ghost", "classify", [{"x": 1}])["job_id"]
+    sched.start()
+    try:
+        _wait(lambda: store.status(jid)["state"] == "failed",
+              "terminal failure")
+    finally:
+        sched.stop()
+    assert "not servable" in store.status(jid)["error"]
+    assert sched.stats()["jobs_failed"] == 1
+    assert store.next_shard() is None  # never rescheduled
+
+
+# -- restart resume on a real engine: exactly-once end to end --------------
+
+
+class _StopAfterStore(JobStore):
+    """Durable store that halts its scheduler after N recorded shards —
+    the deterministic 'kill -9 mid-job' stand-in (the scheduler's loop
+    checks its stop flag between shards, so at most the in-flight shard
+    also lands)."""
+
+    def __init__(self, root, *, stop_after, **kw):
+        super().__init__(root, **kw)
+        self.sched: BatchScheduler | None = None
+        self._stop_after = stop_after
+        self._recorded = 0
+
+    def record_shard(self, *a, **kw):
+        ok = super().record_shard(*a, **kw)
+        if ok:
+            self._recorded += 1
+            if self._recorded >= self._stop_after \
+                    and self.sched is not None:
+                self.sched._stop.set()
+        return ok
+
+
+def test_restart_resumes_from_checkpoint_exactly_once(tmp_path,
+                                                      lenet_serving):
+    """Kill mid-job, restart, drain: every manifest index appears in
+    the durable results exactly once, and the engine executed each
+    image exactly once — durable shards are never re-run."""
+    reg, sm = lenet_serving
+    root = str(tmp_path / "jobs")
+    manifest = _manifest(12)
+
+    def resolve(name):
+        return reg.get(name), eng
+
+    with BatchingEngine(sm, buckets=[4], max_wait_ms=2) as eng:
+        store1 = _StopAfterStore(root, stop_after=1, shard_size=4)
+        jid = store1.submit(sm.name, "classify", manifest)["job_id"]
+        sched1 = BatchScheduler(store1, resolve, interval_s=0.002)
+        store1.sched = sched1
+        sched1.start()
+        _wait(lambda: not sched1._thread.is_alive(), "mid-job halt")
+        sched1.stop()
+        done1 = store1.status(jid)["shards_done"]
+        served1 = eng.served
+        assert 1 <= done1 < 3  # genuinely mid-job
+
+        # "restart": a fresh store replays the JSONL ledger
+        store2 = JobStore(root)
+        assert store2.resumed == 1
+        assert store2.replayed_shards == done1
+        assert store2.next_shard()[1] == done1  # first missing shard
+        sched2 = BatchScheduler(store2, resolve, interval_s=0.002)
+        sched2.start()
+        try:
+            _wait(lambda: store2.status(jid)["state"] == "done",
+                  "post-restart drain")
+        finally:
+            sched2.stop()
+        # zero duplicates: the engine never re-executed a durable shard
+        assert served1 + (eng.served - served1) == eng.served == 12
+        items = list(store2.results_items(jid))
+        assert [i for i, _ in items] == list(range(12))
+        assert all("top" in r for _, r in items)
+        assert store2.status(jid)["images_done"] == 12
+
+
+# -- interference: interactive p99 unharmed by a draining bulk job ---------
+
+
+def _p99(lat):
+    return sorted(lat)[max(0, int(len(lat) * 0.99) - 1)]
+
+
+def test_interactive_p99_unharmed_while_bulk_job_drains(lenet_serving):
+    """The acceptance gate: a bulk job drains to completion while a
+    foreground client's p99 stays in its no-batch envelope — the
+    priority band admits shards only into troughs, so the worst case
+    an interactive request sees is one batch-sized cohort."""
+    reg, sm = lenet_serving
+    img = np.random.RandomState(0).randn(32, 32, 1).astype(np.float32)
+
+    def resolve(name):
+        return reg.get(name), eng
+
+    with BatchingEngine(sm, buckets=[4], max_wait_ms=2) as eng:
+        # baseline: interactive latencies with no batch tier at all
+        base_lat = []
+        for _ in range(30):
+            t0 = time.monotonic()
+            assert eng.infer(img) is not None
+            base_lat.append(time.monotonic() - t0)
+
+        store = JobStore(shard_size=4)
+        jid = store.submit(sm.name, "classify",
+                           _manifest(32))["job_id"]
+        sched = BatchScheduler(store, resolve, interval_s=0.002)
+        sched.start()
+        try:
+            during_lat = []
+            for _ in range(30):
+                t0 = time.monotonic()
+                assert eng.infer(img) is not None
+                during_lat.append(time.monotonic() - t0)
+            # starvation-freedom under interleaved interactive load:
+            # the job still finishes
+            _wait(lambda: store.status(jid)["state"] == "done",
+                  "bulk drain under interactive load")
+        finally:
+            sched.stop()
+        assert store.status(jid)["images_done"] == 32
+        assert _p99(during_lat) <= _p99(base_lat) * 5 + 0.25, (
+            f"interactive p99 regressed under batch drain: "
+            f"{_p99(base_lat):.4f}s -> {_p99(during_lat):.4f}s")
+
+
+# -- engine + scheduler occupancy signals ----------------------------------
+
+
+def test_engine_occupancy_rolling_signal(lenet_serving):
+    reg, sm = lenet_serving
+    with BatchingEngine(sm, buckets=[4], max_wait_ms=2) as eng:
+        assert eng.occupancy() == 0.0  # no compute yet
+        img = np.random.RandomState(0).randn(32, 32, 1)
+        for _ in range(8):
+            assert eng.infer(img.astype(np.float32)) is not None
+        occ = eng.occupancy()
+        assert 0.0 < occ <= 1.0
+        pipe = eng.stats()["pipeline"]
+        assert 0.0 < pipe["occupancy"] <= 1.0
+
+
+def test_scheduler_occupancy_after_drain():
+    store, eng, sched = _stub_rig()
+    assert sched.occupancy() == 0.0
+    store.submit("stub", "classify", [{"x": i} for i in range(4)])
+    sched.start()
+    try:
+        _wait(lambda: sched.stats()["shards_done"] >= 1, "drain")
+    finally:
+        sched.stop()
+    assert 0.0 <= sched.stats()["occupancy"] <= 1.0
+
+
+# -- occupancy-based autoscaling for the batchy SLO class ------------------
+
+
+class _OccEngine:
+    """The scaler's engine surface plus the occupancy signal and a
+    workload SLO class name."""
+
+    def __init__(self, occ=0.0, slo="batchy", live=1):
+        self._queue: queue.Queue = queue.Queue()
+        self.admission = types.SimpleNamespace(
+            bucket_ewma_s=lambda: 0.01)
+        self.model = types.SimpleNamespace(
+            name="fake",
+            workload=types.SimpleNamespace(
+                slo=types.SimpleNamespace(name=slo)))
+        self.occ = occ
+        self.live = live
+
+    def occupancy(self):
+        return self.occ
+
+    def total_inflight(self):
+        return 0
+
+    def live_replicas(self):
+        return self.live
+
+    def add_replica(self):
+        self.live += 1
+        return self.live - 1
+
+    def remove_replica(self, drain_deadline=5.0):
+        self.live -= 1
+        return self.live
+
+
+def test_autoscaler_batchy_scales_up_on_occupancy_not_queue():
+    """The signal switch: a saturated batchy engine runs flat out with
+    an EMPTY queue (whole cohorts go straight in-flight), so queue
+    pressure reads 0 — occupancy is what must drive the scale-up."""
+    from deep_vision_tpu.deploy import ReplicaAutoscaler
+
+    eng = _OccEngine(occ=0.9)
+    s = ReplicaAutoscaler(eng, min_replicas=1, max_replicas=3,
+                          up_window=3, down_window=3, cooldown_s=0.0,
+                          occupancy_high=0.75, occupancy_low=0.2)
+    sig = s.signals()
+    assert sig["batchy"] and sig["occupancy"] == 0.9
+    assert sig["pressure_ms"] == 0.0  # the signal queue pressure misses
+    assert s.tick() is None and s.tick() is None  # hysteresis holds
+    act = s.tick()
+    assert act["action"] == "scale_up" and eng.live == 2
+    # an interactive engine with the same occupancy does NOT scale:
+    # the switch is keyed on the SLO class, not on the signal existing
+    inter = _OccEngine(occ=0.9, slo="interactive")
+    s2 = ReplicaAutoscaler(inter, min_replicas=1, max_replicas=3,
+                           up_window=1, cooldown_s=0.0)
+    assert not s2.signals()["batchy"]
+    for _ in range(5):
+        assert s2.tick() is None
+    assert inter.live == 1
+
+
+def test_autoscaler_batchy_scale_down_needs_low_occupancy():
+    """The inter-shard gap samples as queue 0 / inflight 0; the rolling
+    occupancy window is what keeps that from reading as idle."""
+    from deep_vision_tpu.deploy import ReplicaAutoscaler
+
+    eng = _OccEngine(occ=0.5, live=3)  # between the two thresholds
+    s = ReplicaAutoscaler(eng, min_replicas=1, max_replicas=3,
+                          up_window=3, down_window=2, cooldown_s=0.0,
+                          occupancy_high=0.75, occupancy_low=0.2)
+    for _ in range(6):
+        assert s.tick() is None  # neither hot nor idle: holds steady
+    assert eng.live == 3
+    eng.occ = 0.05  # genuinely drained
+    assert s.tick() is None
+    act = s.tick()
+    assert act["action"] == "scale_down" and eng.live == 2
+
+
+# -- HTTP: job API, chunked results stream, metrics ------------------------
+
+
+def _get_json(url, timeout=60):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post_json(url, payload, timeout=60):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_jobs_http_end_to_end_with_metrics(lenet_serving):
+    """POST a manifest, poll the handle, stream the chunked ndjson
+    results, and find the batch tier's goodput series in /metrics —
+    the full wire contract of docs/BATCH.md."""
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = lenet_serving
+    eng = BatchingEngine(sm, buckets=[4], max_wait_ms=2).start()
+
+    def resolve(name):
+        return reg.get(name), eng
+
+    store = JobStore(shard_size=4)
+    sched = BatchScheduler(store, resolve, interval_s=0.002).start()
+    srv = ServeServer(reg, {sm.name: eng}, port=0, jobs=store,
+                      batch_sched=sched).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        status, view = _post_json(base + "/v1/jobs",
+                                  {"model": "lenet5",
+                                   "items": _manifest(6),
+                                   "shard_size": 2})
+        assert status == 202 and view["n_shards"] == 3
+        jid = view["job_id"]
+        _wait(lambda: _get_json(base + f"/v1/jobs/{jid}")[1]["state"]
+              == "done", "job drain over HTTP")
+        _, listing = _get_json(base + "/v1/jobs")
+        assert [j["job_id"] for j in listing["jobs"]] == [jid]
+
+        # the results stream: chunked ndjson, one line per item in
+        # manifest order, then the terminal status line
+        req = urllib.request.urlopen(base + f"/v1/jobs/{jid}/results",
+                                     timeout=60)
+        assert req.headers.get("Transfer-Encoding") == "chunked"
+        lines = [json.loads(ln) for ln in req.read().splitlines()]
+        assert [ln["index"] for ln in lines[:-1]] == list(range(6))
+        assert all("top" in ln for ln in lines[:-1])
+        assert lines[-1]["status"]["state"] == "done"
+
+        _, stats = _get_json(base + "/v1/stats")
+        batch = stats["batch"]
+        assert batch["jobs"]["images_done"] == 6
+        assert batch["scheduler"]["shards_done"] == 3
+        assert "mfu_occupancy_weighted" in batch
+        with urllib.request.urlopen(base + "/metrics", timeout=60) as r:
+            text = r.read().decode()
+        assert "dvt_batch_images_total 6" in text
+        assert "dvt_batch_occupancy" in text
+        assert "dvt_serve_occupancy" in text
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _get_json(base + "/v1/jobs/nope")
+        assert exc.value.code == 404
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            _post_json(base + "/v1/jobs",
+                       {"model": "lenet5", "items": []})
+        assert exc.value.code == 400
+    finally:
+        srv.shutdown()
+        sched.stop()
+        eng.stop()
+
+
+def test_jobs_http_503_when_tier_not_enabled(lenet_serving):
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = lenet_serving
+    eng = BatchingEngine(sm, buckets=[4], max_wait_ms=2).start()
+    srv = ServeServer(reg, {sm.name: eng}, port=0).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        for do in (lambda: _get_json(base + "/v1/jobs"),
+                   lambda: _post_json(base + "/v1/jobs",
+                                      {"items": [{}]})):
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                do()
+            assert exc.value.code == 503
+            assert "--jobs-dir" in json.loads(exc.value.read())["error"]
+    finally:
+        srv.shutdown()
+        eng.stop()
+
+
+@pytest.mark.parametrize("edge", [True, False],
+                         ids=["edge-loop", "thread-server"])
+def test_results_stream_partial_prefix_both_frontends(lenet_serving,
+                                                      edge):
+    """Both HTTP front-ends speak the same chunked stream: a partially
+    drained job streams its contiguous completed prefix plus a
+    ``running`` status line — a stable, never-repeated view a client
+    can poll."""
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = lenet_serving
+    store = JobStore(shard_size=2)
+    jid = store.submit(sm.name, "classify",
+                       [{"k": i} for i in range(6)])["job_id"]
+    store.record_shard(jid, 0, [{"y": 0}, {"y": 1}], 2)
+    store.record_shard(jid, 2, [{"y": 4}, {"y": 5}], 2)  # gap at 1
+    srv = ServeServer(reg, {}, port=0, jobs=store,
+                      edge=edge).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(base + f"/v1/jobs/{jid}/results",
+                                    timeout=60) as r:
+            assert r.headers.get("Transfer-Encoding") == "chunked"
+            lines = [json.loads(ln) for ln in r.read().splitlines()]
+        # shard 2 is done but NOT streamed: the prefix stops at the gap
+        assert [ln["index"] for ln in lines[:-1]] == [0, 1]
+        assert lines[-1]["status"]["state"] == "running"
+    finally:
+        srv.shutdown()
+
+
+# -- CycleGAN 256² image-in serving on real restored weights ---------------
+
+
+@pytest.mark.slow
+def test_cyclegan_256_image_in_serving_real_weights(tmp_path):
+    """End-to-end generative image translation at full 256² resolution
+    on a real restored checkpoint (not the random-init fallback):
+    uint8 pixels in over the wire, fused uint8 epilogue out, and the
+    same manifest entry drains through the batch job path."""
+    import os
+
+    from deep_vision_tpu.core.checkpoint import Checkpointer
+    from deep_vision_tpu.core.config import get_config
+    from deep_vision_tpu.core.restore import load_state
+
+    cfg = get_config("cyclegan")
+    seed_dir = str(tmp_path / "seed")
+    _, state = load_state(cfg, seed_dir, log=lambda *a, **k: None)
+    workdir = str(tmp_path / "cyclegan")
+    ckpt = Checkpointer(os.path.join(workdir, "checkpoints"))
+    ckpt.save(1, state)
+    ckpt.wait_until_finished()
+
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint("cyclegan", workdir, wire_dtype="uint8")
+    assert sm.restored_step == 1  # real weights, not the fallback init
+    assert sm.workload.verb == "generate"
+    assert sm.input_shape == (256, 256, 3)
+    assert str(sm.wire_dtype) == "uint8"  # image-in wire is honored
+    assert sm.output_wire == "uint8"
+
+    img = np.random.RandomState(0).randint(
+        0, 256, size=(256, 256, 3), dtype=np.uint8)
+    with BatchingEngine(sm, buckets=[1], max_wait_ms=2) as eng:
+        out = np.asarray(eng.submit(img).result(600))
+        assert out.dtype == np.uint8 and out.shape == (256, 256, 3)
+        resp = sm.workload.respond(sm, {}, out)
+        assert resp["image"]["shape"] == [256, 256, 3]
+        assert resp["image"]["dtype"] == "uint8"
+
+        # the same image as a batch manifest entry: decode → engine →
+        # respond, through the real scheduler
+        store = JobStore()
+        jid = store.submit("cyclegan", "generate",
+                           [{"pixels": img.tolist()}])["job_id"]
+        sched = BatchScheduler(store, lambda n: (sm, eng),
+                               interval_s=0.002).start()
+        try:
+            _wait(lambda: store.status(jid)["state"] == "done",
+                  "cyclegan job drain", timeout=600)
+        finally:
+            sched.stop()
+        rows = [r for _, r in store.results_items(jid)]
+        assert rows[0]["image"]["shape"] == [256, 256, 3]
