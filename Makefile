@@ -51,6 +51,7 @@ serve-smoke: lint lint-test
 	$(PY) tests/mesh_smoke.py
 	$(PY) tests/workload_smoke.py
 	$(PY) tests/batch_smoke.py
+	$(PY) tests/cascade_smoke.py
 
 # the async HTTP edge end to end over real sockets: keep-alive reuse
 # visible in the connection counters, a content-addressed cache hit
@@ -128,6 +129,21 @@ batch-smoke:
 # chunked results stream on both HTTP front-ends)
 batch-test:
 	$(PY) -m pytest tests/test_batch.py -q -m batch
+
+# the confidence-routed cascade end to end over HTTP: fail-closed
+# all-big before calibration, live dual-run calibration flipping
+# traffic to the front tier (X-DVT-Tier), an always-big QoS tenant
+# pinned to the big tier, and a mid-load front reload resetting then
+# REcalibrating the threshold with zero client errors
+# (docs/SERVING.md "Cascaded serving")
+cascade-smoke:
+	$(PY) tests/cascade_smoke.py
+
+# the cascade unit suite alone (deterministic threshold calibration,
+# fail-closed thin samples, escalation bit-identity + deadline
+# preservation, version-swap resets, always-big QoS routing)
+cascade-test:
+	$(PY) -m pytest tests/test_cascade.py -q -m models
 
 # the continuous train->deploy loop end to end: a real async-Orbax
 # checkpoint published mid-load auto-deploys through debounce -> gate
@@ -292,4 +308,5 @@ list:
 	obs-test model-smoke model-test quant-smoke quant-test \
 	workload-smoke workload-test \
 	mesh-smoke mesh-test \
-	deploy-smoke deploy-test batch-smoke batch-test lint lint-test list
+	deploy-smoke deploy-test batch-smoke batch-test \
+	cascade-smoke cascade-test lint lint-test list

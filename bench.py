@@ -1076,7 +1076,8 @@ def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
                     max_batch: int = 8, max_wait_ms: float = 2.0,
                     pipeline_depth: int = 2,
                     hbm_budget_mb: float = 0.0,
-                    zipf_s: float = 1.1, **_ignored) -> dict:
+                    zipf_s: float = 1.1,
+                    cascade: str | None = None, **_ignored) -> dict:
     """Mixed-WORKLOAD serving mix (``bench.py --serve-mix``): every
     model in ``models`` deployed behind one control plane
     (serve/models.py) sharing a weight cache, closed-loop clients
@@ -1095,7 +1096,14 @@ def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
     number, not folklore (docs/SERVING.md "Model lifecycle & weight
     cache", "Workloads").  ``hbm_budget_mb`` is the experiment knob:
     0 = uncapped (baseline), small enough to hold one model =
-    worst-case thrash."""
+    worst-case thrash.
+
+    ``cascade='front:big'`` (both names in ``models``) routes the big
+    name's Zipf slot through the cascade router (serve/cascade.py):
+    its requests land in a dedicated ``cascade`` column of the table —
+    NOT under either tier — so per-model client img/s never counts a
+    cascaded request twice; the engine-side table still shows each
+    tier's own served counts."""
     import sys
     import tempfile
     import threading
@@ -1115,6 +1123,14 @@ def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
 
     registry = ModelRegistry()
     admissions: dict = {}
+
+    cas_front = cas_big = None
+    if cascade:
+        cas_front, _, cas_big = str(cascade).partition(":")
+        if cas_front not in models or cas_big not in models:
+            raise ValueError(
+                f"--cascade tiers {cascade!r} must both be in the mix "
+                f"{list(models)}")
 
     def admission_for(name):
         if name not in admissions:
@@ -1138,6 +1154,8 @@ def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
                 model, state = load_state(
                     cfg, td, log=lambda m: print(m, file=sys.stderr))
             sm = CheckpointServingModel(name, cfg, model, state)
+            if name == cas_front:
+                sm.cascade_topk = 5  # fuse the confidence epilogue
             plane.deploy(sm)
             # workload-aware input synthesis: the serving input shape
             # may be a latent vector (generate) and the wire dtype is
@@ -1150,6 +1168,19 @@ def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
             else:
                 imgs[name] = rng0.randn(*sm.input_shape).astype(wire)
         plane.warmup()  # compiles excluded from every load point
+        router = None
+        if cascade:
+            from deep_vision_tpu.serve.cascade import (CascadeRouter,
+                                                       CascadeSpec)
+            # small min_sample: the router calibrates organically from
+            # its own dual-run sampling during the first load point
+            router = CascadeRouter(plane, CascadeSpec(
+                cas_front, cas_big, min_sample=30, sample_period=10,
+                min_agreement=0.9))
+        # the cascade column owns the big name's Zipf slot: a cascaded
+        # request is recorded there and ONLY there (never under either
+        # tier), so per-model client img/s can't double-count it
+        cols = list(models) + (["cascade"] if cascade else [])
 
         # Zipf-ish popularity: weight ∝ 1/rank^s in `models` order
         weights = [1.0 / (r + 1) ** zipf_s for r in range(len(models))]
@@ -1168,7 +1199,7 @@ def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
 
         points = []
         for clients in loads:
-            per_model: dict = {name: [] for name in models}
+            per_model: dict = {name: [] for name in cols}
             errors = [0]
             retries = [0]
             lock = threading.Lock()
@@ -1179,16 +1210,22 @@ def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
                 # honor queue-full Retry-After hints with jittered
                 # bounded backoff before counting an error
                 rng = random.Random(seed)
-                local = {name: [] for name in models}
+                local = {name: [] for name in cols}
                 local_err, local_retry = 0, 0
                 while time.perf_counter() < stop_at:
                     name = pick(rng)
+                    col = "cascade" if router is not None \
+                        and name == cas_big else name
                     t0 = time.perf_counter()
                     r = None
                     try:
                         for _ in range(3):  # 1 attempt + 2 retries
-                            r = plane.infer(name, imgs[name],
-                                            timeout=60)
+                            if col == "cascade":
+                                r = router.infer(imgs[name],
+                                                 timeout=60)[1]
+                            else:
+                                r = plane.infer(name, imgs[name],
+                                                timeout=60)
                             if not (isinstance(r, Shed)
                                     and r.retry_after_s):
                                 break
@@ -1201,9 +1238,9 @@ def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
                     except Exception:  # noqa: BLE001
                         local_err += 1
                         continue
-                    local[name].append(time.perf_counter() - t0)
+                    local[col].append(time.perf_counter() - t0)
                 with lock:
-                    for name in models:
+                    for name in cols:
                         per_model[name].extend(local[name])
                     errors[0] += local_err
                     retries[0] += local_retry
@@ -1221,13 +1258,15 @@ def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
                    "errors": errors[0], "retries": retries[0],
                    "img_per_sec": round(total / elapsed, 1),
                    "models": {}}
-            for name in models:
+            for name in cols:
                 lat = np.asarray(per_model[name]) * 1e3
                 if not len(lat):
                     row["models"][name] = {"requests": 0}
                     continue
                 row["models"][name] = {
-                    "workload": registry.get(name).workload.verb,
+                    "workload": f"cascade({cascade})"
+                    if name == "cascade"
+                    else registry.get(name).workload.verb,
                     "requests": int(len(lat)),
                     "share": round(len(lat) / max(1, total), 3),
                     "p50_ms": round(float(np.percentile(lat, 50)), 2),
@@ -1235,6 +1274,7 @@ def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
                     "p99_ms": round(float(np.percentile(lat, 99)), 2)}
             points.append(row)
         stats = plane.stats()
+        cas_stats = router.stats() if router is not None else None
     finally:
         plane.stop()
     cstats = stats["cache"]
@@ -1276,6 +1316,221 @@ def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
                           / max(1, m["engine"]["served"]), 1)}
                for name, m in stats["models"].items()},
            "device_kind": jax.devices()[0].device_kind}
+    if cas_stats is not None:
+        out["cascade"] = {
+            "front": cas_stats["front"], "big": cas_stats["big"],
+            "threshold": cas_stats["threshold"],
+            "calibrated": cas_stats["calibrated"],
+            "served": cas_stats["served"],
+            "escalations": cas_stats["escalations"],
+            "escalation_rate": cas_stats["escalation_rate"],
+            "samples": cas_stats["samples"]}
+    return out
+
+
+def bench_serve_cascade(front: str = "lenet5", big: str = "lenet5_big",
+                        loads: tuple = (4, 8), duration_s: float = 2.0,
+                        max_batch: int = 8, max_wait_ms: float = 2.0,
+                        pipeline_depth: int = 2,
+                        min_agreement: float = 0.95,
+                        sample_period: int = 10,
+                        min_sample: int = 50,
+                        train_epochs: int = 2,
+                        synthetic_size: int = 1024,
+                        holdout: int = 256, **_ignored) -> dict:
+    """Confidence-routed cascade A/B (``bench.py --serve-cascade``):
+    big-model-only serving vs the cascade router (serve/cascade.py)
+    over the same control plane, at matched top-1 quality.
+
+    Both tiers TRAIN first (subprocess ``cli.train --synthetic``, a
+    couple of epochs on the blob dataset) — an untrained pair has no
+    meaningful agreement structure, so the calibration story would be
+    vacuous.  The cascade then calibrates from live dual-run samples
+    exactly as in production (no histogram backdoor), a labeled
+    held-out set scores top-1 accuracy for big-only vs cascade (the
+    matched-quality check), and closed-loop clients sweep ``loads``
+    twice per point — big-only, then cascade — for the img/s ratio.
+    Reports escalation rate, threshold, per-tier p50/p99, and the
+    accuracy deltas; docs/PERF.md records the methodology."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    from deep_vision_tpu.core.config import get_config
+    from deep_vision_tpu.data.synthetic import synthetic_classification
+    from deep_vision_tpu.serve.admission import (AdmissionController,
+                                                 Shed)
+    from deep_vision_tpu.serve.cascade import CascadeRouter, CascadeSpec
+    from deep_vision_tpu.serve.engine import BatchingEngine
+    from deep_vision_tpu.serve.faults import Quarantined
+    from deep_vision_tpu.serve.models import ModelControlPlane
+    from deep_vision_tpu.serve.registry import ModelRegistry
+    from deep_vision_tpu.serve.workloads import ClassifyWorkload
+
+    top1 = ClassifyWorkload.top1
+    registry = ModelRegistry()
+    admissions: dict = {}
+
+    def admission_for(name):
+        if name not in admissions:
+            admissions[name] = AdmissionController(name=name)
+        return admissions[name]
+
+    def engine_factory(sm):
+        return BatchingEngine(sm, max_batch=max_batch,
+                              max_wait_ms=max_wait_ms,
+                              pipeline_depth=pipeline_depth,
+                              admission=admission_for(sm.name))
+
+    plane = ModelControlPlane(registry, engine_factory,
+                              admission_factory=admission_for)
+    out: dict = {"metric": "serve_cascade_speedup", "unit": "x",
+                 "front": front, "big": big,
+                 "train_epochs": train_epochs,
+                 "min_agreement": min_agreement,
+                 "sample_period": sample_period,
+                 "min_sample": min_sample,
+                 "max_batch": max_batch, "max_wait_ms": max_wait_ms}
+    with tempfile.TemporaryDirectory() as wd:
+        for name in (front, big):
+            t0 = time.perf_counter()
+            subprocess.run(
+                [sys.executable, "-m", "deep_vision_tpu.cli.train",
+                 "-m", name, "--synthetic",
+                 "--synthetic-size", str(synthetic_size),
+                 "--epochs", str(train_epochs),
+                 "--workdir", os.path.join(wd, name)],
+                check=True, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL)
+            print(f"[cascade] trained {name} in "
+                  f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+        # float32 wire: the tiers see the exact training distribution
+        # (the synthetic blobs are float images, not 0-255 pixels)
+        fsm = registry.load_checkpoint(front, os.path.join(wd, front),
+                                       cascade_topk=5)
+        bsm = registry.load_checkpoint(big, os.path.join(wd, big))
+        cfg = get_config(big)
+        try:
+            plane.deploy(fsm)
+            plane.deploy(bsm)
+            plane.warmup()
+            spec = CascadeSpec(front, big,
+                               min_agreement=min_agreement,
+                               sample_period=sample_period,
+                               min_sample=min_sample)
+            router = CascadeRouter(plane, spec)
+            data = synthetic_classification(
+                holdout, cfg.image_size, cfg.channels,
+                cfg.num_classes, seed=7)
+            imgs = [np.ascontiguousarray(x) for x in data["image"]]
+            labels = [int(y) for y in data["label"]]
+
+            # -- quality: big-only reference answers ------------------
+            big_cls = []
+            for x in imgs:
+                r = plane.infer(big, x, timeout=120)
+                big_cls.append(top1(r)[0])
+            big_acc = sum(c == y for c, y in zip(big_cls, labels)) \
+                / len(labels)
+
+            # -- calibrate through the REAL sampling path -------------
+            warm = 0
+            while router.threshold is None \
+                    and warm < 40 * sample_period * min_sample:
+                router.infer(imgs[warm % len(imgs)], timeout=120)
+                warm += 1
+            out["calibrated"] = router.threshold is not None
+            out["threshold"] = router.threshold
+            out["warm_requests"] = warm
+
+            # -- quality: cascade answers on the same held-out set ----
+            cas_cls, tiers = [], {"front": 0, "big": 0}
+            for x in imgs:
+                tier, row = router.infer(x, timeout=120)
+                tiers[tier] += 1
+                cas_cls.append(top1(row)[0])
+            cas_acc = sum(c == y for c, y in zip(cas_cls, labels)) \
+                / len(labels)
+            matched = sum(c == b for c, b in zip(cas_cls, big_cls)) \
+                / len(big_cls)
+            out["quality"] = {
+                "holdout": len(imgs),
+                "big_top1_acc": round(big_acc, 4),
+                "cascade_top1_acc": round(cas_acc, 4),
+                "matched_top1": round(matched, 4),
+                "holdout_tiers": tiers}
+
+            # -- throughput: big-only vs cascade per load point -------
+            def sweep(infer_one):
+                lat: list = []
+                errors = [0]
+                lock = threading.Lock()
+                stop_at = time.perf_counter() + duration_s
+
+                def client(seed):
+                    rng = random.Random(seed)
+                    local, errs = [], 0
+                    while time.perf_counter() < stop_at:
+                        x = imgs[rng.randrange(len(imgs))]
+                        t0 = time.perf_counter()
+                        try:
+                            r = infer_one(x)
+                        except Exception:  # noqa: BLE001
+                            errs += 1
+                            continue
+                        if isinstance(r, (Shed, Quarantined)):
+                            errs += 1
+                            continue
+                        local.append(time.perf_counter() - t0)
+                    with lock:
+                        lat.extend(local)
+                        errors[0] += errs
+                threads = [threading.Thread(target=client, args=(k,))
+                           for k in range(clients)]
+                t_start = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+                elapsed = time.perf_counter() - t_start
+                arr = np.asarray(lat) * 1e3
+                return {"requests": len(lat), "errors": errors[0],
+                        "img_per_sec": round(len(lat) / elapsed, 1),
+                        "p50_ms": round(float(np.percentile(arr, 50)), 2)
+                        if len(lat) else None,
+                        "p99_ms": round(float(np.percentile(arr, 99)), 2)
+                        if len(lat) else None}
+
+            points = []
+            for clients in loads:
+                ref = sweep(lambda x: plane.infer(big, x, timeout=120))
+                cas = sweep(
+                    lambda x: router.infer(x, timeout=120)[1])
+                speedup = cas["img_per_sec"] / ref["img_per_sec"] \
+                    if ref["img_per_sec"] else None
+                points.append({"clients": clients,
+                               "big_only": ref, "cascade": cas,
+                               "speedup": round(speedup, 2)
+                               if speedup else None})
+            rstats = router.stats()
+            out.update({
+                "value": points[-1]["speedup"],
+                "loads": points,
+                "cascade": {
+                    "threshold": rstats["threshold"],
+                    "served": rstats["served"],
+                    "escalations": rstats["escalations"],
+                    "escalation_rate": rstats["escalation_rate"],
+                    "samples": rstats["samples"],
+                    "agreement": rstats["agreement"],
+                    "latency": rstats["latency"]},
+                "device_kind": jax.devices()[0].device_kind})
+        finally:
+            plane.stop()
     return out
 
 
@@ -2575,6 +2830,23 @@ def main():
     p.add_argument("--zipf-s", type=float, default=1.1,
                    help="Zipf exponent for --serve-mix model "
                         "popularity (higher = hotter head)")
+    p.add_argument("--serve-cascade", action="store_true",
+                   help="confidence-routed cascade A/B: train both "
+                        "tiers on synthetic data, calibrate the "
+                        "escalation threshold from live dual-run "
+                        "samples, then sweep --serve-loads big-only vs "
+                        "cascaded — img/s ratio at matched held-out "
+                        "top-1, escalation rate, per-tier p50/p99 "
+                        "(docs/PERF.md, serve/cascade.py)")
+    p.add_argument("--cascade", default="",
+                   help="'front:big' pair — the tiers for "
+                        "--serve-cascade (default lenet5:lenet5_big) "
+                        "and, when set, the cascade column source for "
+                        "--serve-mix (both names must be in "
+                        "--serve-mix-models; '' = no cascade column)")
+    p.add_argument("--cascade-min-agreement", type=float, default=0.95,
+                   help="calibration agreement floor for "
+                        "--serve-cascade")
     p.add_argument("--serve-edge", action="store_true",
                    help="HTTP front-end A/B: selector event loop "
                         "(keep-alive + pipelining + bounded conns) vs "
@@ -2681,7 +2953,18 @@ def main():
             loads=tuple(int(c) for c in args.serve_loads.split(",")),
             duration_s=args.serve_duration, max_batch=args.batch or 8,
             pipeline_depth=args.serve_pipeline_depth,
-            hbm_budget_mb=args.hbm_budget_mb, zipf_s=args.zipf_s)))
+            hbm_budget_mb=args.hbm_budget_mb, zipf_s=args.zipf_s,
+            cascade=args.cascade or None)))
+        return
+    if args.serve_cascade:
+        pair = args.cascade or "lenet5:lenet5_big"
+        front, _, big = pair.partition(":")
+        print(json.dumps(bench_serve_cascade(
+            front=front.strip(), big=big.strip(),
+            loads=tuple(int(c) for c in args.serve_loads.split(",")),
+            duration_s=args.serve_duration, max_batch=args.batch or 8,
+            pipeline_depth=args.serve_pipeline_depth,
+            min_agreement=args.cascade_min_agreement)))
         return
     if args.deploy:
         # the autoscale half needs a spare device for add_replica();

@@ -100,7 +100,9 @@ def _batch_tier(args, resolve):
 
     shard = int(getattr(args, "batch_shard_size", 0) or 0) \
         or int(args.max_batch)
-    store = JobStore(jobs_dir or None, shard_size=shard)
+    store = JobStore(jobs_dir or None, shard_size=shard,
+                     max_cached_shards=int(
+                         getattr(args, "batch_cache_shards", 64) or 0))
     sched = BatchScheduler(
         store, resolve,
         interval_s=float(getattr(args, "batch_interval_ms", 20.0) or
@@ -337,6 +339,23 @@ def _build_plane_server(args, registry, wire_dtype: str,
     names = [s.strip() for s in args.models.split(",") if s.strip()]
     if not names:
         raise ValueError("--models needs at least one config name")
+    cascade_spec = None
+    if getattr(args, "cascade", None):
+        from deep_vision_tpu.serve.cascade import CascadeSpec
+
+        cascade_spec = CascadeSpec.parse(
+            args.cascade,
+            min_agreement=float(getattr(args, "cascade_min_agreement",
+                                        0.98)),
+            sample_period=int(getattr(args, "cascade_sample_period",
+                                      10)),
+            min_sample=int(getattr(args, "cascade_min_sample", 200)),
+            topk=int(getattr(args, "cascade_topk", 5)))
+        for tier in (cascade_spec.front, cascade_spec.big):
+            if tier not in names:
+                raise ValueError(
+                    f"--cascade tier '{tier}' is not served; --models "
+                    f"must include both cascade tiers (got {names})")
     buckets = [int(b) for b in args.buckets.split(",")] if args.buckets \
         else None
     fault_spec = getattr(args, "faults", None)
@@ -430,12 +449,26 @@ def _build_plane_server(args, registry, wire_dtype: str,
                               admission_factory=admission_for)
     for name in names:
         workdir = os.path.join(args.workdir, name)
+        # the cascade's FRONT tier fuses the (top1_idx, top1_prob)
+        # confidence epilogue into its bucket programs; the big tier
+        # keeps dense logits so escalated answers are bit-identical to
+        # big-only serving (serve/cascade.py)
+        front_k = cascade_spec.topk if cascade_spec is not None \
+            and name == cascade_spec.front else 0
         sm = registry.load_checkpoint(
             name, workdir, wire_dtype=wire_dtype,
             infer_dtype=infer_dtype,
             calib_batches=int(getattr(args, "calib_batches", 2) or 2),
-            calib_dir=getattr(args, "calib_dir", None))
+            calib_dir=getattr(args, "calib_dir", None),
+            cascade_topk=front_k)
         plane.deploy(sm, workdir=workdir)
+    cascade = None
+    if cascade_spec is not None:
+        from deep_vision_tpu.serve.cascade import CascadeRouter
+
+        # built AFTER the boot deploys: the router's version listener
+        # only needs to see RELOADS (boot state is uncalibrated anyway)
+        cascade = CascadeRouter(plane, cascade_spec)
     if args.warmup:
         for name, eng in plane.active_engines().items():
             print(f"[serve] warming {name} {eng.buckets} ...")
@@ -499,7 +532,7 @@ def _build_plane_server(args, registry, wire_dtype: str,
         socket_timeout_s=socket_timeout_s if socket_timeout_s > 0
         else None,
         tracer=tracer, plane=plane, deploy=pipeline,
-        jobs=jobs, batch_sched=batch_sched,
+        jobs=jobs, batch_sched=batch_sched, cascade=cascade,
         **_edge_kwargs(args))
     return plane, server
 
@@ -733,6 +766,33 @@ def main(argv=None):
                         "classes with token-bucket quotas and "
                         "pressure-weighted shedding (docs/SERVING.md; "
                         "empty = off)")
+    # -- confidence-routed cascade (docs/SERVING.md "Cascaded
+    #    serving") --
+    p.add_argument("--cascade", default=None,
+                   help="'front:big' — route classify requests "
+                        "addressed to the BIG model through the cheap "
+                        "FRONT tier first, escalating only when the "
+                        "front's top-1 confidence falls below a "
+                        "threshold calibrated from live dual-run "
+                        "samples; both names must appear in --models "
+                        "(serve/cascade.py; uncalibrated = all-big)")
+    p.add_argument("--cascade-min-agreement", type=float, default=0.98,
+                   help="calibration target: smallest confidence "
+                        "threshold whose measured front-vs-big top-1 "
+                        "agreement (above it) still clears this")
+    p.add_argument("--cascade-sample-period", type=int, default=10,
+                   help="every N-th cascade request dual-runs BOTH "
+                        "tiers to feed the agreement histogram (the "
+                        "big answer is returned, so sampling costs no "
+                        "correctness)")
+    p.add_argument("--cascade-min-sample", type=int, default=200,
+                   help="calibration samples required before any "
+                        "traffic may stop at the front tier; below it "
+                        "the cascade fails closed to all-big")
+    p.add_argument("--cascade-topk", type=int, default=5,
+                   help="entries in the front tier's fused device-side "
+                        "top-k confidence epilogue (bounds top_k in "
+                        "front-served responses)")
     # -- offline batch tier (docs/BATCH.md) --
     p.add_argument("--jobs-dir", default=None,
                    help="enable the offline batch-inference tier "
@@ -758,6 +818,12 @@ def main(argv=None):
                    help="interactive pressure ceiling (queue_depth x "
                         "exec EWMA, ms) for the trough check; above "
                         "it batch work defers")
+    p.add_argument("--batch-cache-shards", type=int, default=64,
+                   help="per-job completed-shard payloads kept in "
+                        "memory; with --jobs-dir the rest spill to the "
+                        "JSONL ledger (LRU) and GET /v1/jobs/<id>/"
+                        "results streams them back from disk (0 = "
+                        "unbounded; memory-only stores never evict)")
     # -- observability (docs/OBSERVABILITY.md) --
     p.add_argument("--log-level", default="info",
                    choices=("debug", "info", "warning", "error"),
@@ -776,6 +842,9 @@ def main(argv=None):
     args = p.parse_args(argv)
     if not args.model and not args.models:
         p.error("one of -m/--model or --models is required")
+    if args.cascade and not args.models:
+        p.error("--cascade routes across the multi-model plane; use "
+                "--models front,big")
 
     from deep_vision_tpu.core.compile_cache import enable_compile_cache
     from deep_vision_tpu.obs.log import configure_logging
@@ -800,6 +869,16 @@ def main(argv=None):
               f"shadow_frac={args.shadow_frac}) — reload: curl -XPOST "
               f"http://{server.host}:{server.port}"
               f"/v1/models/<name>/reload")
+    cascade = getattr(server.httpd, "cascade", None)
+    if cascade is not None:
+        print(f"[serve] cascade: {cascade.spec.front} -> "
+              f"{cascade.spec.big} — requests "
+              f"for '{cascade.spec.big}' answer from the front tier "
+              f"when calibrated confidence allows "
+              f"(min_agreement={cascade.spec.min_agreement}, "
+              f"sample_period={cascade.spec.sample_period}, "
+              f"min_sample={cascade.spec.min_sample}; uncalibrated = "
+              f"all-big)")
     deploy = getattr(server.httpd, "deploy", None)
     if deploy is not None:
         bits = []

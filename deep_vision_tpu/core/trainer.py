@@ -633,6 +633,15 @@ class Trainer:
             # device batches pinned in it
             if self._prefetcher is not None:
                 self._prefetcher.close()
+            # the last epoch's async saves must commit before the process
+            # exits — interpreter shutdown kills orbax's background
+            # executor mid-finalize, leaving a *.orbax-checkpoint-tmp-*
+            # directory that restore() cannot see
+            for ckpt in (self.checkpointer, self.best_checkpointer):
+                try:
+                    ckpt.wait_until_finished()
+                except Exception:  # noqa: BLE001 — a failed async save already logged itself; don't mask the fit() result
+                    pass
 
     def _install_preempt_handler(self):
         self._preempted = False  # stale flag must not abort a fresh fit()

@@ -14,6 +14,38 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 
+class LeNet5Big(nn.Module):
+    """A deliberately heavy MNIST-shape classifier — the cascade's BIG
+    tier opposite LeNet-5 (serve/cascade.py, bench.py --serve-cascade).
+
+    Same 32×32×1 input and class count as LeNet-5 so the two tiers are
+    interchangeable on the wire, but VGG-style doubled-conv blocks with
+    ``width``× the channels and a wide head: ~50× the FLOPs/params of
+    LeNet-5 at width 32 — the compute ratio the reference zoo spans
+    between its mobile and server models, reproduced at a size CPU
+    hosts can still bench."""
+
+    num_classes: int = 10
+    width: int = 32
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for mult in (1, 2, 4):  # 32→16→8→4 after the pools
+            ch = self.width * mult
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.Conv(ch, (3, 3), padding="SAME", dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), (2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(8 * self.width, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
 class LeNet5(nn.Module):
     num_classes: int = 10
     dtype: Any = jnp.float32

@@ -249,13 +249,17 @@ class QoSClass:
     of engine queue capacity beyond which this class's cache-missing
     requests are shed pre-engine, so under pressure best-effort
     (shed_at 0.5) absorbs the 429s half a queue before premium
-    (shed_at 1.0) loses anything."""
+    (shed_at 1.0) loses anything.  ``always_big`` is the cascade
+    premium knob (serve/cascade.py): members of the class bypass the
+    cheap front tier entirely — every request goes straight to the big
+    tier, pricing guaranteed-big-model answers as a QoS class."""
 
     name: str
     rate: float = 0.0
     burst: float = 1.0
     shed_at: float = 1.0
     tenants: tuple = ()
+    always_big: bool = False
 
 
 class TenantQoS:
@@ -276,7 +280,9 @@ class TenantQoS:
         ``premium:rate=0,shed_at=1.0,tenants=acme|bigco;``
         ``best_effort:rate=20,burst=5,shed_at=0.5;default=best_effort``
     ``tenants=`` pins named tenants to a class; everything else lands in
-    the ``default=`` class (first class declared if omitted)."""
+    the ``default=`` class (first class declared if omitted);
+    ``always_big=1`` marks the class as cascade-premium (its tenants
+    bypass the front tier — serve/cascade.py)."""
 
     def __init__(self, classes: list, default: str):
         if not classes:
@@ -315,6 +321,9 @@ class TenantQoS:
                         t for t in v.strip().split("|") if t)
                 elif k in ("rate", "burst", "shed_at"):
                     kw[k] = float(v)
+                elif k == "always_big":
+                    kw["always_big"] = v.strip().lower() \
+                        not in ("", "0", "false", "no")
                 else:
                     raise ValueError(f"unknown QoS option {k!r} in "
                                      f"{part!r}")
@@ -381,6 +390,7 @@ class TenantQoS:
             return {name: {
                         "rate": c.rate, "burst": c.burst,
                         "shed_at": c.shed_at,
+                        "always_big": c.always_big,
                         "served": self._served[name],
                         "shed_quota": self._shed_quota[name],
                         "shed_priority": self._shed_priority[name],

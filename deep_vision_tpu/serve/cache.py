@@ -59,6 +59,12 @@ class ResponseCache:
         self.misses = 0       # guarded-by: _lock
         self.evictions = 0    # guarded-by: _lock
         self.insertions = 0   # guarded-by: _lock
+        # cascade provenance: which tier produced each inserted answer
+        # ("front"/"big").  Counters only — the KEY stays tier-agnostic
+        # (a hit is a hit no matter which tier computed it), keyed on
+        # the cascade's combined digest so either tier's reload still
+        # invalidates.  guarded-by: _lock
+        self.insertions_by_tier: dict = {}
 
     @staticmethod
     def key(route: str, model: str, version_digest: str,
@@ -79,7 +85,8 @@ class ResponseCache:
             self.hits += 1
             return blob
 
-    def put(self, key: tuple, blob: bytes):  # dvtlint: hot
+    def put(self, key: tuple, blob: bytes,
+            tier: str | None = None):  # dvtlint: hot
         size = len(blob)
         if size > self.max_bytes:
             return  # larger than the whole budget: not cacheable
@@ -90,6 +97,9 @@ class ResponseCache:
             self._store[key] = blob
             self._bytes += size
             self.insertions += 1
+            if tier:
+                self.insertions_by_tier[tier] = \
+                    self.insertions_by_tier.get(tier, 0) + 1
             while self._bytes > self.max_bytes:
                 _, victim = self._store.popitem(last=False)
                 self._bytes -= len(victim)
@@ -110,4 +120,5 @@ class ResponseCache:
                     "misses": self.misses,
                     "hit_rate": self.hits / lookups if lookups else 0.0,
                     "evictions": self.evictions,
-                    "insertions": self.insertions}
+                    "insertions": self.insertions,
+                    "insertions_by_tier": dict(self.insertions_by_tier)}

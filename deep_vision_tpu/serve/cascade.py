@@ -1,0 +1,406 @@
+"""Confidence-routed model cascade: serve the cheap model when it's
+sure.
+
+Classic production-vision economics (ROADMAP): the zoo spans ~50× in
+compute for the same task, and most traffic doesn't need the big
+model.  The ``CascadeRouter`` layers on the multi-model plane
+(serve/models.py) and routes every classify request addressed to the
+BIG model name through a cheap FRONT tier first; the request only
+escalates to the big tier when the front's top-1 softmax confidence
+falls below a *calibrated* threshold.
+
+Addressing contract: clients name the big model — that name is the
+quality contract — and the cascade transparently answers from the
+front tier when it is confident, reporting which tier actually
+answered in the ``X-DVT-Tier`` response header.  Requests that name
+the front model directly bypass the cascade (it is still an ordinary
+routable model), and "always-big" QoS tenants (serve/admission.py)
+force every request straight to the big tier.
+
+Calibration inverts the PR 9 shadow-sampling machinery: every
+``sample_period``-th request runs BOTH tiers — the client gets the big
+tier's answer (authoritative), and the front-vs-big top-1 agreement is
+recorded into an ``AgreementHistogram`` at the front's confidence
+bucket.  The threshold is then the smallest confidence whose measured
+at-or-above agreement clears ``min_agreement``.  Fail-closed is the
+core safety property: with no threshold (sample thinner than
+``min_sample``, or no confidence level agrees enough) ALL traffic goes
+to the big tier, and a version swap of either tier (reload, promote,
+revert) resets calibration through the plane's version listener —
+new weights shift the confidence distribution, so the old threshold is
+invalid until the sample rebuilds.
+
+The escalation decision is device-cheap: the front tier's bucket
+programs carry a fused confidence epilogue
+(workloads.ClassifyWorkload.make_epilogue, the PR 15 pose-epilogue
+pattern) so the router reads ``(top1_class, top1_prob)`` off the bulk
+D2H row instead of the dense logits.  An escalated image re-enters the
+big tier's admission queue carrying its REMAINING deadline — original
+budget minus the time the front attempt burned — and its original
+trace span, so a cascaded request never gets double SLO budget and the
+big tier's admission controller judges it by what's actually left.
+
+All chaining is ``Future.add_done_callback`` — the router never blocks
+an engine worker thread.  Lock order: ``CascadeRouter._lock`` is a
+LEAF lock; no plane or engine call happens under it.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+
+from deep_vision_tpu.analysis.sanitizer import new_lock
+from deep_vision_tpu.core.metrics import LatencyHistogram
+from deep_vision_tpu.obs.log import event, get_logger
+from deep_vision_tpu.serve.admission import Shed
+from deep_vision_tpu.serve.faults import Quarantined
+from deep_vision_tpu.serve.models import AgreementHistogram
+from deep_vision_tpu.serve.workloads import ClassifyWorkload
+
+_log = get_logger("dvt.serve.cascade")
+
+FRONT = "front"
+BIG = "big"
+
+_DEFAULT_DEADLINE_MS = 30_000.0
+
+
+class CascadeSpec:
+    """Parsed ``--cascade front:big`` plus the calibration knobs — one
+    immutable value the CLI hands to the router and the boot print."""
+
+    def __init__(self, front: str, big: str, *,
+                 min_agreement: float = 0.98,
+                 sample_period: int = 10,
+                 min_sample: int = 200,
+                 bins: int = 20,
+                 topk: int = 5):
+        if not front or not big or front == big:
+            raise ValueError(
+                f"cascade needs two distinct model names, got "
+                f"{front!r}:{big!r}")
+        self.front = front
+        self.big = big
+        self.min_agreement = float(min_agreement)
+        self.sample_period = max(1, int(sample_period))
+        self.min_sample = max(1, int(min_sample))
+        self.bins = max(1, int(bins))
+        self.topk = max(1, int(topk))
+
+    @classmethod
+    def parse(cls, spec: str, **kw) -> "CascadeSpec":
+        front, sep, big = str(spec).partition(":")
+        if not sep:
+            raise ValueError(
+                f"--cascade wants 'front:big', got {spec!r}")
+        return cls(front.strip(), big.strip(), **kw)
+
+    def describe(self) -> dict:
+        return {"front": self.front, "big": self.big,
+                "min_agreement": self.min_agreement,
+                "sample_period": self.sample_period,
+                "min_sample": self.min_sample,
+                "bins": self.bins, "topk": self.topk}
+
+
+class CascadeRouter:
+    """Route classify traffic addressed to ``spec.big`` through the
+    front tier, escalating below the calibrated threshold."""
+
+    def __init__(self, plane, spec: CascadeSpec):
+        self.plane = plane
+        self.spec = spec
+        self.hist = AgreementHistogram(bins=spec.bins)
+        self._lock = new_lock("serve.cascade.CascadeRouter._lock")
+        # None = uncalibrated → fail closed (all-big); guarded-by: _lock
+        self._threshold: float | None = None
+        self._tick = 0  # guarded-by: _lock
+        self.served = {FRONT: 0, BIG: 0}  # guarded-by: _lock
+        self.escalations = 0  # guarded-by: _lock
+        self.escalated_shed = 0  # no deadline left post-front; guarded-by: _lock
+        self.escalated_lowconf = 0  # guarded-by: _lock
+        self.escalated_error = 0  # front Shed/Quarantined/raise; guarded-by: _lock
+        self.forced_big = 0  # always-big tenants; guarded-by: _lock
+        self.samples = 0  # dual-run calibration requests; guarded-by: _lock
+        self.samples_discarded = 0  # guarded-by: _lock
+        self.calibrations = 0  # threshold (re)computed; guarded-by: _lock
+        self.resets = 0  # version-swap calibration drops; guarded-by: _lock
+        self._latency = {FRONT: LatencyHistogram(),
+                         BIG: LatencyHistogram()}  # guarded-by: _lock
+        self._top1 = ClassifyWorkload.top1
+        plane.add_version_listener(self._on_version_swap)
+
+    # -- routing table ------------------------------------------------------
+
+    def serves(self, name: str) -> bool:
+        """True when requests addressed to ``name`` route through the
+        cascade (only the big/logical name; the front model stays
+        directly addressable)."""
+        return name == self.spec.big
+
+    @property
+    def threshold(self) -> float | None:
+        with self._lock:
+            return self._threshold
+
+    def params_digest(self) -> str | None:
+        """Combined version identity of BOTH tiers — the response-cache
+        digest slot, so a reload of either tier stops old keys from
+        matching.  None (uncacheable) unless both tiers carry digests,
+        same contract as a single model without one."""
+        try:
+            f = getattr(self.plane.resolve(self.spec.front),
+                        "params_digest", None)
+            b = getattr(self.plane.resolve(self.spec.big),
+                        "params_digest", None)
+        except KeyError:
+            return None
+        if not f or not b:
+            return None
+        return f"{f}+{b}"
+
+    def canary_active(self) -> bool:
+        """Cache inserts pause while EITHER tier runs a canary — a
+        canary-served answer must not be filed under the steady-state
+        combined digest."""
+        return self.plane.canary_active(self.spec.front) \
+            or self.plane.canary_active(self.spec.big)
+
+    # -- request path -------------------------------------------------------
+
+    def submit(self, image, deadline_ms: float | None = None,
+               span=None, force_big: bool = False) -> Future:
+        """Route one request.  The future resolves to ``(tier, row)``
+        where ``tier`` is "front"/"big" (the ``X-DVT-Tier`` header) and
+        ``row`` is exactly what the named tier's engine produced —
+        including Shed/Quarantined verdicts, which the HTTP layer maps
+        to status codes the same way as for a plain model."""
+        fut: Future = Future()
+        t0 = time.monotonic()
+        if deadline_ms is None:
+            deadline_ms = _DEFAULT_DEADLINE_MS
+        deadline_ms = float(deadline_ms)
+        with self._lock:
+            self._tick += 1
+            tick = self._tick
+            thr = self._threshold
+            if force_big:
+                self.forced_big += 1
+        if force_big:
+            if span is not None:
+                span.mark("cascade_forced_big")
+            self._submit_big(image, deadline_ms, span, fut, t0)
+            return fut
+        if tick % self.spec.sample_period == 0:
+            self._submit_sample(image, deadline_ms, span, fut, t0)
+            return fut
+        if thr is None:
+            # fail closed: uncalibrated traffic belongs to the big tier
+            self._submit_big(image, deadline_ms, span, fut, t0)
+            return fut
+        ffut = self.plane.submit(self.spec.front, image, deadline_ms,
+                                 span=span)
+        ffut.add_done_callback(
+            lambda f: self._front_done(f, image, deadline_ms, span,
+                                       fut, t0, thr))
+        return fut
+
+    def infer(self, image, deadline_ms: float | None = None,
+              timeout: float | None = 30.0, span=None,
+              force_big: bool = False):
+        """Blocking wrapper → ``(tier, row)``."""
+        return self.submit(image, deadline_ms, span=span,
+                           force_big=force_big).result(timeout)
+
+    def _submit_big(self, image, deadline_ms, span, fut: Future, t0):
+        bfut = self.plane.submit(self.spec.big, image, deadline_ms,
+                                 span=span)
+        bfut.add_done_callback(lambda f: self._finish(f, fut, t0, BIG))
+
+    def _front_done(self, ffut: Future, image, deadline_ms, span,
+                    fut: Future, t0, thr: float):
+        """Front answered (engine worker thread — never block): serve
+        it when confident, escalate otherwise."""
+        try:
+            row = ffut.result()
+        except Exception:  # noqa: BLE001 — front failure must not reach the client; big owns the contract
+            self._escalate(image, deadline_ms, span, fut, t0, "error")
+            return
+        if isinstance(row, (Shed, Quarantined)):
+            # front shed/quarantined: the request still deserves the
+            # big tier's attempt — the client addressed the big name
+            self._escalate(image, deadline_ms, span, fut, t0, "error")
+            return
+        _, conf = self._top1(row)
+        if conf is None:
+            # no confidence on the row (front missing its epilogue and
+            # a non-classify shape): never guess — escalate
+            self._escalate(image, deadline_ms, span, fut, t0, "error")
+            return
+        if conf >= thr:
+            if span is not None:
+                span.mark("cascade_front_served")
+            self._finish_row(row, fut, t0, FRONT)
+            return
+        self._escalate(image, deadline_ms, span, fut, t0, "lowconf")
+
+    def _escalate(self, image, deadline_ms, span, fut: Future, t0,
+                  why: str):
+        """Re-admit on the big tier with the REMAINING deadline —
+        original budget minus the front attempt — so escalation never
+        doubles the SLO budget."""
+        with self._lock:
+            self.escalations += 1
+            if why == "lowconf":
+                self.escalated_lowconf += 1
+            else:
+                self.escalated_error += 1
+        remaining_ms = deadline_ms - (time.monotonic() - t0) * 1e3
+        if remaining_ms <= 0.0:
+            with self._lock:
+                self.escalated_shed += 1
+            self._finish_row(
+                Shed("deadline",
+                     f"cascade escalation: front attempt consumed the "
+                     f"{deadline_ms:.0f}ms budget"),
+                fut, t0, BIG)
+            return
+        if span is not None:
+            span.mark("cascade_escalate")
+        bfut = self.plane.submit(self.spec.big, image, remaining_ms,
+                                 span=span)
+        bfut.add_done_callback(lambda f: self._finish(f, fut, t0, BIG))
+
+    def _finish(self, inner: Future, fut: Future, t0, tier: str):
+        try:
+            row = inner.result()
+        except Exception as e:  # noqa: BLE001 — propagate the tier's failure as-is
+            fut.set_exception(e)
+            return
+        self._finish_row(row, fut, t0, tier)
+
+    def _finish_row(self, row, fut: Future, t0, tier: str):
+        with self._lock:
+            self.served[tier] += 1
+            self._latency[tier].record(time.monotonic() - t0)
+        fut.set_result((tier, row))
+
+    # -- calibration --------------------------------------------------------
+
+    def _submit_sample(self, image, deadline_ms, span, fut: Future, t0):
+        """Dual-run calibration sample: BOTH tiers execute, the client
+        gets the big answer (authoritative), and front-vs-big top-1
+        agreement lands in the histogram at the front's confidence
+        bucket.  Same holder-pair idiom as the plane's shadow compare."""
+        with self._lock:
+            self.samples += 1
+        ffut = self.plane.submit(self.spec.front, image, deadline_ms)
+        bfut = self.plane.submit(self.spec.big, image, deadline_ms,
+                                 span=span)
+        holder: dict = {}
+
+        def arrived(which, f):
+            with self._lock:
+                holder[which] = f
+                ready = "f" in holder and "b" in holder \
+                    and not holder.get("_done")
+                if ready:
+                    holder["_done"] = True
+            if ready:
+                self._record_sample(holder["f"], holder["b"])
+
+        ffut.add_done_callback(lambda f: arrived("f", f))
+        bfut.add_done_callback(lambda f: arrived("b", f))
+        bfut.add_done_callback(lambda f: self._finish(f, fut, t0, BIG))
+
+    def _record_sample(self, ffut: Future, bfut: Future):
+        try:
+            fr, br = ffut.result(), bfut.result()
+        except Exception:  # noqa: BLE001 — either side failed: nothing to compare
+            with self._lock:
+                self.samples_discarded += 1
+            return
+        fcls, fconf = self._top1(fr)
+        bcls, _ = self._top1(br)
+        if fcls is None or fconf is None or bcls is None:
+            with self._lock:
+                self.samples_discarded += 1
+            return
+        self.hist.record(fconf, fcls == bcls)
+        self._recalibrate()
+
+    def _recalibrate(self):
+        thr = self.hist.threshold(self.spec.min_agreement,
+                                  self.spec.min_sample)
+        with self._lock:
+            old = self._threshold
+            self._threshold = thr
+            changed = thr != old
+            if changed:
+                self.calibrations += 1
+        if changed:
+            event(_log, "cascade_calibrated",
+                  front=self.spec.front, big=self.spec.big,
+                  threshold=thr,
+                  samples=self.hist.stats()["samples"])
+
+    def _on_version_swap(self, name: str):
+        """Plane version listener: a reload/promote/revert of either
+        tier invalidates the calibration — fail closed and resample."""
+        if name not in (self.spec.front, self.spec.big):
+            return
+        self.hist.reset()
+        with self._lock:
+            had = self._threshold is not None
+            self._threshold = None
+            self.resets += 1
+        if had:
+            event(_log, "cascade_recalibrating", model=name,
+                  front=self.spec.front, big=self.spec.big)
+
+    # -- observability ------------------------------------------------------
+
+    def stats(self) -> dict:
+        """The reserved ``cascade`` block in /v1/stats — serve/http.py
+        renders the ``dvt_cascade_*`` /metrics series from it, and the
+        gateway folds it into its fleet view."""
+        hstats = self.hist.stats()
+        with self._lock:
+            served = dict(self.served)
+            routed = served[FRONT] + self.escalated_lowconf \
+                + self.escalated_shed
+            out = {
+                "front": self.spec.front,
+                "big": self.spec.big,
+                "threshold": self._threshold,
+                "calibrated": self._threshold is not None,
+                "min_agreement": self.spec.min_agreement,
+                "sample_period": self.spec.sample_period,
+                "min_sample": self.spec.min_sample,
+                "served": served,
+                "escalations": self.escalations,
+                "escalated_lowconf": self.escalated_lowconf,
+                "escalated_error": self.escalated_error,
+                "escalated_shed": self.escalated_shed,
+                # of the requests the front tier actually judged, how
+                # many it sent upstairs — the live economics gauge
+                "escalation_rate": ((self.escalated_lowconf
+                                     + self.escalated_shed) / routed)
+                if routed else None,
+                "forced_big": self.forced_big,
+                "samples": self.samples,
+                "samples_discarded": self.samples_discarded,
+                "calibrations": self.calibrations,
+                "resets": self.resets,
+                "agreement": hstats["agreement"],
+                "agreement_bins": {"bins": hstats["bins"],
+                                   "samples": hstats["samples"],
+                                   "total": hstats["total"],
+                                   "agree": hstats["agree"]},
+                "latency": {t: h.percentiles()
+                            for t, h in self._latency.items()},
+                "latency_hist": {t: h.state_dict()
+                                 for t, h in self._latency.items()},
+            }
+        return out

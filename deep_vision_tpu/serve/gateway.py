@@ -104,7 +104,8 @@ HALF_OPEN = "half_open"
 
 # retry-able HTTP verdicts vs. final ones: anything below 500 except a
 # 429 means the backend is alive and answered THIS request definitively
-_PROXY_HEADERS = ("Content-Type", "Retry-After", "X-DVT-Cache")
+_PROXY_HEADERS = ("Content-Type", "Retry-After", "X-DVT-Cache",
+                  "X-DVT-Tier")
 
 
 class Backend:
@@ -923,7 +924,60 @@ class Gateway:
                 merged.state_dict() if merged is not None else None
             out["gateway"]["mfu"] = mfu
             out["gateway"]["models"] = per_model
+            cas = self._aggregate_cascade(agg)
+            if cas is not None:
+                out["gateway"]["cascade"] = cas
         return out
+
+    @staticmethod
+    def _aggregate_cascade(agg: dict):
+        """Fold each backend's reserved ``cascade`` stats block into
+        one fleet view: summed tier/escalation/sample counters, a
+        fleet-wide escalation rate, and per-tier latency percentiles
+        from bin-wise-merged histograms (true fleet quantiles, same
+        construction as the backend-latency merge above).  None when no
+        backend runs a cascade."""
+        served: dict = {}
+        esc = esc_low = esc_shed = samples = forced = 0
+        backends = []
+        hists: dict = {}
+        for bname, bstats in agg.items():
+            cas = bstats.get("cascade") \
+                if isinstance(bstats, dict) else None
+            if not isinstance(cas, dict):
+                continue
+            backends.append(bname)
+            for tier, n in (cas.get("served") or {}).items():
+                served[tier] = served.get(tier, 0) + int(n or 0)
+            esc += int(cas.get("escalations") or 0)
+            esc_low += int(cas.get("escalated_lowconf") or 0)
+            esc_shed += int(cas.get("escalated_shed") or 0)
+            samples += int(cas.get("samples") or 0)
+            forced += int(cas.get("forced_big") or 0)
+            for tier, h in (cas.get("latency_hist") or {}).items():
+                if not h:
+                    continue
+                try:
+                    mh = hists.get(tier)
+                    if mh is None:
+                        mh = hists[tier] = LatencyHistogram()
+                        mh.load_state_dict(h)
+                    else:
+                        mh.merge(h)
+                except (KeyError, ValueError, TypeError):
+                    pass  # malformed or mismatched bins: skip
+        if not backends:
+            return None
+        routed = served.get("front", 0) + esc_low + esc_shed
+        return {"backends": backends,
+                "served": served,
+                "escalations": esc,
+                "escalation_rate": ((esc_low + esc_shed) / routed)
+                if routed else None,
+                "samples": samples,
+                "forced_big": forced,
+                "latency": {t: h.percentiles()
+                            for t, h in hists.items()}}
 
     @staticmethod
     def _iter_engine_stats(bstats: dict):
@@ -1089,6 +1143,20 @@ def render_gateway_metrics(gw: Gateway, edge: dict | None = None) -> str:
     p.gauge("dvt_gateway_serving_mfu", mfu.get("serving_mfu"),
             help="Fleet serving MFU (summed FLOPs / summed compute "
                  "seconds / peak)")
+    cas = g.get("cascade")
+    if isinstance(cas, dict):
+        p.counter("dvt_gateway_cascade_escalations_total",
+                  cas.get("escalations"),
+                  help="Cascade escalations summed across backends")
+        p.gauge("dvt_gateway_cascade_escalation_rate",
+                cas.get("escalation_rate"),
+                help="Fleet-wide fraction of front-judged requests "
+                     "escalated to the big tier")
+        for tier, n in sorted((cas.get("served") or {}).items()):
+            p.counter("dvt_gateway_cascade_requests_total", n,
+                      {"tier": str(tier)},
+                      help="Cascade answers fleet-wide by answering "
+                           "tier")
     tr = g.get("trace") or {}
     p.counter("dvt_gateway_traces_finished_total", tr.get("finished"),
               help="Gateway spans sealed into the ring")

@@ -160,6 +160,11 @@ from deep_vision_tpu.serve.workloads import (
 
 DEFAULT_MAX_BODY_BYTES = 32 * 2**20
 
+#: which cascade tier produced this answer ("front"/"big") — set on
+#: every cascaded 200 so clients and the bench can split per-tier
+#: latency without a debug span (serve/cascade.py)
+TIER_HEADER = "X-DVT-Tier"
+
 
 class ServeError(Exception):
     def __init__(self, status: int, message: str,
@@ -265,6 +270,8 @@ def render_serve_metrics(stats: dict) -> str:
     _render_edge_metrics(p, stats)
     if isinstance(stats.get("batch"), dict):
         _render_batch_metrics(p, stats["batch"])
+    if isinstance(stats.get("cascade"), dict):
+        _render_cascade_metrics(p, stats["cascade"])
     if isinstance(stats.get("models"), dict):
         for name, entry in stats["models"].items():
             if isinstance(entry.get("engine"), dict):
@@ -327,7 +334,8 @@ def render_serve_metrics(stats: dict) -> str:
             _render_deploy_metrics(p, dep)
         return p.render()
     for name, s in stats.items():
-        if name in ("edge", "response_cache", "qos", "batch"):
+        if name in ("edge", "response_cache", "qos", "batch",
+                    "cascade"):
             continue  # front-end blocks, rendered above
         _render_engine_metrics(p, name, s)
     return p.render()
@@ -376,6 +384,13 @@ def _render_edge_metrics(p, stats: dict) -> None:
         p.counter("dvt_serve_cache_insertions_total",
                   rcache.get("insertions"), {},
                   help="Responses inserted into the cache")
+        for tier, n in sorted(
+                (rcache.get("insertions_by_tier") or {}).items()):
+            p.counter("dvt_serve_cache_tier_insertions_total", n,
+                      {"tier": str(tier)},
+                      help="Cache inserts by the cascade tier that "
+                           "produced the answer (the key itself stays "
+                           "tier-agnostic)")
         p.gauge("dvt_serve_cache_bytes", rcache.get("bytes"), {},
                 help="Bytes of cached serialized responses")
         p.gauge("dvt_serve_cache_entries", rcache.get("entries"), {},
@@ -476,6 +491,51 @@ def _render_batch_metrics(p, batch: dict) -> None:
                 help="serving MFU x engine compute occupancy — the "
                      "sustained-throughput MFU a saturating bulk job "
                      "should drive toward the interactive peak")
+
+
+def _render_cascade_metrics(p, cas: dict) -> None:
+    """Emit the dvt_cascade_* series from the reserved ``cascade``
+    stats block (serve/cascade.py ``CascadeRouter.stats()``;
+    docs/OBSERVABILITY.md tabulates these)."""
+    lab = {"front": str(cas.get("front")), "big": str(cas.get("big"))}
+    p.counter("dvt_cascade_escalations_total", cas.get("escalations"),
+              lab, help="Requests the front tier sent to the big tier "
+                        "(low confidence, front errors, and "
+                        "deadline-exhausted escalations)")
+    for tier in ("front", "big"):
+        p.counter("dvt_cascade_requests_total",
+                  (cas.get("served") or {}).get(tier),
+                  {**lab, "tier": tier},
+                  help="Cascade requests answered, by the tier that "
+                       "produced the answer")
+    p.gauge("dvt_cascade_escalation_rate", cas.get("escalation_rate"),
+            lab, help="Of requests the front tier judged, the fraction "
+                      "escalated — the live cascade-economics gauge")
+    p.gauge("dvt_cascade_threshold", cas.get("threshold"), lab,
+            help="Calibrated confidence threshold (absent while "
+                 "uncalibrated — fail-closed, all traffic big)")
+    p.gauge("dvt_cascade_calibrated",
+            1 if cas.get("calibrated") else 0, lab,
+            help="1 while a calibrated threshold routes traffic to "
+                 "the front tier")
+    p.gauge("dvt_cascade_agreement", cas.get("agreement"), lab,
+            help="Front-vs-big top-1 agreement over the live "
+                 "calibration sample")
+    p.counter("dvt_cascade_calibration_samples_total",
+              cas.get("samples"), lab,
+              help="Dual-run calibration samples taken")
+    p.counter("dvt_cascade_forced_big_total", cas.get("forced_big"),
+              lab, help="Requests routed straight to the big tier for "
+                        "always-big QoS tenants")
+    p.counter("dvt_cascade_recalibrations_total", cas.get("resets"),
+              lab, help="Calibration drops after a tier version swap")
+    for tier, hist in (cas.get("latency_hist") or {}).items():
+        if hist:
+            p.histogram("dvt_cascade_latency_seconds", hist,
+                        {**lab, "tier": tier},
+                        help="End-to-end cascade request latency by "
+                             "answering tier (escalations land in "
+                             "'big' and include the front attempt)")
 
 
 def _render_engine_metrics(p, name: str, s: dict) -> None:
@@ -602,6 +662,7 @@ class _Handler(BaseHTTPRequestHandler):
     _rid = None
     _span = None
     _raw_body = None  # raw payload bytes — the cache's content address
+    _tier = None  # cascade tier that answered ("front"/"big")
     # chunked-response state: edge._handle sets _edge_stream on its
     # shim; _reply_stream parks the body generator on _stream for the
     # event loop to pump (serve/edge.py), or drains inline without it
@@ -730,7 +791,21 @@ class _Handler(BaseHTTPRequestHandler):
         if deadline_ms is None and wl is not None:
             deadline_ms = wl.slo.deadline_ms
         plane = getattr(self.server, "plane", None)
-        if plane is not None:
+        cascade = getattr(self.server, "cascade", None)
+        if cascade is not None and plane is not None \
+                and cascade.serves(model.name):
+            # cascade routing: the front tier answers when confident,
+            # escalation to the big tier keeps the ORIGINAL deadline
+            # budget.  Always-big QoS tenants skip the front entirely.
+            qos = getattr(self.server, "qos", None)
+            force_big = False
+            if qos is not None:
+                tenant = self.headers.get(TENANT_HEADER) or ""
+                force_big = bool(qos.class_of(tenant).always_big)
+            self._tier, result = cascade.infer(
+                x, deadline_ms=deadline_ms, span=self._span,
+                force_big=force_big)
+        elif plane is not None:
             # plane routing: canary/shadow splits + cross-version
             # resubmission happen behind this call, not per-engine
             result = plane.infer(model.name, x,
@@ -801,10 +876,17 @@ class _Handler(BaseHTTPRequestHandler):
             raise ServeError(400, f"'{model.name}' is a {model.task} "
                                   f"model; use /v1/{model_wl.verb}")
         cache = getattr(self.server, "response_cache", None)
+        cascade = getattr(self.server, "cascade", None)
+        if cascade is not None and not cascade.serves(model.name):
+            cascade = None
         key = None
         if cache is not None and not debug \
                 and self._raw_body is not None:
-            digest = getattr(model, "params_digest", None)
+            # cascaded models key on the COMBINED front+big digest: a
+            # hit is tier-agnostic (either tier's answer satisfies the
+            # contract), and a reload of either tier invalidates
+            digest = cascade.params_digest() if cascade is not None \
+                else getattr(model, "params_digest", None)
             if digest is not None:
                 key = ResponseCache.key(
                     path, model.name, digest,
@@ -840,10 +922,14 @@ class _Handler(BaseHTTPRequestHandler):
             # during a canary window plane.infer may have routed this
             # request to the CANDIDATE — filing that answer under the
             # active version's digest would poison the cache, so
-            # inserts pause until the canary resolves
+            # inserts pause until the canary resolves (for a cascade:
+            # a canary on EITHER tier)
             plane = getattr(self.server, "plane", None)
-            if plane is None or not plane.canary_active(model.name):
-                cache.put(key, blob)
+            paused = cascade.canary_active() if cascade is not None \
+                else (plane is not None
+                      and plane.canary_active(model.name))
+            if not paused:
+                cache.put(key, blob, tier=self._tier)
         if qos is not None:
             qos.record_served(tenant, time.monotonic() - t0)
         return blob
@@ -902,6 +988,15 @@ class _Handler(BaseHTTPRequestHandler):
                 weighted[name] = round_mfu(mfu * occ)
         block["mfu_occupancy_weighted"] = weighted
         stats["batch"] = block
+
+    def _add_cascade_block(self, stats: dict) -> None:
+        """Attach the cascade router's reserved ``cascade`` stats block
+        (escalation counters, live threshold/agreement, per-tier
+        latency) when one is wired.  Like "edge"/"batch", the key is
+        reserved: no model may be named "cascade"."""
+        cascade = getattr(self.server, "cascade", None)
+        if cascade is not None:
+            stats["cascade"] = cascade.stats()
 
     def _job_results_ndjson(self, job_id: str):
         """The results stream body: one JSON line per completed item
@@ -1008,12 +1103,14 @@ class _Handler(BaseHTTPRequestHandler):
                     stats["deploy"] = deploy.stats()
                 stats.update(self._edge_blocks())
                 self._add_batch_block(stats)
+                self._add_cascade_block(stats)
                 self._reply(200, stats)
                 return
             stats = {name: eng.stats()
                      for name, eng in self.server.engines.items()}
             stats.update(self._edge_blocks())
             self._add_batch_block(stats)
+            self._add_cascade_block(stats)
             self._reply(200, stats)
         elif path == "/v1/models":
             if plane is not None:
@@ -1033,6 +1130,7 @@ class _Handler(BaseHTTPRequestHandler):
                          for name, eng in self.server.engines.items()}
             stats.update(self._edge_blocks())
             self._add_batch_block(stats)
+            self._add_cascade_block(stats)
             text = render_serve_metrics(stats)
             self._reply_raw(
                 200, text.encode(),
@@ -1099,12 +1197,18 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             body = self._body()
             self._cache_hit = False
+            self._tier = None
             blob = self._infer_route(path, body, path_model, debug)
             # X-DVT-Cache lets clients (and the trace bench) split
-            # hit/miss latency without a debug span per request
+            # hit/miss latency without a debug span per request;
+            # X-DVT-Tier reports which cascade tier answered
+            headers = {}
+            if self._cache_hit:
+                headers["X-DVT-Cache"] = "hit"
+            if self._tier is not None:
+                headers[TIER_HEADER] = self._tier
             self._reply_raw(200, blob, "application/json",
-                            headers={"X-DVT-Cache": "hit"}
-                            if self._cache_hit else None)
+                            headers=headers or None)
         except ServeError as e:
             self._reply(e.status, {"error": str(e)}, headers=e.headers)
         except TimeoutError:
@@ -1230,7 +1334,7 @@ class ServeServer:
                  tracer=None, plane=None, deploy=None, edge: bool = True,
                  max_connections: int = DEFAULT_MAX_CONNECTIONS,
                  http_workers: int = 8, response_cache=None, qos=None,
-                 jobs=None, batch_sched=None):
+                 jobs=None, batch_sched=None, cascade=None):
         if edge:
             self.httpd = EdgeServer((host, port), _Handler,
                                     max_connections=max_connections,
@@ -1259,6 +1363,10 @@ class ServeServer:
         # /v1/jobs and the trough-filling scheduler it kicks
         self.httpd.jobs = jobs
         self.httpd.batch_sched = batch_sched
+        # confidence-routed cascade (serve/cascade.py, None = off):
+        # requests naming its big model route front-first with
+        # calibrated escalation; needs the plane (both tiers live there)
+        self.httpd.cascade = cascade
         if tracer is None:
             # share the first engine's tracer so handler-created spans
             # land in the same ring /v1/traces reads

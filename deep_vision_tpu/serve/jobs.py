@@ -21,6 +21,12 @@ A shard whose record made it to disk is never re-executed and its
 results are never produced twice; a shard whose record was torn re-runs
 in full, so results land exactly once in the durable log either way.
 
+The ledger is also the result store: in memory each job keeps only a
+bounded LRU cache of completed shard payloads (``max_cached_shards``),
+and ``GET /v1/jobs/<id>/results`` streams evicted shards back from the
+JSONL file by byte offset — a million-image job's results never have
+to fit in RAM.
+
 Lock order: ``JobStore._lock`` is a leaf — file appends happen OUTSIDE
 it (one slow disk must not stall status polls), and no engine or
 scheduler lock is ever taken under it.
@@ -28,6 +34,7 @@ scheduler lock is ever taken under it.
 
 from __future__ import annotations
 
+import collections
 import json
 import math
 import os
@@ -43,13 +50,19 @@ class Job:
     """One bulk job: an immutable manifest plus mutable shard progress.
 
     ``manifest`` is frozen at submit time and never mutated, so the
-    scheduler may slice it without the store lock; ``results`` /
-    ``images_done`` / ``done`` / ``error`` are guarded by the owning
-    store's ``_lock``."""
+    scheduler may slice it without the store lock; the mutable fields
+    are guarded by the owning store's ``_lock``.
+
+    ``shards_done`` is the authoritative completion state (what the
+    scheduler and status views read); ``results`` is only a bounded
+    payload CACHE over the durable JSONL ledger — on a durable store
+    the store evicts least-recently-read shards past its
+    ``max_cached_shards`` cap and the results endpoint re-reads them
+    from disk (``JobStore.results_items``)."""
 
     __slots__ = ("job_id", "model", "verb", "manifest", "shard_size",
-                 "n_shards", "results", "images_done", "done", "error",
-                 "created_ts")
+                 "n_shards", "shards_done", "results", "pinned",
+                 "images_done", "done", "error", "created_ts")
 
     def __init__(self, job_id: str, model: str, verb: str,
                  manifest: list, shard_size: int,
@@ -61,7 +74,13 @@ class Job:
         self.shard_size = max(1, int(shard_size))
         self.n_shards = max(1, math.ceil(len(self.manifest)
                                          / self.shard_size))
-        self.results: dict[int, list] = {}  # guarded-by: JobStore._lock
+        self.shards_done: set[int] = set()  # guarded-by: JobStore._lock
+        # payload cache, insertion/access-ordered for LRU eviction
+        self.results: collections.OrderedDict[int, list] = \
+            collections.OrderedDict()  # guarded-by: JobStore._lock
+        # shards whose ledger append FAILED: memory is their only copy,
+        # so eviction must never touch them
+        self.pinned: set[int] = set()  # guarded-by: JobStore._lock
         self.images_done = 0  # guarded-by: JobStore._lock
         self.done = False  # guarded-by: JobStore._lock
         self.error: str | None = None  # guarded-by: JobStore._lock
@@ -78,7 +97,7 @@ class Job:
             return "failed"
         if self.done:
             return "done"
-        return "running" if self.results else "pending"
+        return "running" if self.shards_done else "pending"
 
     def _status_locked(self) -> dict:
         out = {"job_id": self.job_id, "model": self.model,
@@ -86,7 +105,7 @@ class Job:
                "n_items": len(self.manifest),
                "shard_size": self.shard_size,
                "n_shards": self.n_shards,
-               "shards_done": len(self.results),
+               "shards_done": len(self.shards_done),
                "images_done": self.images_done,
                "created_ts": round(self.created_ts, 3)}
         if self.error:
@@ -104,15 +123,23 @@ class JobStore:
     replays existing files so a restarted server picks unfinished jobs
     back up at their first missing shard."""
 
-    def __init__(self, root: str | None = None, *, shard_size: int = 32):
+    def __init__(self, root: str | None = None, *, shard_size: int = 32,
+                 max_cached_shards: int = 64):
         self.root = root
         self.default_shard_size = max(1, int(shard_size))
+        # per-job in-memory payload cache bound: with a durable root,
+        # completed shard payloads past this count spill to the JSONL
+        # ledger (LRU) and /v1/jobs/<id>/results streams them back from
+        # disk; 0 = unbounded.  Memory-only stores never evict — memory
+        # is the only copy
+        self.max_cached_shards = max(0, int(max_cached_shards))
         self._lock = new_lock("serve.jobs.JobStore._lock")
         self._jobs: dict[str, Job] = {}  # guarded-by: _lock
         self._order: list[str] = []  # FIFO scheduling order, guarded-by: _lock
         self.submitted = 0  # guarded-by: _lock
         self.resumed = 0  # jobs replayed unfinished, guarded-by: _lock
         self.replayed_shards = 0  # guarded-by: _lock
+        self.spilled_shards = 0  # payloads evicted to disk, guarded-by: _lock
         self.write_errors = 0  # guarded-by: _lock
         self.torn_lines = 0  # guarded-by: _lock
         if root:
@@ -126,20 +153,24 @@ class JobStore:
                        for c in job_id)
         return os.path.join(self.root, f"{safe}.jsonl")
 
-    def _append(self, job_id: str, record: dict) -> None:
+    def _append(self, job_id: str, record: dict) -> bool:
         # called OUTSIDE self._lock — one slow disk must not stall the
         # scheduler or a status poll; memory is already updated, and a
-        # lost append only means the shard re-runs after a restart
+        # lost append only means the shard re-runs after a restart.
+        # Returns whether the record is durable (False pins the shard's
+        # payload in memory — eviction must not drop the only copy)
         if not self.root:
-            return
+            return True
         line = json.dumps(record, default=str) + "\n"
         try:
             with open(self._path(job_id), "a", encoding="utf-8") as f:
                 f.write(line)
+            return True
         except OSError as e:
             with self._lock:
                 self.write_errors += 1
             event(_log, "job_write_error", job=job_id, error=str(e))
+            return False
 
     def _load(self) -> None:
         loaded: list[Job] = []
@@ -184,8 +215,12 @@ class JobStore:
                     res = rec.get("results")
                     if isinstance(idx, int) and isinstance(res, list) \
                             and 0 <= idx < job.n_shards \
-                            and idx not in job.results:
-                        job.results[idx] = res
+                            and idx not in job.shards_done:
+                        # completion state only: the payload already
+                        # lives in this very ledger, so replay leaves
+                        # the cache cold and results_items streams the
+                        # rows back from disk on demand
+                        job.shards_done.add(idx)
                         job.images_done += int(rec.get("images",
                                                        len(res)))
                         replayed += 1
@@ -209,7 +244,7 @@ class JobStore:
                     resumed.append(job)
         for job in resumed:
             event(_log, "job_resumed", job=job.job_id,
-                  model=job.model, shards_done=len(job.results),
+                  model=job.model, shards_done=len(job.shards_done),
                   n_shards=job.n_shards)
 
     # -- job API ------------------------------------------------------------
@@ -263,7 +298,7 @@ class JobStore:
                 if job.done:
                     continue
                 for i in range(job.n_shards):
-                    if i not in job.results:
+                    if i not in job.shards_done:
                         return job, i
         return None
 
@@ -275,15 +310,21 @@ class JobStore:
         replayed or double-run shard."""
         with self._lock:
             job = self._jobs[job_id]
-            if index in job.results or job.done:
+            if index in job.shards_done or job.done:
                 return False
+            job.shards_done.add(index)
             job.results[index] = list(results)
             job.images_done += int(images)
-            finished = len(job.results) == job.n_shards
-        self._append(job_id, {"kind": "shard", "job": job_id,
-                              "index": index, "images": int(images),
-                              "results": list(results),
-                              "ts": time.time()})
+            finished = len(job.shards_done) == job.n_shards
+        durable = self._append(job_id, {"kind": "shard", "job": job_id,
+                                        "index": index,
+                                        "images": int(images),
+                                        "results": list(results),
+                                        "ts": time.time()})
+        with self._lock:
+            if not durable:
+                job.pinned.add(index)
+            self._evict_locked(job)
         if finished:
             with self._lock:
                 job.done = True
@@ -306,20 +347,95 @@ class JobStore:
                               "reason": reason, "ts": time.time()})
         event(_log, "job_failed", job=job_id, reason=reason)
 
+    def _evict_locked(self, job: Job) -> None:
+        # guarded-by: _lock.  Spill least-recently-read payloads past
+        # the cache bound; only shards with a durable ledger record are
+        # eligible (memory-only stores and pinned shards keep theirs)
+        cap = self.max_cached_shards
+        if not self.root or cap <= 0:
+            return
+        for i in list(job.results):
+            if len(job.results) <= cap:
+                break
+            if i in job.pinned:
+                continue
+            del job.results[i]
+            self.spilled_shards += 1
+
+    def _shard_offsets(self, job_id: str, wanted: set) -> dict:
+        """One pass over the job's ledger → byte offset of each wanted
+        shard record, so streaming re-reads spilled payloads with one
+        seek apiece instead of holding the whole file in memory."""
+        offsets: dict[int, int] = {}
+        if not self.root or not wanted:
+            return offsets
+        try:
+            # manual tell/readline loop: line iteration disables tell()
+            with open(self._path(job_id), encoding="utf-8") as f:
+                pos = f.tell()
+                line = f.readline()
+                while line:
+                    if '"shard"' in line:
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            rec = None
+                        if isinstance(rec, dict) \
+                                and rec.get("kind") == "shard":
+                            idx = rec.get("index")
+                            if idx in wanted and idx not in offsets:
+                                offsets[idx] = pos
+                    pos = f.tell()
+                    line = f.readline()
+        except OSError:
+            return {}
+        return offsets
+
+    def _read_shard(self, job_id: str, offset: int) -> list | None:
+        try:
+            with open(self._path(job_id), encoding="utf-8") as f:
+                f.seek(offset)
+                rec = json.loads(f.readline())
+            res = rec.get("results")
+            return res if isinstance(res, list) else None
+        except (OSError, ValueError, AttributeError):
+            return None
+
     def results_items(self, job_id: str):
         """Completed results in manifest order — the contiguous shard
         prefix only, so a partially-drained job streams a stable,
         in-order, never-repeated prefix.  Yields ``(global_index,
-        result_dict)``."""
+        result_dict)``.
+
+        Cached shards stream from memory (refreshing their LRU slot);
+        spilled shards stream back from the JSONL ledger via a one-pass
+        byte-offset index + per-shard seek, so a bulk job's full result
+        set never has to fit in memory at once."""
         with self._lock:
             job = self._jobs[job_id]
-            prefix: list[list] = []
-            for i in range(job.n_shards):
-                if i not in job.results:
-                    break
-                prefix.append(job.results[i])
+            contiguous = 0
+            while contiguous in job.shards_done:
+                contiguous += 1
+            cached: dict[int, list] = {}
+            for i in list(job.results):
+                if i < contiguous:
+                    cached[i] = job.results[i]
+                    job.results.move_to_end(i)  # reading = recent use
+        missing = set(range(contiguous)) - set(cached)
+        offsets = self._shard_offsets(job_id, missing)
         idx = 0
-        for shard in prefix:
+        for i in range(contiguous):
+            shard = cached.get(i)
+            if shard is None:
+                off = offsets.get(i)
+                shard = self._read_shard(job_id, off) \
+                    if off is not None else None
+            if shard is None:
+                # spilled payload unreadable (ledger pruned/corrupt):
+                # end the stable prefix here rather than renumber the
+                # rows after a gap
+                event(_log, "job_results_gap", job=job_id, shard=i)
+                break
             for item in shard:
                 yield idx, item
                 idx += 1
@@ -335,6 +451,9 @@ class JobStore:
                     "submitted": self.submitted,
                     "resumed": self.resumed,
                     "replayed_shards": self.replayed_shards,
+                    "spilled_shards": self.spilled_shards,
+                    "cached_shards": sum(len(j.results)
+                                         for j in self._jobs.values()),
                     "images_done": images,
                     "write_errors": self.write_errors,
                     "torn_lines": self.torn_lines,

@@ -346,6 +346,80 @@ class ModelVersion:
         return d
 
 
+class AgreementHistogram:
+    """Front-vs-big top-1 agreement per front-confidence bucket — the
+    cascade calibration sample (serve/cascade.py).
+
+    Fixed bins over [0, 1): sample i lands in
+    ``floor(conf * bins)`` and records whether the front tier's top-1
+    matched the big tier's.  ``threshold()`` answers the calibration
+    question: the smallest confidence at which routing everything
+    at-or-above it to the front tier still clears the operator's
+    agreement floor — computed from suffix sums, so it is exactly "the
+    measured agreement of the traffic the front tier would answer".
+    Deterministic for a given sample sequence (no RNG anywhere), which
+    is what makes calibration testable with a seeded sample."""
+
+    def __init__(self, bins: int = 20):
+        self.bins = max(1, int(bins))
+        self._lock = new_lock("serve.models.AgreementHistogram._lock")
+        self._total = [0] * self.bins  # guarded-by: _lock
+        self._agree = [0] * self.bins  # guarded-by: _lock
+
+    def record(self, confidence: float, agreed: bool):
+        conf = min(max(float(confidence), 0.0), 1.0)
+        i = min(int(conf * self.bins), self.bins - 1)
+        with self._lock:
+            self._total[i] += 1
+            if agreed:
+                self._agree[i] += 1
+
+    def reset(self):
+        with self._lock:
+            self._total = [0] * self.bins
+            self._agree = [0] * self.bins
+
+    def threshold(self, min_agreement: float,
+                  min_sample: int) -> float | None:
+        """Smallest bin lower-edge t where the agreement of all samples
+        with confidence >= t clears ``min_agreement`` — or None (fail
+        closed: all traffic to the big tier) when the whole sample is
+        thinner than ``min_sample`` or no suffix clears the floor.
+
+        The edge must sit on a POPULATED bin: empty bins below the
+        lowest qualifying sample never extend the threshold downward,
+        so confidence levels the sample has not observed escalate
+        instead of riding an extrapolated threshold (conservative in
+        the cheap direction — an extra big-tier answer costs
+        throughput, never correctness)."""
+        with self._lock:
+            total = list(self._total)
+            agree = list(self._agree)
+        if sum(total) < max(1, int(min_sample)):
+            return None
+        suf_t = suf_a = 0
+        best = None
+        # walk top bin down so each step extends the suffix by one bin;
+        # the LAST qualifying populated edge is the smallest qualifying t
+        for i in range(self.bins - 1, -1, -1):
+            suf_t += total[i]
+            suf_a += agree[i]
+            if total[i] > 0 and suf_a / suf_t >= float(min_agreement):
+                best = i / self.bins
+        return best
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = list(self._total)
+            agree = list(self._agree)
+        n = sum(total)
+        return {"bins": self.bins,
+                "samples": n,
+                "agreement": (sum(agree) / n) if n else None,
+                "total": total,
+                "agree": agree}
+
+
 class ModelControlPlane:
     """Versioned model table + reload/canary lifecycle over N engines.
 
@@ -388,6 +462,10 @@ class ModelControlPlane:
         self._counter: dict[str, int] = {}  # guarded-by: _lock
         self._reloading: dict[str, threading.Thread] = {}  # guarded-by: _lock
         self._admissions: dict = {}  # name → controller; guarded-by: _lock
+        # fns called as fn(name) after a version swap (deploy/promote/
+        # rollback/revert) — the cascade recalibration hook; guarded-by:
+        # _lock for mutation, snapshotted before firing
+        self._version_listeners: list = []  # guarded-by: _lock
         self._lock = new_lock("serve.models.ModelControlPlane._lock")
         self._stopping = threading.Event()
         self.reloads = 0  # guarded-by: _lock
@@ -410,6 +488,27 @@ class ModelControlPlane:
                 adm = self._admissions[name] = \
                     self.admission_factory(name)
             return adm
+
+    def add_version_listener(self, fn):
+        """Register ``fn(name)`` to fire after any version swap of
+        ``name`` (deploy, promote — and through promote, revert).  The
+        cascade router hooks this to drop its calibration the instant a
+        tier's weights change: a new checkpoint shifts the confidence
+        distribution, so the old threshold is invalid."""
+        with self._lock:
+            self._version_listeners.append(fn)
+
+    def _fire_version_listeners(self, name: str):
+        # snapshot then call OUTSIDE _lock: listeners may call back
+        # into the plane (resolve, canary_active) and _lock is a leaf
+        with self._lock:
+            listeners = list(self._version_listeners)
+        for fn in listeners:
+            try:
+                fn(name)
+            except Exception as e:  # noqa: BLE001 — a listener must not break a deploy
+                event(_log, "version_listener_error", model=name,
+                      error=f"{type(e).__name__}: {e}")
 
     def deploy(self, model, *, workdir: str | None = None,
                start: bool = True) -> ModelVersion:
@@ -445,6 +544,7 @@ class ModelControlPlane:
             mv.was_active = True
         if old is not None:
             self._retire(old, reason="replaced by deploy")
+        self._fire_version_listeners(model.name)
         event(_log, "deploy", model=model.name, version=mv.version,
               step=model.restored_step)
         return mv
@@ -728,6 +828,10 @@ class ModelControlPlane:
             calib_batches=getattr(old, "calib_batches", 2),
             calib_dir=getattr(old, "calib_dir", None),
             ingest=getattr(old, "ingest", "pallas"))
+        # cascade front tiers keep their fused confidence epilogue
+        # across reloads (workloads.ClassifyWorkload.make_epilogue
+        # gates on this attribute at bucket-compile time)
+        sm.cascade_topk = getattr(old, "cascade_topk", 0)
         sm.restored_step = info.get("step")
         sm.restore_fallback = bool(info.get("fallback"))
         sm.restored_mtime = info.get("mtime")
@@ -920,6 +1024,7 @@ class ModelControlPlane:
                 if pair is not None and pair[0] is mv:
                     routes.pop(name)
         self.registry.add(mv.model, version=mv.version)
+        self._fire_version_listeners(name)
         event(_log, "promote", model=name, version=mv.version,
               step=mv.model.restored_step)
         if old is not None and old is not mv:

@@ -125,6 +125,12 @@ class ServingModel:
         # version number under the control plane's versioned model
         # table (serve/models.py); None outside plane-managed serving
         self.serve_version: int | None = None
+        # cascade front-tier knob (serve/cascade.py): K > 0 makes the
+        # classify workload fuse a softmax+top-K confidence epilogue
+        # into this model's bucket programs, so the cascade router
+        # reads (top1_class, top1_prob) off the bulk D2H instead of
+        # dense logits.  0 = plain dense-logits serving.
+        self.cascade_topk: int = 0
 
     def compile_bucket(self, batch: int):
         raise NotImplementedError
@@ -680,7 +686,8 @@ class ModelRegistry:
                         infer_dtype: str = "float32",
                         calib_batches: int = 2,
                         calib_dir: str | None = None,
-                        ingest: str = "pallas") -> ServingModel:
+                        ingest: str = "pallas",
+                        cascade_topk: int = 0) -> ServingModel:
         """``wire_dtype``: what clients ship and the engine H2D-transfers
         — "uint8" (raw 0–255 pixels, normalization fused into the bucket
         programs; the ``cli.serve`` default) or "float32" (the original
@@ -691,7 +698,10 @@ class ModelRegistry:
         (serve/quant.py) — ``calib_batches`` held-out batches from
         ``calib_dir`` (deterministic synthetic data when None) calibrate
         the activation scales, and ``ingest`` picks the fused Pallas
-        serve-prologue ("pallas", the default) or the XLA fallback."""
+        serve-prologue ("pallas", the default) or the XLA fallback.
+        ``cascade_topk`` > 0 marks a cascade FRONT tier: the classify
+        workload fuses its confidence epilogue (softmax + top-K on
+        device) into the bucket programs (serve/cascade.py)."""
         from deep_vision_tpu.core.config import get_config
         from deep_vision_tpu.core.restore import load_state
 
@@ -704,6 +714,7 @@ class ModelRegistry:
                                     calib_batches=calib_batches,
                                     calib_dir=calib_dir,
                                     ingest=ingest)
+        sm.cascade_topk = int(cascade_topk)
         sm.restored_step = info.get("step")
         sm.restore_fallback = bool(info.get("fallback"))
         sm.restored_mtime = info.get("mtime")

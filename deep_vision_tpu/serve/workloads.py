@@ -169,9 +169,73 @@ class ClassifyWorkload(Workload):
     verb = "classify"
     slo = SLO("interactive", deadline_ms=30_000.0, max_queue=256)
 
+    def make_epilogue(self, model):
+        """Confidence reduction fused on DEVICE for cascade front
+        tiers: softmax + top-K in the bucket program, so the bulk D2H
+        moves 3·K scalars per image instead of the dense logits and the
+        cascade router's escalation decision reads ``topk_prob[0]`` /
+        ``topk_class[0]`` off the already-fetched row.  Gated on the
+        model's ``cascade_topk`` attribute (set by cli.serve for the
+        front tier only; copied across reloads by models._load_model),
+        so plain classify serving keeps its dense-logits rows and
+        escalated answers stay bit-identical to big-only serving."""
+        k = int(getattr(model, "cascade_topk", 0) or 0)
+        if k <= 0:
+            return None
+        import jax
+        import jax.numpy as jnp
+
+        def post(out):  # dvtlint: traced
+            logits = out
+            kk = min(k, logits.shape[-1])
+            probs = jax.nn.softmax(logits, axis=-1)
+            top_p, top_i = jax.lax.top_k(probs, kk)
+            top_l = jnp.take_along_axis(logits, top_i, axis=-1)
+            return {"topk_class": top_i.astype(jnp.int32),
+                    "topk_prob": top_p.astype(jnp.float32),
+                    "topk_logit": top_l.astype(jnp.float32)}
+
+        return post
+
+    @staticmethod
+    def top1(row):
+        """``(class, prob)`` of a classify row — dense logits OR the
+        confidence-epilogue dict — or ``(None, None)`` for rows with no
+        top-1 (Shed/Quarantined, foreign shapes).  The one place that
+        knows both row shapes; the cascade router and ``agree`` both
+        route through it so the two shapes always compare."""
+        import numpy as np
+
+        if isinstance(row, dict):
+            try:
+                cls = np.asarray(row["topk_class"]).reshape(-1)
+                prob = np.asarray(row["topk_prob"]).reshape(-1)
+            except (KeyError, TypeError, ValueError):
+                return None, None
+            if cls.size == 0 or prob.size == 0:
+                return None, None
+            return int(cls[0]), float(prob[0])
+        if isinstance(row, np.ndarray) and row.ndim >= 1 and row.size:
+            logits = row.astype(np.float64)
+            z = np.exp(logits - logits.max())
+            c = int(np.argmax(logits))
+            return c, float(z[c] / z.sum())
+        return None, None
+
     def respond(self, model, body: dict, row) -> dict:
         import numpy as np
 
+        if isinstance(row, dict):
+            # confidence-epilogue row: top-K already reduced on device
+            cls = np.asarray(row["topk_class"]).reshape(-1)
+            prob = np.asarray(row["topk_prob"]).reshape(-1)
+            logit = np.asarray(row["topk_logit"]).reshape(-1)
+            k = min(int(body.get("top_k", 5)), cls.shape[0])
+            return {"model": model.name,
+                    "top": [{"class": int(cls[j]),
+                             "prob": float(prob[j]),
+                             "logit": float(logit[j])}
+                            for j in range(k)]}
         logits = np.asarray(row)
         k = min(int(body.get("top_k", 5)), logits.shape[-1])
         top = np.argsort(logits)[-k:][::-1]
@@ -182,15 +246,11 @@ class ClassifyWorkload(Workload):
                          "logit": float(logits[c])} for c in top]}
 
     def agree(self, primary_row, shadow_row):
-        import numpy as np
-
-        comparable = (isinstance(primary_row, np.ndarray)
-                      and isinstance(shadow_row, np.ndarray)
-                      and primary_row.shape == shadow_row.shape
-                      and primary_row.ndim >= 1)
-        if not comparable:
+        p, _ = self.top1(primary_row)
+        s, _ = self.top1(shadow_row)
+        if p is None or s is None:
             return None
-        return int(np.argmax(primary_row)) == int(np.argmax(shadow_row))
+        return p == s
 
 
 class DetectWorkload(Workload):

@@ -8,7 +8,7 @@ from deep_vision_tpu.core.config import (
     TrainConfig,
     register_config,
 )
-from deep_vision_tpu.models.lenet import LeNet5
+from deep_vision_tpu.models.lenet import LeNet5, LeNet5Big
 
 
 @register_config("lenet5")
@@ -23,6 +23,28 @@ def lenet5() -> TrainConfig:
         scheduler=SchedulerConfig(
             name="plateau", kwargs=dict(mode="max", factor=0.1, patience=10)),
         half_precision=False,  # MNIST-scale; f32 is fine
+        image_size=32,
+        channels=1,
+        num_classes=10,
+    )
+
+
+@register_config("lenet5_big")
+def lenet5_big() -> TrainConfig:
+    """The cascade's BIG tier opposite lenet5: identical wire contract
+    (32×32×1, 10 classes) at ~50× the compute — the cheap-front /
+    heavy-big pair ``bench.py --serve-cascade`` and the cascade smoke
+    serve behind one plane (serve/cascade.py)."""
+    return TrainConfig(
+        name="lenet5_big",
+        model=lambda: LeNet5Big(),
+        task="classification",
+        batch_size=64,
+        total_epochs=50,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        scheduler=SchedulerConfig(
+            name="plateau", kwargs=dict(mode="max", factor=0.1, patience=10)),
+        half_precision=False,
         image_size=32,
         channels=1,
         num_classes=10,

@@ -1,0 +1,221 @@
+"""`make cascade-smoke`: the confidence-routed cascade end to end
+through the real CLI wiring (cli.serve.build_server with --models
+lenet5,lenet5_big --cascade lenet5:lenet5_big) on a random port, with
+an injected transient compute fault.  Clients address the BIG model;
+the smoke hammers it from threads while asserting: fail-closed all-big
+service before calibration, live dual-run calibration flipping the
+router to the front tier (X-DVT-Tier header), an always-big QoS tenant
+(X-DVT-Tenant) never leaving the big tier, a mid-load front-tier
+reload resetting and then RE-calibrating the threshold with zero
+client errors, and every /metrics line parsing as prometheus text with
+the dvt_cascade_* series present (docs/SERVING.md "Cascaded serving").
+Run directly, not under pytest; chained into `make serve-smoke`."""
+
+import argparse
+import json
+import os
+import re
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+import numpy as np
+
+# plain script (not pytest): make the repo root importable when invoked
+# as `python tests/cascade_smoke.py` from the checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FRONT, BIG = "lenet5", "lenet5_big"
+
+# prometheus text exposition: `name{labels} value` / `# HELP|TYPE ...`
+_METRIC_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [^ ]+$")
+
+
+def _args(workdir: str) -> argparse.Namespace:
+    return argparse.Namespace(
+        model=None, models=f"{FRONT},{BIG}", workdir=workdir,
+        stablehlo=None, host="127.0.0.1", port=0, max_batch=4,
+        max_wait_ms=2.0, buckets=None, max_queue=64, warmup=True,
+        verbose=False, pipeline_depth=2,
+        # one transient compute failure: the cascade must ride the
+        # engine's bisect-retry without surfacing a client error
+        faults="compute:exception:times=1", fault_seed=0,
+        serve_devices=1, shard_batches=False, wire_dtype="float32",
+        infer_dtype="float32",
+        # random-init tiers rarely agree, so the smoke calibrates on
+        # machinery, not quality: ANY observed agreement qualifies
+        cascade=f"{FRONT}:{BIG}", cascade_min_agreement=0.0,
+        cascade_sample_period=3, cascade_min_sample=10, cascade_topk=3,
+        # fast canary so the mid-load reload promotes in seconds
+        hbm_budget_mb=0.0, canary_frac=0.5, canary_min_requests=3,
+        canary_max_error_rate=1.0, canary_max_p99_ratio=50.0,
+        shadow_frac=0.0, phase_timeout_s=60.0,
+        qos=("premium:rate=0,always_big=1,tenants=acme;"
+             "standard:rate=0;default=standard"))
+
+
+def _get(base: str, path: str):
+    with urllib.request.urlopen(base + path, timeout=60) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(base: str, path: str, payload: dict, headers: dict = None):
+    hdrs = {"Content-Type": "application/json"}
+    hdrs.update(headers or {})
+    req = urllib.request.Request(
+        base + path, data=json.dumps(payload).encode(), headers=hdrs)
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return r.status, json.loads(r.read()), dict(r.headers)
+
+
+def _cascade_stats(base: str) -> dict:
+    _, stats = _get(base, "/v1/stats")
+    assert "cascade" in stats, sorted(stats)
+    return stats["cascade"]
+
+
+def _wait_for(what: str, predicate, deadline_s: float = 60.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        out = predicate()
+        if out is not None:
+            return out
+        time.sleep(0.05)
+    raise AssertionError(f"timed out after {deadline_s}s waiting for {what}")
+
+
+def smoke(workdir: str) -> None:
+    from deep_vision_tpu.cli.serve import build_server
+
+    plane, server = build_server(_args(workdir))
+    server.start_background()
+    base = f"http://{server.host}:{server.port}"
+    rng = np.random.default_rng(0)
+    imgs = [rng.uniform(0.0, 1.0, (32, 32, 1)).tolist()
+            for _ in range(8)]
+    try:
+        # -- fail closed: uncalibrated router serves everything big ---
+        cas = _cascade_stats(base)
+        assert cas["calibrated"] is False and cas["threshold"] is None, cas
+        s, out, hdrs = _post(base, f"/v1/models/{BIG}/classify",
+                             {"pixels": imgs[0]})
+        assert s == 200 and out["top"], out
+        assert hdrs.get("X-DVT-Tier") == "big", hdrs
+
+        # -- hammer the big model's route; every failure is a bug -----
+        errors, served, tiers = [], [0], {"front": 0, "big": 0}
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    s, out, hdrs = _post(
+                        base, f"/v1/models/{BIG}/classify",
+                        {"pixels": imgs[i % len(imgs)]})
+                    assert s == 200 and out["top"], out
+                    tier = hdrs.get("X-DVT-Tier")
+                    assert tier in ("front", "big"), hdrs
+                    with lock:
+                        served[0] += 1
+                        tiers[tier] += 1
+                except Exception as e:  # noqa: BLE001 — any failure is a lost request
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+
+        # dual-run sampling calibrates the threshold under live load
+        cas = _wait_for(
+            "threshold calibration from dual-run samples",
+            lambda: (lambda c: c if c["calibrated"] else None)(
+                _cascade_stats(base)))
+        assert cas["samples"] >= 10 and cas["calibrations"] >= 1, cas
+        # min_agreement=0 calibrates at the lowest POPULATED bin, so
+        # the front tier now answers confident traffic directly
+        _wait_for("front tier serving past calibration",
+                  lambda: tiers["front"] or None)
+
+        # -- always-big tenant: premium QoS never sees the front ------
+        for _ in range(5):
+            s, out, hdrs = _post(base, f"/v1/models/{BIG}/classify",
+                                 {"pixels": imgs[0]},
+                                 headers={"X-DVT-Tenant": "acme"})
+            assert s == 200 and hdrs.get("X-DVT-Tier") == "big", hdrs
+        cas = _cascade_stats(base)
+        assert cas["forced_big"] >= 5, cas
+
+        # the FRONT tier still answers its own direct route, epilogue
+        # and all (dict rows respond identically to dense ones)
+        s, out, hdrs = _post(base, f"/v1/models/{FRONT}/classify",
+                             {"pixels": imgs[0]})
+        assert s == 200 and out["top"], out
+        assert "X-DVT-Tier" not in hdrs, hdrs  # cascade serves BIG only
+
+        # -- mid-load front-tier reload: reset, then REcalibrate ------
+        resets_before = cas["resets"]
+        errors_before = len(errors)
+        s, out, _ = _post(base, f"/v1/models/{FRONT}/reload",
+                          {"force": True, "wait": True})
+        assert s == 200, out
+        cas = _wait_for(
+            "cascade reset after front reload",
+            lambda: (lambda c: c if c["resets"] > resets_before
+                     else None)(_cascade_stats(base)))
+        cas = _wait_for(
+            "recalibration after front reload",
+            lambda: (lambda c: c
+                     if c["calibrated"] and c["calibrations"] >= 2
+                     else None)(_cascade_stats(base)))
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(errors) == errors_before == 0, errors[:5]
+        assert served[0] > 0 and tiers["front"] > 0, (served, tiers)
+
+        # -- /metrics: every line parses; cascade series present ------
+        with urllib.request.urlopen(base + "/metrics", timeout=60) as r:
+            text = r.read().decode()
+        for ln in text.splitlines():
+            if not ln or ln.startswith("#"):
+                continue
+            assert _METRIC_LINE.match(ln), f"unparseable metric: {ln!r}"
+            float(ln.rsplit(" ", 1)[1])  # value must be a number
+        for series in ("dvt_cascade_requests_total",
+                       "dvt_cascade_escalations_total",
+                       "dvt_cascade_threshold",
+                       "dvt_cascade_calibrated",
+                       "dvt_cascade_calibration_samples_total",
+                       "dvt_cascade_forced_big_total",
+                       "dvt_cascade_recalibrations_total",
+                       "dvt_cascade_latency_seconds"):
+            assert series in text, f"missing {series} in /metrics"
+        print(f"cascade-smoke PASS: {served[0]} requests "
+              f"(front {tiers['front']}, big {tiers['big']}), 0 errors "
+              f"through a fault-injected mid-load front reload; "
+              f"threshold {cas['threshold']:.2f} recalibrated "
+              f"({cas['calibrations']} calibrations, {cas['resets']} "
+              f"resets); always-big tenant pinned to the big tier; "
+              f"all /metrics lines parsed from port {server.port}")
+    finally:
+        server.shutdown()
+        plane.stop(drain_deadline=5.0)
+
+
+def main():
+    with tempfile.TemporaryDirectory() as workdir:
+        for name in (FRONT, BIG):
+            os.makedirs(os.path.join(workdir, name), exist_ok=True)
+        smoke(workdir)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -122,10 +122,44 @@ def test_jobstore_restart_replay_and_torn_tail(tmp_path):
     assert s3.status(jid)["state"] == "done"
 
     # restart #2: terminal state replays; nothing resumes, nothing
-    # re-emits — indices come back exactly once, in manifest order
+    # re-emits — indices come back exactly once, in manifest order.
+    # Replay leaves the payload cache COLD (the rows are already in the
+    # ledger), so this also exercises the disk-streaming read path
     s4 = JobStore(root)
     assert s4.resumed == 0 and s4.next_shard() is None
+    assert s4.stats()["cached_shards"] == 0
     assert [i for i, _ in s4.results_items(jid)] == list(range(6))
+
+
+def test_jobstore_results_spill_to_ledger(tmp_path):
+    """Bounded payload cache: completed shards past ``max_cached_shards``
+    evict (LRU) and the results endpoint streams them back from the
+    JSONL ledger — rows identical and in order, memory O(cap)."""
+    store = JobStore(str(tmp_path / "jobs"), shard_size=2,
+                     max_cached_shards=1)
+    jid = store.submit("m", "classify",
+                       [{"x": i} for i in range(8)])["job_id"]
+    for s in range(4):
+        store.record_shard(jid, s, [{"y": 2 * s}, {"y": 2 * s + 1}], 2)
+    st = store.stats()
+    assert st["spilled_shards"] == 3 and st["cached_shards"] == 1
+    assert store.status(jid)["state"] == "done"  # spilling ≠ progress loss
+    rows = list(store.results_items(jid))
+    assert [i for i, _ in rows] == list(range(8))
+    assert [r["y"] for _, r in rows] == list(range(8))
+    # second read: identical (the ledger is the authority, the cache is
+    # only an optimization)
+    assert list(store.results_items(jid)) == rows
+
+    # memory-only stores never evict — memory is the only copy
+    mem = JobStore(shard_size=2, max_cached_shards=1)
+    jid2 = mem.submit("m", "classify",
+                      [{"x": i} for i in range(8)])["job_id"]
+    for s in range(4):
+        mem.record_shard(jid2, s, [{"y": 2 * s}, {"y": 2 * s + 1}], 2)
+    assert mem.stats()["spilled_shards"] == 0
+    assert mem.stats()["cached_shards"] == 4
+    assert [r["y"] for _, r in mem.results_items(jid2)] == list(range(8))
 
 
 # -- scheduler: priority band, retries, terminal failures ------------------
