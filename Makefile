@@ -52,6 +52,7 @@ serve-smoke: lint lint-test
 	$(PY) tests/workload_smoke.py
 	$(PY) tests/batch_smoke.py
 	$(PY) tests/cascade_smoke.py
+	$(PY) tests/brownout_smoke.py
 
 # the async HTTP edge end to end over real sockets: keep-alive reuse
 # visible in the connection counters, a content-addressed cache hit

@@ -1635,6 +1635,18 @@ def bench_gateway(model_name: str = "lenet5", loads: tuple = (1, 8),
                                 break
                             if r.status != 429:
                                 raise RuntimeError(f"HTTP {r.status}")
+                            # cooperative retry budget: the gateway
+                            # reports its remaining per-backend retry
+                            # tokens on every response — when IT is out
+                            # of budget, the client stops adding its
+                            # own retries on top, so the two layers
+                            # never jointly multiply offered load
+                            # (docs/SERVING.md "Retry budgets")
+                            budget = r.headers.get("X-DVT-Retry-Budget")
+                            if budget is not None \
+                                    and float(budget) < 1.0:
+                                raise RuntimeError(
+                                    "429 with retry budget exhausted")
                             local_retry += 1
                             ra = float(r.headers.get(
                                 "Retry-After") or 1)

@@ -8,6 +8,8 @@ Static rules (see docs/ANALYSIS.md for the catalog and annotation guide):
   DVT004  Python side effect inside jit-traced / AOT-lowered code
   DVT005  elapsed interval computed from ``time.time()`` (wall clock)
   DVT006  broad except without a ``# noqa: BLE001 — <reason>`` justification
+  DVT007  blocking call with no timeout (zero-arg ``.get()``/``.wait()``/
+          ``.join()``, timeout-less connection dial)
 
 Run with ``python -m deep_vision_tpu.analysis --strict`` (what ``make lint``
 does), or programmatically via :func:`run_paths`. The runtime half lives in
@@ -19,6 +21,7 @@ This package is stdlib-only by design — importing it (e.g. for
 
 from .framework import Finding, Report, run_paths
 
-RULE_CODES = ("DVT001", "DVT002", "DVT003", "DVT004", "DVT005", "DVT006")
+RULE_CODES = ("DVT001", "DVT002", "DVT003", "DVT004", "DVT005", "DVT006",
+              "DVT007")
 
 __all__ = ["Finding", "Report", "run_paths", "RULE_CODES"]

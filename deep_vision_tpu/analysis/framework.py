@@ -275,6 +275,7 @@ def run_paths(paths, root=None) -> Report:
         rules_jax.check_dvt004,
         rules_hygiene.check_dvt005,
         rules_hygiene.check_dvt006,
+        rules_hygiene.check_dvt007,
     )
     raw: list[tuple[Finding, FileContext, ast.AST]] = []
     for ctx in contexts:

@@ -1,4 +1,5 @@
-"""DVT005 (wall-clock durations) and DVT006 (broad-except hygiene).
+"""DVT005 (wall-clock durations), DVT006 (broad-except hygiene), and
+DVT007 (unbounded blocking calls).
 
 DVT005: ``time.time()`` is the wall clock — NTP can step it backwards, so
 any *interval* computed from it (EWMAs, deadlines, histograms) is wrong by
@@ -11,6 +12,17 @@ DVT006: ``except Exception`` / bare ``except`` / ``except BaseException``
 must carry the repo's justification convention on the same line:
 ``# noqa: BLE001 — <reason>``. A bare ``# noqa: BLE001`` with no reason is
 also a finding — the reason is the point.
+
+DVT007: a zero-argument ``.get()`` / ``.wait()`` / ``.join()`` blocks its
+thread FOREVER when the peer stalls — the exact failure mode the serving
+watchdogs, drain deadlines, and gateway blackhole faults exist to bound.
+(``dict.get`` always takes a key, so a zero-arg ``.get()`` can only be a
+queue/future.)  Connection constructors (``HTTPConnection``,
+``socket.create_connection``) without a ``timeout`` are the same bug one
+layer down: a black-holed dial pins the thread at connect.  Deliberate
+forever-blocks (process shutdown joins, ``Pool.join`` which has no
+timeout parameter) annotate ``# dvtlint: disable=DVT007`` with a reason
+comment.
 """
 
 from __future__ import annotations
@@ -97,4 +109,49 @@ def check_dvt006(ctx):
             msg = (f"{what} without justification — narrow it or annotate "
                    "`# noqa: BLE001 — <reason>` on the except line")
         out.append((Finding("DVT006", ctx.rel, node.lineno, msg), ctx, node))
+    return out
+
+
+# attribute-call methods that block forever when called with no arguments
+# (queue.Queue.get, AsyncResult.get, Event/Condition.wait, Thread.join,
+# Popen.wait — never dict.get or str.join, which require a positional)
+_BLOCKING_METHODS = {"get", "wait", "join"}
+# dial calls -> the positional index their timeout parameter occupies
+_DIAL_CALLS = {"HTTPConnection": 2, "HTTPSConnection": 2,
+               "create_connection": 1}
+
+
+def check_dvt007(ctx):
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kwargs = {kw.arg for kw in node.keywords}
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _BLOCKING_METHODS \
+                and not node.args and "timeout" not in kwargs:
+            out.append((
+                Finding(
+                    "DVT007", ctx.rel, node.lineno,
+                    f"{node.func.attr}() with no timeout blocks this "
+                    "thread forever if the peer stalls — pass timeout= "
+                    "(deliberate forever-blocks annotate "
+                    "`# dvtlint: disable=DVT007` with the reason)",
+                ),
+                ctx, node,
+            ))
+            continue
+        chain = attr_chain(node.func)
+        name = chain.rsplit(".", 1)[-1] if chain else None
+        if name in _DIAL_CALLS and "timeout" not in kwargs \
+                and len(node.args) <= _DIAL_CALLS[name]:
+            out.append((
+                Finding(
+                    "DVT007", ctx.rel, node.lineno,
+                    f"{name}(...) without a connect timeout — a "
+                    "black-holed peer pins this thread at dial; "
+                    "pass timeout=",
+                ),
+                ctx, node,
+            ))
     return out

@@ -31,11 +31,15 @@ def build_gateway(args):
     """argparse namespace → (Gateway, GatewayServer); shared with
     ``tests/gateway_smoke.py`` so the smoke boots production wiring."""
     from deep_vision_tpu.obs.trace import Tracer
+    from deep_vision_tpu.serve.faults import FaultPlane
     from deep_vision_tpu.serve.gateway import Gateway, GatewayServer
 
     tracer = Tracer(ring=getattr(args, "trace_ring", 256),
                     slow_ms=getattr(args, "slow_trace_ms", 250.0),
                     enabled=not getattr(args, "no_trace", False))
+    fault_spec = getattr(args, "faults", None)
+    faults = FaultPlane(fault_spec, getattr(args, "fault_seed", 0)) \
+        if fault_spec else None
     gw = Gateway(
         list(args.backend),
         tracer=tracer,
@@ -51,7 +55,10 @@ def build_gateway(args):
         dead_after=getattr(args, "dead_after", 5),
         hedge=getattr(args, "hedge", False),
         hedge_after_ms=getattr(args, "hedge_after_ms", None),
-        affinity=getattr(args, "affinity", False))
+        affinity=getattr(args, "affinity", False),
+        retry_budget_ratio=getattr(args, "retry_budget_ratio", 0.1),
+        retry_budget_burst=getattr(args, "retry_budget_burst", 10.0),
+        faults=faults)
     gw.start()
     socket_timeout_s = getattr(args, "socket_timeout_s", 30.0)
     server = GatewayServer(
@@ -87,6 +94,19 @@ def main(argv=None):
                    help="extra attempts per request after the first "
                         "(connect error / timeout / 5xx → retry on a "
                         "different backend when one is routable)")
+    p.add_argument("--retry-budget-ratio", type=float, default=0.1,
+                   help="per-backend retry BUDGET refill: each real "
+                        "success adds this many retry tokens (capped "
+                        "at --retry-budget-burst), each retried "
+                        "attempt spends one — bounds the steady-state "
+                        "retry RATIO, so a dying fleet sees at most "
+                        "~ratio extra load instead of a retry storm "
+                        "multiplying it (--retry-budget still caps "
+                        "attempts per request)")
+    p.add_argument("--retry-budget-burst", type=float, default=10.0,
+                   help="retry-token bucket depth per backend (also "
+                        "the boot balance, so cold-start blips can "
+                        "retry before any success has refilled)")
     p.add_argument("--backoff-ms", type=float, default=10.0,
                    help="base retry backoff; doubles per attempt with "
                         "full jitter, capped at --backoff-max-ms")
@@ -127,6 +147,17 @@ def main(argv=None):
                         "disables); same slow-loris guard as the "
                         "backends")
     p.add_argument("--verbose", action="store_true")
+    # -- chaos (docs/SERVING.md "Failure model & operations") --
+    p.add_argument("--faults", default=None,
+                   help="deterministic gateway-hop fault spec, e.g. "
+                        "'gateway:conn_reset:p=0.3' or "
+                        "'gateway:blackhole:hang_s=2:times=1' — "
+                        "injects NETWORK failures (conn_reset / "
+                        "slow_drip / blackhole) into the gateway's "
+                        "per-attempt backend calls so the breaker and "
+                        "retry budget exercise their tested paths")
+    p.add_argument("--fault-seed", type=int, default=0,
+                   help="seed for probabilistic (p=) fault firing")
     # -- observability (docs/OBSERVABILITY.md) --
     p.add_argument("--log-level", default="info",
                    choices=("debug", "info", "warning", "error"),
@@ -151,10 +182,15 @@ def main(argv=None):
           f"-> {len(gw.backends)} backend(s), "
           f"routable now: {health['routable'] or 'NONE'}")
     print(f"[gateway] retry_budget={gw.retry_budget} "
+          f"retry_ratio={gw.retry_budget_ratio:g}"
+          f"(burst {gw.retry_budget_burst:g}) "
           f"probe_interval={gw.probe_interval_s * 1e3:.0f}ms "
           f"breaker={gw.backends[0].breaker_threshold}"
           f"/{gw.backends[0].breaker_cooldown_s}s "
           f"hedge={'on' if gw.hedge else 'off'}")
+    if gw.faults is not None and gw.faults.enabled:
+        print(f"[gateway] FAULT INJECTION ACTIVE: '{gw.faults.spec}' "
+              f"(seed {gw.faults.seed})")
     try:
         server.serve_forever()
     except KeyboardInterrupt:

@@ -115,6 +115,39 @@ def _batch_tier(args, resolve):
     return store, sched
 
 
+def _brownout(args, engines_provider):
+    """``--brownout`` → started BrownoutController or None.
+
+    ``engines_provider`` is the zero-arg callable the controller polls
+    each tick (engines dict values or the plane's active engines), so a
+    hot reload swaps the observed engine automatically.  The controller
+    is wired into every optional-work producer by the caller — the
+    ladder itself only reads signals and steps a level."""
+    if not getattr(args, "brownout", False):
+        return None
+    from deep_vision_tpu.serve.brownout import BrownoutController
+
+    bc = BrownoutController(
+        engines_provider,
+        interval_s=float(getattr(args, "brownout_interval_ms", 250.0)
+                         or 250.0) / 1e3,
+        l1_pressure_ms=float(getattr(args, "brownout_l1_ms", 50.0)),
+        l2_pressure_ms=float(getattr(args, "brownout_l2_ms", 150.0)),
+        l3_pressure_ms=float(getattr(args, "brownout_l3_ms", 400.0)),
+        occupancy_high=float(getattr(args, "brownout_occupancy", 0.97)),
+        shed_rate_high=float(getattr(args, "brownout_shed_rate", 0.10)),
+        up_window=int(getattr(args, "brownout_up_window", 2)),
+        down_window=int(getattr(args, "brownout_down_window", 8)),
+        cooldown_s=float(getattr(args, "brownout_cooldown_s", 2.0)))
+    force = int(getattr(args, "brownout_force", -1)
+                if getattr(args, "brownout_force", -1) is not None
+                else -1)
+    if force >= 0:
+        bc.force(force)
+    bc.start()
+    return bc
+
+
 def _parse_mesh_arg(spec: str) -> tuple[int, int]:
     """``--mesh D,M`` (data,model) → (D, M); a single value N means
     N,1 — pure batch sharding, same as --shard-batches over N."""
@@ -298,6 +331,12 @@ def build_server(args):
         return registry.get(name), eng
 
     jobs, batch_sched = _batch_tier(args, resolve)
+    brownout = _brownout(args, lambda: engines.values())
+    if brownout is not None:
+        if batch_sched is not None:
+            batch_sched.brownout = brownout  # L1+: freeze the batch tier
+        # L1+: stop paying for slow-trace serialization under overload
+        tracer.suppress_slow = lambda: brownout.at_least(1)
     server = ServeServer(
         registry, engines, host=args.host, port=args.port,
         verbose=args.verbose,
@@ -305,6 +344,7 @@ def build_server(args):
         socket_timeout_s=socket_timeout_s if socket_timeout_s > 0
         else None,
         tracer=tracer, jobs=jobs, batch_sched=batch_sched,
+        brownout=brownout,
         **_edge_kwargs(args))
     return engine, server
 
@@ -467,8 +507,13 @@ def _build_plane_server(args, registry, wire_dtype: str,
         from deep_vision_tpu.serve.cascade import CascadeRouter
 
         # built AFTER the boot deploys: the router's version listener
-        # only needs to see RELOADS (boot state is uncalibrated anyway)
-        cascade = CascadeRouter(plane, cascade_spec)
+        # only needs to see RELOADS (boot state is uncalibrated anyway).
+        # The ledger root gives calibration restart durability — a
+        # rebooted server reloads its threshold instead of failing
+        # closed to all-big for another min_sample requests
+        cascade = CascadeRouter(plane, cascade_spec,
+                                root=os.path.join(args.workdir,
+                                                  "_cascade"))
     if args.warmup:
         for name, eng in plane.active_engines().items():
             print(f"[serve] warming {name} {eng.buckets} ...")
@@ -525,6 +570,15 @@ def _build_plane_server(args, registry, wire_dtype: str,
         return model, plane.active_engine(model.name)
 
     jobs, batch_sched = _batch_tier(args, resolve)
+    brownout = _brownout(
+        args, lambda: plane.active_engines().values())
+    if brownout is not None:
+        plane.brownout = brownout    # L1+: pause shadow duplication
+        if cascade is not None:
+            cascade.brownout = brownout  # L1 sample pause, L2 degrade
+        if batch_sched is not None:
+            batch_sched.brownout = brownout  # L1+: freeze the batch tier
+        tracer.suppress_slow = lambda: brownout.at_least(1)
     server = ServeServer(
         registry, plane.active_engines(), host=args.host,
         port=args.port, verbose=args.verbose,
@@ -533,6 +587,7 @@ def _build_plane_server(args, registry, wire_dtype: str,
         else None,
         tracer=tracer, plane=plane, deploy=pipeline,
         jobs=jobs, batch_sched=batch_sched, cascade=cascade,
+        brownout=brownout,
         **_edge_kwargs(args))
     return plane, server
 
@@ -824,6 +879,45 @@ def main(argv=None):
                         "JSONL ledger (LRU) and GET /v1/jobs/<id>/"
                         "results streams them back from disk (0 = "
                         "unbounded; memory-only stores never evict)")
+    # -- overload brownout (docs/SERVING.md "Overload & brownout") --
+    p.add_argument("--brownout", action="store_true",
+                   help="arm the brownout degradation ladder: a "
+                        "per-process controller polls queue pressure / "
+                        "engine occupancy / shed rate and steps "
+                        "L0→L3 — L1 sheds optional work (cascade "
+                        "sampling, shadow duplication, batch tier, "
+                        "slow traces), L2 degrades quality (forced "
+                        "front-tier answers, stale cache hits, marked "
+                        "X-DVT-Degraded), L3 hard-sheds lower QoS "
+                        "classes so premium tenants keep answering "
+                        "(docs/SERVING.md runbook)")
+    p.add_argument("--brownout-interval-ms", type=float, default=250.0,
+                   help="ladder evaluation tick")
+    p.add_argument("--brownout-l1-ms", type=float, default=50.0,
+                   help="queue pressure (depth × exec EWMA, ms) that "
+                        "votes for L1")
+    p.add_argument("--brownout-l2-ms", type=float, default=150.0,
+                   help="queue pressure that votes for L2")
+    p.add_argument("--brownout-l3-ms", type=float, default=400.0,
+                   help="queue pressure that votes for L3")
+    p.add_argument("--brownout-occupancy", type=float, default=0.97,
+                   help="engine occupancy above this votes ≥L1")
+    p.add_argument("--brownout-shed-rate", type=float, default=0.10,
+                   help="interval shed fraction above this votes ≥L2")
+    p.add_argument("--brownout-up-window", type=int, default=2,
+                   help="consecutive hot ticks before the ladder "
+                        "ENGAGES (jumps straight to the target level)")
+    p.add_argument("--brownout-down-window", type=int, default=8,
+                   help="consecutive cool ticks before the ladder "
+                        "releases ONE level (hysteresis: engage fast, "
+                        "release slow)")
+    p.add_argument("--brownout-cooldown-s", type=float, default=2.0,
+                   help="minimum dwell after any transition before a "
+                        "release may happen")
+    p.add_argument("--brownout-force", type=int, default=-1,
+                   help="pin the ladder at this level at boot (0..3; "
+                        "-1 = signals in control; also settable live "
+                        "via POST /v1/brownout {\"force\": N|null})")
     # -- observability (docs/OBSERVABILITY.md) --
     p.add_argument("--log-level", default="info",
                    choices=("debug", "info", "warning", "error"),
@@ -909,6 +1003,16 @@ def main(argv=None):
         else:
             print("[serve] sharded batches: "
                   f"{engine.model.placement_desc()}")
+    bo = getattr(server.httpd, "brownout", None)
+    if bo is not None:
+        print(f"[serve] brownout ladder armed: "
+              f"L1@{args.brownout_l1_ms:g}ms "
+              f"L2@{args.brownout_l2_ms:g}ms "
+              f"L3@{args.brownout_l3_ms:g}ms queue pressure "
+              f"(occupancy>{args.brownout_occupancy:g} → ≥L1, "
+              f"shed_rate>{args.brownout_shed_rate:g} → ≥L2) — "
+              f"override: curl -XPOST http://{server.host}:"
+              f"{server.port}/v1/brownout -d '{{\"force\": 2}}'")
     jobs = getattr(server.httpd, "jobs", None)
     if jobs is not None:
         print(f"[serve] batch tier: POST http://{server.host}:"
@@ -937,6 +1041,11 @@ def main(argv=None):
             # engine.stop(); in-flight shard results past this point
             # shed and replay from the JSONL checkpoint on next boot
             batch_sched.stop()
+        brownout = getattr(server.httpd, "brownout", None)
+        if brownout is not None:
+            # the ladder polls engine signals — stop it before the
+            # engines it reads drain away
+            brownout.stop()
         server.shutdown()
         engine.stop(drain_deadline=args.drain_deadline)
     return 0

@@ -472,10 +472,12 @@ class ImageNetLoader:
                  n_real))
             if len(pending) > self.prefetch_batches:
                 res, nr = pending.popleft()
-                yield self._assemble(res.get(), nr)
+                # a hung decode worker fails the epoch loudly instead of
+                # pinning the input pipeline forever
+                yield self._assemble(res.get(timeout=600.0), nr)
         while pending:
             res, nr = pending.popleft()
-            yield self._assemble(res.get(), nr)
+            yield self._assemble(res.get(timeout=600.0), nr)
 
     def close(self):
         if self._pool is not None:

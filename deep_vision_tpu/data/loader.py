@@ -107,7 +107,9 @@ class PreppedSampleLoader:
     def close(self):
         if self._pool is not None:
             self._pool.terminate()
-            self._pool.join()
+            # Pool.join has no timeout parameter; terminate() already
+            # killed the workers so this only reaps them
+            self._pool.join()  # dvtlint: disable=DVT007
             self._pool = None
 
     def _assemble(self, items: list, weight) -> dict:
@@ -138,7 +140,10 @@ class PreppedSampleLoader:
                     pending.append(self._pool.map_async(
                         _prep_one, args, chunksize=chunk))
                     submit += 1
-                yield self._assemble(pending.popleft().get(), plan[b][1])
+                # a hung worker should fail the epoch loudly, not pin
+                # the training loop forever
+                yield self._assemble(pending.popleft().get(timeout=600.0),
+                                     plan[b][1])
         else:
             for sel, weight, _ in plan:
                 items = [self._prepare_indexed(int(i), self.epoch)

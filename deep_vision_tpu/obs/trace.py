@@ -116,6 +116,13 @@ class Tracer:
         self.started = 0  # guarded-by: _lock
         self.finished = 0  # guarded-by: _lock
         self.slow_sampled = 0  # guarded-by: _lock
+        self.slow_suppressed = 0  # guarded-by: _lock
+        # optional zero-arg predicate: True → drop the slow_request
+        # emission (the ring and stage sums still record).  The
+        # brownout L1 hook (serve/brownout.py): an overloaded process
+        # would otherwise log one line per request, since under
+        # saturation EVERY request is slow.
+        self.suppress_slow = None
         self._stage_s: dict[str, list] = {}  # stage -> [total_s, samples]; guarded-by: _lock
 
     def start(self, request_id: str | None = None,
@@ -139,6 +146,12 @@ class Tracer:
         except Exception:  # noqa: BLE001 — observability must not throw
             return
         slow = self.slow_ms is not None and d["total_ms"] > self.slow_ms
+        suppress = False
+        if slow and self.suppress_slow is not None:
+            try:
+                suppress = bool(self.suppress_slow())
+            except Exception:  # noqa: BLE001 — observability must not throw
+                suppress = False
         with self._lock:
             self.finished += 1
             for stage, ms in d["stages"].items():
@@ -146,9 +159,12 @@ class Tracer:
                 agg[0] += ms / 1e3
                 agg[1] += 1
             if slow:
-                self.slow_sampled += 1
+                if suppress:
+                    self.slow_suppressed += 1
+                else:
+                    self.slow_sampled += 1
             self.ring.append(d)
-        if slow:
+        if slow and not suppress:
             event(_log, "slow_request", **d)
 
     def recent(self, n: int = 32) -> list[dict]:
@@ -161,6 +177,7 @@ class Tracer:
                     "started": self.started,
                     "finished": self.finished,
                     "slow_sampled": self.slow_sampled,
+                    "slow_suppressed": self.slow_suppressed,
                     "slow_ms": self.slow_ms,
                     "ring": len(self.ring),
                     "stage_ms_avg": {

@@ -40,9 +40,12 @@ class Shed:
     ``retry_after_s`` is a hint for the client (surfaced as the HTTP
     ``Retry-After`` header on 429s): for ``queue_full`` it is the
     current estimated service time — when the backlog should have
-    drained enough to admit a retry.  Deadline sheds carry no hint (a
-    retry can't make a deadline the first attempt already missed), nor
-    do shutdown sheds (this replica is going away)."""
+    drained enough to admit a retry.  Deadline sheds carry the same
+    bucket-EWMA estimate: the first attempt's deadline is dead either
+    way, but the estimate is when a FRESH deadline stops being doomed
+    on arrival, so clients back off instead of immediately re-offering
+    work the estimator will shed again.  Shutdown sheds carry no hint
+    (this replica is going away)."""
 
     reason: str   # "queue_full" | "deadline" | "shutdown" | "quota" | "priority"
     detail: str = ""
@@ -181,7 +184,8 @@ class AdmissionController:
                     self.shed_deadline += 1
                 return Shed("deadline",
                             f"needs ~{est * 1e3:.1f}ms, "
-                            f"deadline in {(deadline - now) * 1e3:.1f}ms")
+                            f"deadline in {(deadline - now) * 1e3:.1f}ms",
+                            retry_after_s=est)
         return None
 
     def record_admit(self):
@@ -205,7 +209,8 @@ class AdmissionController:
                 self.shed_deadline += 1
             return Shed("deadline",
                         f"expired {(now - deadline) * 1e3:.1f}ms ago in "
-                        f"queue")
+                        f"queue",
+                        retry_after_s=self.estimated_service_s())
         return None
 
     def stats(self) -> dict:
@@ -362,11 +367,17 @@ class TenantQoS:
                     retry_after_s=wait_s)
 
     def check_pressure(self, tenant: str, queue_depth: int,
-                       max_queue: int) -> Shed | None:
+                       max_queue: int,
+                       floor: float = 0.0) -> Shed | None:
         """Weighted shedding on a cache miss: shed this class once
-        engine queue pressure crosses its knee."""
+        engine queue pressure crosses its knee.  ``floor`` is a lower
+        bound on the pressure the knees see — the brownout L3 hook
+        (serve/brownout.py) passes a floor just below 1.0 so every
+        class but premium (shed_at=1.0) sheds regardless of the actual
+        queue, premium last by construction."""
         cls = self.class_of(tenant)
         pressure = queue_depth / max_queue if max_queue > 0 else 0.0
+        pressure = max(pressure, float(floor))
         if pressure < cls.shed_at:
             return None
         with self._lock:

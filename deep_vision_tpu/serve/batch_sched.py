@@ -76,6 +76,10 @@ class BatchScheduler:
         self.shard_timeout_s = float(shard_timeout_s)
         self.occupancy_window_s = float(occupancy_window_s)
         self._lock = new_lock("serve.batch_sched.BatchScheduler._lock")
+        # optional BrownoutController (serve/brownout.py): at L1+ the
+        # batch tier is optional work — cohort admission freezes
+        # entirely, jobs just drain more slowly; read racily
+        self.brownout = None
         # rolling (t_end, busy_s) intervals of batch shard executions —
         # the dvt_batch_occupancy numerator
         self._busy: deque = deque()  # guarded-by: _lock
@@ -83,6 +87,7 @@ class BatchScheduler:
         self.shards_done = 0  # guarded-by: _lock
         self.shards_shed = 0  # whole-shard retries, guarded-by: _lock
         self.deferred = 0  # trough checks that said "not now", guarded-by: _lock
+        self.frozen_deferred = 0  # brownout L1+ freezes, guarded-by: _lock
         self.decode_errors = 0  # guarded-by: _lock
         self.item_errors = 0  # quarantined/timeout items, guarded-by: _lock
         self.jobs_failed = 0  # guarded-by: _lock
@@ -144,6 +149,17 @@ class BatchScheduler:
                 detail = e.args[0] if e.args else job.model
                 self.store.fail(job.job_id,
                                 f"model not servable: {detail}")
+                continue
+            bo = self.brownout
+            if bo is not None and bo.at_least(1):
+                # brownout L1+: admission frozen regardless of the
+                # trough check — under overload the next cohort is
+                # pure optional load on a saturated engine
+                with self._lock:
+                    self.deferred += 1
+                    self.frozen_deferred += 1
+                self._kick.wait(self.interval_s)
+                self._kick.clear()
                 continue
             if not self._trough(engine):
                 with self._lock:
@@ -246,6 +262,7 @@ class BatchScheduler:
                     "shards_done": self.shards_done,
                     "shards_shed": self.shards_shed,
                     "deferred": self.deferred,
+                    "frozen_deferred": self.frozen_deferred,
                     "decode_errors": self.decode_errors,
                     "item_errors": self.item_errors,
                     "jobs_failed": self.jobs_failed,

@@ -21,6 +21,14 @@ What is deliberately NOT cached:
   * models without a ``params_digest`` (raw exported blobs) — no
     version identity means no safe invalidation.
 
+Brownout L2 (serve/brownout.py) relaxes version purity DELIBERATELY:
+``get_stale`` answers a miss with the newest cached entry for the same
+(route, model, dtypes, payload) under ANY params version — a stale but
+well-formed answer beats a 429 when the engine is saturated.  The
+stale path is opt-in per lookup (the HTTP layer only consults it at
+L2+ and marks the response ``X-DVT-Degraded``), so normal operation
+keeps the exact-version contract untouched.
+
 The store is a byte-bounded LRU (``OrderedDict`` under one leaf lock);
 lookups and inserts are O(1) and the value is the already-serialized
 JSON body, so a hit skips decode, engine, and re-serialization in one
@@ -65,6 +73,11 @@ class ResponseCache:
         # the cascade's combined digest so either tier's reload still
         # invalidates.  guarded-by: _lock
         self.insertions_by_tier: dict = {}
+        # version-agnostic alias → the newest full key inserted for it
+        # (the brownout L2 stale path); pruned with its entry on
+        # eviction.  guarded-by: _lock
+        self._stale: dict[tuple, tuple] = {}
+        self.stale_hits = 0   # guarded-by: _lock
 
     @staticmethod
     def key(route: str, model: str, version_digest: str,
@@ -75,6 +88,11 @@ class ResponseCache:
         return (route, model, version_digest, wire_dtype, infer_dtype,
                 body_digest)
 
+    @staticmethod
+    def _alias(key: tuple) -> tuple:
+        # the full key minus the params digest (index 2)
+        return key[:2] + key[3:]
+
     def get(self, key: tuple) -> bytes | None:  # dvtlint: hot
         with self._lock:
             blob = self._store.get(key)
@@ -83,6 +101,24 @@ class ResponseCache:
                 return None
             self._store.move_to_end(key)
             self.hits += 1
+            return blob
+
+    def get_stale(self, key: tuple) -> bytes | None:  # dvtlint: hot
+        """Brownout L2 fallback AFTER an exact ``get`` miss: the newest
+        entry for the same (route, model, dtypes, payload) under any
+        params version — None when no prior version ever answered this
+        payload.  The caller owns marking the response degraded."""
+        alias = self._alias(key)
+        with self._lock:
+            full = self._stale.get(alias)
+            if full is None or full == key:
+                return None
+            blob = self._store.get(full)
+            if blob is None:
+                del self._stale[alias]  # entry aged out of the LRU
+                return None
+            self._store.move_to_end(full)
+            self.stale_hits += 1
             return blob
 
     def put(self, key: tuple, blob: bytes,
@@ -97,17 +133,21 @@ class ResponseCache:
             self._store[key] = blob
             self._bytes += size
             self.insertions += 1
+            self._stale[self._alias(key)] = key
             if tier:
                 self.insertions_by_tier[tier] = \
                     self.insertions_by_tier.get(tier, 0) + 1
             while self._bytes > self.max_bytes:
-                _, victim = self._store.popitem(last=False)
+                vkey, victim = self._store.popitem(last=False)
                 self._bytes -= len(victim)
                 self.evictions += 1
+                if self._stale.get(self._alias(vkey)) == vkey:
+                    del self._stale[self._alias(vkey)]
 
     def clear(self):
         with self._lock:
             self._store.clear()
+            self._stale.clear()
             self._bytes = 0
 
     def stats(self) -> dict:
@@ -117,6 +157,7 @@ class ResponseCache:
                     "bytes": self._bytes,
                     "max_bytes": self.max_bytes,
                     "hits": self.hits,
+                    "stale_hits": self.stale_hits,
                     "misses": self.misses,
                     "hit_rate": self.hits / lookups if lookups else 0.0,
                     "evictions": self.evictions,

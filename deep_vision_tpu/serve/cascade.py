@@ -40,6 +40,30 @@ budget minus the time the front attempt burned — and its original
 trace span, so a cascaded request never gets double SLO budget and the
 big tier's admission controller judges it by what's actually left.
 
+Brownout hooks (serve/brownout.py, optional — ``router.brownout``
+defaults to None and nothing changes): at L1+ the dual-run calibration
+sampling PAUSES (each skipped slot counted in ``samples_paused``; the
+would-be sample routes like ordinary traffic) — under overload the
+duplicate big-tier run is the first capacity to reclaim.  At L2+ a
+non-premium request whose front confidence falls BELOW the calibrated
+threshold is served the front answer anyway, resolved with the
+``DEGRADED`` tier token so the HTTP layer marks it ``X-DVT-Degraded``
+— quality traded for the escalation's big-tier slot, visibly, and
+only when a threshold exists (uncalibrated traffic stays fail-closed
+all-big: no threshold means no quality claim to degrade from).
+Always-big tenants bypass both hooks — premium degrades last.
+
+Calibration persists across restarts when ``root`` names a ledger
+directory (``<workdir>/_cascade`` in production — the deploy-ledger
+JSONL idiom, deploy/history.py): every threshold CHANGE appends the
+histogram counts plus the combined params digest, every version-swap
+reset appends a reset record, and boot replays the tail — the
+histogram and threshold are adopted only when the persisted digest
+matches both live tiers (and the threshold is RE-derived from the
+restored counts, so retuned ``min_agreement`` knobs apply
+immediately).  Any mismatch stays fail-closed, exactly as if the
+ledger did not exist.
+
 All chaining is ``Future.add_done_callback`` — the router never blocks
 an engine worker thread.  Lock order: ``CascadeRouter._lock`` is a
 LEAF lock; no plane or engine call happens under it.
@@ -47,6 +71,8 @@ LEAF lock; no plane or engine call happens under it.
 
 from __future__ import annotations
 
+import json
+import os
 import time
 from concurrent.futures import Future
 
@@ -62,6 +88,10 @@ _log = get_logger("dvt.serve.cascade")
 
 FRONT = "front"
 BIG = "big"
+# tier token for a brownout-L2 front answer served BELOW the
+# calibrated threshold — serve/http.py maps it to X-DVT-Tier: front
+# plus X-DVT-Degraded: 1
+DEGRADED = "front-degraded"
 
 _DEFAULT_DEADLINE_MS = 30_000.0
 
@@ -108,7 +138,8 @@ class CascadeRouter:
     """Route classify traffic addressed to ``spec.big`` through the
     front tier, escalating below the calibrated threshold."""
 
-    def __init__(self, plane, spec: CascadeSpec):
+    def __init__(self, plane, spec: CascadeSpec,
+                 root: str | None = None):
         self.plane = plane
         self.spec = spec
         self.hist = AgreementHistogram(bins=spec.bins)
@@ -116,6 +147,9 @@ class CascadeRouter:
         # None = uncalibrated → fail closed (all-big); guarded-by: _lock
         self._threshold: float | None = None
         self._tick = 0  # guarded-by: _lock
+        # optional BrownoutController (serve/brownout.py) — the L1
+        # sampling pause and L2 degraded-front hooks; read racily
+        self.brownout = None
         self.served = {FRONT: 0, BIG: 0}  # guarded-by: _lock
         self.escalations = 0  # guarded-by: _lock
         self.escalated_shed = 0  # no deadline left post-front; guarded-by: _lock
@@ -124,11 +158,20 @@ class CascadeRouter:
         self.forced_big = 0  # always-big tenants; guarded-by: _lock
         self.samples = 0  # dual-run calibration requests; guarded-by: _lock
         self.samples_discarded = 0  # guarded-by: _lock
+        self.samples_paused = 0  # brownout L1 skipped slots; guarded-by: _lock
+        self.degraded_served = 0  # brownout L2 below-threshold fronts; guarded-by: _lock
         self.calibrations = 0  # threshold (re)computed; guarded-by: _lock
         self.resets = 0  # version-swap calibration drops; guarded-by: _lock
         self._latency = {FRONT: LatencyHistogram(),
                          BIG: LatencyHistogram()}  # guarded-by: _lock
         self._top1 = ClassifyWorkload.top1
+        # calibration ledger (None = memory-only, the test default)
+        self._root = root
+        self.restored = False
+        self.ledger_write_errors = 0  # guarded-by: _lock
+        if root is not None:
+            os.makedirs(root, exist_ok=True)
+            self._restore()
         plane.add_version_listener(self._on_version_swap)
 
     # -- routing table ------------------------------------------------------
@@ -192,18 +235,27 @@ class CascadeRouter:
                 span.mark("cascade_forced_big")
             self._submit_big(image, deadline_ms, span, fut, t0)
             return fut
+        bo = self.brownout
         if tick % self.spec.sample_period == 0:
-            self._submit_sample(image, deadline_ms, span, fut, t0)
-            return fut
+            if bo is None or not bo.at_least(1):
+                self._submit_sample(image, deadline_ms, span, fut, t0)
+                return fut
+            # brownout L1+: the dual-run sample is optional work —
+            # skip the slot and route the request like any other
+            with self._lock:
+                self.samples_paused += 1
         if thr is None:
             # fail closed: uncalibrated traffic belongs to the big tier
             self._submit_big(image, deadline_ms, span, fut, t0)
             return fut
+        # decided at submit time so one request sees one policy even
+        # if the ladder moves while the front tier runs
+        degrade = bo is not None and bo.at_least(2)
         ffut = self.plane.submit(self.spec.front, image, deadline_ms,
                                  span=span)
         ffut.add_done_callback(
             lambda f: self._front_done(f, image, deadline_ms, span,
-                                       fut, t0, thr))
+                                       fut, t0, thr, degrade))
         return fut
 
     def infer(self, image, deadline_ms: float | None = None,
@@ -219,7 +271,8 @@ class CascadeRouter:
         bfut.add_done_callback(lambda f: self._finish(f, fut, t0, BIG))
 
     def _front_done(self, ffut: Future, image, deadline_ms, span,
-                    fut: Future, t0, thr: float):
+                    fut: Future, t0, thr: float,
+                    degrade: bool = False):
         """Front answered (engine worker thread — never block): serve
         it when confident, escalate otherwise."""
         try:
@@ -242,6 +295,15 @@ class CascadeRouter:
             if span is not None:
                 span.mark("cascade_front_served")
             self._finish_row(row, fut, t0, FRONT)
+            return
+        if degrade:
+            # brownout L2: trade quality for the escalation's big-tier
+            # slot — the front answer stands, marked degraded
+            with self._lock:
+                self.degraded_served += 1
+            if span is not None:
+                span.mark("cascade_degraded_front")
+            self._finish_row(row, fut, t0, FRONT, degraded=True)
             return
         self._escalate(image, deadline_ms, span, fut, t0, "lowconf")
 
@@ -280,11 +342,12 @@ class CascadeRouter:
             return
         self._finish_row(row, fut, t0, tier)
 
-    def _finish_row(self, row, fut: Future, t0, tier: str):
+    def _finish_row(self, row, fut: Future, t0, tier: str,
+                    degraded: bool = False):
         with self._lock:
             self.served[tier] += 1
             self._latency[tier].record(time.monotonic() - t0)
-        fut.set_result((tier, row))
+        fut.set_result((DEGRADED if degraded else tier, row))
 
     # -- calibration --------------------------------------------------------
 
@@ -344,6 +407,13 @@ class CascadeRouter:
                   front=self.spec.front, big=self.spec.big,
                   threshold=thr,
                   samples=self.hist.stats()["samples"])
+            h = self.hist.stats()
+            self._append_ledger({"event": "calibrated",
+                                 "threshold": thr,
+                                 "digest": self.params_digest(),
+                                 "bins": h["bins"],
+                                 "total": h["total"],
+                                 "agree": h["agree"]})
 
     def _on_version_swap(self, name: str):
         """Plane version listener: a reload/promote/revert of either
@@ -358,6 +428,79 @@ class CascadeRouter:
         if had:
             event(_log, "cascade_recalibrating", model=name,
                   front=self.spec.front, big=self.spec.big)
+        self._append_ledger({"event": "reset", "model": name})
+
+    # -- calibration persistence --------------------------------------------
+
+    def _ledger_path(self) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in f"{self.spec.front}+{self.spec.big}")
+        return os.path.join(self._root, f"{safe}.jsonl")
+
+    def _append_ledger(self, record: dict):
+        """Append one immutable calibration record (deploy-ledger
+        idiom: write failures are counted, never raised — the ledger
+        observes, it never gates serving)."""
+        if self._root is None:
+            return
+        record = {"ts": round(time.time(), 3),
+                  "front": self.spec.front, "big": self.spec.big,
+                  **record}
+        try:
+            with open(self._ledger_path(), "a", encoding="utf-8") as f:
+                f.write(json.dumps(record) + "\n")
+        except OSError as e:
+            with self._lock:
+                self.ledger_write_errors += 1
+            event(_log, "cascade_ledger_write_failed",
+                  error=f"{type(e).__name__}: {e}")
+
+    def _restore(self):
+        """Boot-time replay: adopt the ledger's newest calibration iff
+        its params digest matches BOTH live tiers.  A trailing reset, a
+        digest mismatch (either tier reloaded while down), a torn tail
+        line, or no ledger at all each leave the router exactly where
+        it started — uncalibrated and fail-closed."""
+        last = None
+        try:
+            with open(self._ledger_path(), encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        last = json.loads(line)
+                    except ValueError:
+                        continue  # torn tail line from a crash
+        except OSError:
+            return  # no ledger yet — first boot
+        if not last or last.get("event") != "calibrated":
+            return
+        digest = self.params_digest()
+        if digest is None or last.get("digest") != digest:
+            event(_log, "cascade_restore_stale",
+                  front=self.spec.front, big=self.spec.big,
+                  ledger_digest=last.get("digest"), live_digest=digest)
+            return
+        try:
+            self.hist.restore(last["total"], last["agree"])
+        except (KeyError, TypeError, ValueError) as e:
+            event(_log, "cascade_restore_invalid",
+                  error=f"{type(e).__name__}: {e}")
+            return
+        # RE-derive the threshold from the restored counts instead of
+        # trusting the stored one: retuned --cascade-min-agreement /
+        # min-sample knobs apply to the old sample immediately, and a
+        # sample now too thin for the knobs stays fail-closed
+        thr = self.hist.threshold(self.spec.min_agreement,
+                                  self.spec.min_sample)
+        with self._lock:
+            self._threshold = thr
+            self.restored = thr is not None
+        event(_log, "cascade_restored",
+              front=self.spec.front, big=self.spec.big,
+              threshold=thr, samples=self.hist.stats()["samples"],
+              calibrated=thr is not None)
 
     # -- observability ------------------------------------------------------
 
@@ -391,8 +534,13 @@ class CascadeRouter:
                 "forced_big": self.forced_big,
                 "samples": self.samples,
                 "samples_discarded": self.samples_discarded,
+                "samples_paused": self.samples_paused,
+                "degraded_served": self.degraded_served,
                 "calibrations": self.calibrations,
                 "resets": self.resets,
+                "restored": self.restored,
+                "ledger_root": self._root,
+                "ledger_write_errors": self.ledger_write_errors,
                 "agreement": hstats["agreement"],
                 "agreement_bins": {"bins": hstats["bins"],
                                    "samples": hstats["samples"],

@@ -14,6 +14,9 @@ front-end) consult at well-defined places in the request lifecycle:
     dispatch  immediately before the H2D + compiled call
     compute   the compiled program execution (and every retry of it)
     d2h       the drainer's bulk device_get
+    gateway   the gateway's per-attempt backend call
+              (serve/gateway.py ``_single``) — the NETWORK between
+              gateway and backend, not the backend itself
 
     mode       effect
     ---------  -----------------------------------------------------
@@ -28,6 +31,15 @@ front-end) consult at well-defined places in the request lifecycle:
                bisect-retry must quarantine exactly that request
     die        raise ``KillThread`` (BaseException) so the stage's
                worker thread exits and the watchdog must restart it
+    conn_reset raise ``ConnectionResetError`` (an OSError, exactly
+               what a peer RST surfaces as) — the gateway's breaker/
+               retry-budget machinery must absorb it
+    slow_drip  sleep ``delay_ms`` mid-attempt — a congested link
+               dripping bytes; pushes attempts past hedging and
+               timeout thresholds without failing them outright
+    blackhole  block up to ``hang_s`` (or until cancelled), then
+               raise ``TimeoutError`` — packets leaving, nothing
+               coming back, the worst network failure mode
 
 Spec syntax (``--faults`` / env ``DVT_SERVE_FAULTS``): semicolon-
 separated faults, each ``stage:mode[:key=value]...`` — e.g.
@@ -54,8 +66,10 @@ import threading
 from deep_vision_tpu.analysis.sanitizer import new_lock
 import time
 
-STAGES = ("decode", "batcher", "staging", "dispatch", "compute", "d2h")
-MODES = ("exception", "latency", "hang", "nan", "poison", "die")
+STAGES = ("decode", "batcher", "staging", "dispatch", "compute", "d2h",
+          "gateway")
+MODES = ("exception", "latency", "hang", "nan", "poison", "die",
+         "conn_reset", "slow_drip", "blackhole")
 
 ENV_SPEC = "DVT_SERVE_FAULTS"
 ENV_SEED = "DVT_SERVE_FAULT_SEED"
@@ -223,19 +237,32 @@ class FaultPlane:
                 f"injected {stage} exception #{f.fired} (spec '{self.spec}')")
         if f.mode == "die":
             raise KillThread(f"injected {stage} thread death #{f.fired}")
-        if f.mode == "latency":
+        if f.mode == "conn_reset":
+            # OSError subclass: the caller's network-failure handling
+            # (gateway breaker, retry budget) must treat it as real
+            raise ConnectionResetError(
+                f"injected {stage} conn-reset #{f.fired}")
+        if f.mode in ("latency", "slow_drip"):
             time.sleep(f.delay_ms / 1e3)
         elif f.mode == "hang":
-            t_end = time.monotonic() + f.hang_s
-            while time.monotonic() < t_end:
-                if self.cancel.is_set():
-                    break
-                if cancel is not None and cancel.is_set():
-                    break
-                if stop is not None and stop.is_set():
-                    break
-                time.sleep(0.005)
+            self._wait_cancelled(f.hang_s, stop, cancel)
+        elif f.mode == "blackhole":
+            self._wait_cancelled(f.hang_s, stop, cancel)
+            raise TimeoutError(
+                f"injected {stage} blackhole #{f.fired} "
+                f"({f.hang_s:g}s of silence)")
         return f.mode
+
+    def _wait_cancelled(self, seconds: float, stop, cancel):
+        t_end = time.monotonic() + seconds
+        while time.monotonic() < t_end:
+            if self.cancel.is_set():
+                break
+            if cancel is not None and cancel.is_set():
+                break
+            if stop is not None and stop.is_set():
+                break
+            time.sleep(0.005)
 
     # -- observability -----------------------------------------------------
 

@@ -32,10 +32,29 @@ survives any single backend dying:
                   one probe interval of it dying — no traffic required.
   retries         inference requests are idempotent, so a connect
                   error / timeout / 5xx is retried with jittered
-                  exponential backoff, bounded by ``retry_budget``,
-                  FAILING OVER to a different backend when one is
-                  routable — killing one of two backends mid-load loses
-                  zero admitted requests from the client's view.
+                  exponential backoff, bounded by ``retry_budget``
+                  attempts per request, FAILING OVER to a different
+                  backend when one is routable — killing one of two
+                  backends mid-load loses zero admitted requests from
+                  the client's view.
+  retry budget    the per-request attempt cap bounds one request; it
+                  does NOT bound the fleet-level retry *ratio* — under
+                  a total backend outage every request still burns its
+                  full attempt allowance, and the retry storm is load
+                  the dying backends must also absorb.  So each retry
+                  additionally draws one token from the TARGET
+                  backend's bucket, refilled ``retry_budget_ratio``
+                  per successful response (capped at
+                  ``retry_budget_burst``): sustained retries are
+                  bounded to a fixed fraction of sustained successes,
+                  the classic success-refilled retry budget (Finagle,
+                  "The Site Reliability Workbook" ch. 21).  A dry
+                  bucket denies the retry; the request answers with
+                  what it has (last 429/502) instead of amplifying.
+                  Remaining tokens ride the ``X-DVT-Retry-Budget``
+                  response header so a cooperating client (bench.py's
+                  closed loop) suppresses ITS retries too — gateway
+                  and client never jointly exceed the budget.
   429s            a shed (429) is failed over once to a less-loaded
                   backend when one exists; otherwise it propagates to
                   the client unchanged, ``Retry-After`` header included,
@@ -94,6 +113,7 @@ from deep_vision_tpu.obs.trace import (
     new_request_id,
 )
 from deep_vision_tpu.serve.edge import DEFAULT_MAX_CONNECTIONS, EdgeServer
+from deep_vision_tpu.serve.faults import InjectedFault
 from deep_vision_tpu.serve.health import DEAD, DEGRADED, OK
 
 _log = get_logger("dvt.serve.gateway")
@@ -105,7 +125,12 @@ HALF_OPEN = "half_open"
 # retry-able HTTP verdicts vs. final ones: anything below 500 except a
 # 429 means the backend is alive and answered THIS request definitively
 _PROXY_HEADERS = ("Content-Type", "Retry-After", "X-DVT-Cache",
-                  "X-DVT-Tier")
+                  "X-DVT-Tier", "X-DVT-Degraded")
+
+#: response header carrying the answering backend's remaining retry
+#: tokens — a value below 1.0 tells a cooperating client that retrying
+#: now would exceed the budget the gateway itself is held to
+RETRY_BUDGET_HEADER = "X-DVT-Retry-Budget"
 
 
 class Backend:
@@ -122,7 +147,9 @@ class Backend:
     def __init__(self, url: str, *, breaker_threshold: int = 3,
                  breaker_cooldown_s: float = 1.0,
                  degraded_after: int = 1, dead_after: int = 5,
-                 ewma_alpha: float = 0.2):
+                 ewma_alpha: float = 0.2,
+                 retry_ratio: float = 0.1,
+                 retry_burst: float = 10.0):
         addr = url.removeprefix("http://").rstrip("/")
         host, _, port = addr.rpartition(":")
         if not host or not port.isdigit():
@@ -151,6 +178,17 @@ class Backend:
         self.breaker_opens = 0  # guarded-by: _lock
         self.breaker_closes = 0  # guarded-by: _lock
         self.half_open_trials = 0  # guarded-by: _lock
+        # success-refilled retry budget: each retry routed HERE spends
+        # one token; each successful response refills ``retry_ratio``
+        # (capped at ``retry_burst``).  The bucket starts full so a
+        # cold gateway can still fail over, but sustained retries are
+        # bounded to ratio × sustained successes — a retry RATIO, not
+        # a per-request count.
+        self.retry_ratio = max(0.0, float(retry_ratio))
+        self.retry_burst = max(1.0, float(retry_burst))
+        self.retry_tokens = self.retry_burst  # guarded-by: _lock
+        self.retries_granted = 0  # guarded-by: _lock
+        self.retries_denied = 0  # guarded-by: _lock
         self.last_probe_at: float | None = None  # guarded-by: _lock
         self.last_error: str | None = None  # guarded-by: _lock
         # model names this backend reports serving (from its healthz
@@ -296,6 +334,11 @@ class Backend:
             self.successes += 1
             self.ewma_s = elapsed_s if self.ewma_s is None else \
                 self.ewma_s + self._alpha * (elapsed_s - self.ewma_s)
+            # only REAL successes refill the retry budget — sheds and
+            # probes don't, so a 100%-shedding backend's bucket stays
+            # dry and retries against it stop at the burst allowance
+            self.retry_tokens = min(self.retry_burst,
+                                    self.retry_tokens + self.retry_ratio)
             self._success_locked()
 
     def done_shed(self):
@@ -312,6 +355,25 @@ class Backend:
             self._trial_inflight = False
             self._failure_locked(err, time.monotonic()
                                  if now is None else now)
+
+    # -- retry budget ------------------------------------------------------
+
+    def try_retry(self) -> bool:
+        """Spend one retry token against this backend.  False means the
+        budget is dry: the caller must NOT retry here — under a
+        sustained outage nothing refills the bucket and the retry storm
+        dies at the burst allowance instead of amplifying the load."""
+        with self._lock:
+            if self.retry_tokens >= 1.0:
+                self.retry_tokens -= 1.0
+                self.retries_granted += 1
+                return True
+            self.retries_denied += 1
+            return False
+
+    def retry_tokens_left(self) -> float:
+        with self._lock:
+            return self.retry_tokens
 
     def probe_ok(self, now: float, models: list[str] | None = None,
                  mesh: dict | None = None):
@@ -374,6 +436,12 @@ class Backend:
                 "breaker_opens": self.breaker_opens,
                 "breaker_closes": self.breaker_closes,
                 "half_open_trials": self.half_open_trials,
+                "retry_budget": {
+                    "tokens": round(self.retry_tokens, 3),
+                    "burst": self.retry_burst,
+                    "ratio": self.retry_ratio,
+                    "granted": self.retries_granted,
+                    "denied": self.retries_denied},
                 "last_probe_age_s": round(now - self.last_probe_at, 4)
                 if self.last_probe_at is not None else None,
                 "last_error": self.last_error,
@@ -407,6 +475,8 @@ class Gateway:
                  probe_timeout_s: float = 1.0,
                  request_timeout_s: float = 30.0,
                  retry_budget: int = 3,
+                 retry_budget_ratio: float = 0.1,
+                 retry_budget_burst: float = 10.0,
                  backoff_ms: float = 10.0,
                  backoff_max_ms: float = 250.0,
                  breaker_threshold: int = 3,
@@ -416,13 +486,16 @@ class Gateway:
                  hedge_after_ms: float | None = None,
                  hedge_min_history: int = 32,
                  affinity: bool = False,
-                 tracer: Tracer | None = None):
+                 tracer: Tracer | None = None,
+                 faults=None):
         if not backends:
             raise ValueError("gateway needs at least one backend")
         self.backends = [Backend(u, breaker_threshold=breaker_threshold,
                                  breaker_cooldown_s=breaker_cooldown_s,
                                  degraded_after=degraded_after,
-                                 dead_after=dead_after)
+                                 dead_after=dead_after,
+                                 retry_ratio=retry_budget_ratio,
+                                 retry_burst=retry_budget_burst)
                          for u in backends]
         names = [b.name for b in self.backends]
         if len(set(names)) != len(names):
@@ -442,6 +515,12 @@ class Gateway:
         # caches.  Opt-in: load-based routing stays the default.
         self.affinity = affinity
         self.tracer = tracer or Tracer()
+        self.retry_budget_ratio = retry_budget_ratio
+        self.retry_budget_burst = retry_budget_burst
+        # optional FaultPlane (serve/faults.py): the "gateway" stage
+        # fires per backend attempt, modeling the NETWORK between the
+        # gateway and its backends (conn_reset / slow_drip / blackhole)
+        self.faults = faults
         self.latency = LatencyHistogram()
         self._lock = new_lock("serve.gateway.Gateway._lock")
         self._stop = threading.Event()
@@ -455,6 +534,7 @@ class Gateway:
         self.hedge_wins = 0  # guarded-by: _lock
         self.exhausted = 0  # guarded-by: _lock
         self.no_backend = 0  # guarded-by: _lock
+        self.retry_budget_denied = 0  # guarded-by: _lock
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -617,6 +697,18 @@ class Gateway:
             if b is None:
                 break
             if attempt > 0:
+                if not b.try_retry():
+                    # the target's retry budget is dry: retrying would
+                    # push the storm past the configured ratio.  Skip
+                    # this backend (another may have tokens); when all
+                    # are dry the loop runs out and the request answers
+                    # with the last verdict it holds.
+                    with self._lock:
+                        self.retry_budget_denied += 1
+                    if span is not None:
+                        span.note("retry_budget_denied", b.name)
+                    tried.append(b)
+                    continue
                 with self._lock:
                     self.retries += 1
                     if prev is not None and b is not prev:
@@ -664,11 +756,16 @@ class Gateway:
                     last_shed.payload)
         if last_fail is not None:
             detail = last_fail.error or f"HTTP {last_fail.status}"
-            return 502, {"Content-Type": "application/json"}, json.dumps(
+            return 502, {
+                "Content-Type": "application/json",
+                RETRY_BUDGET_HEADER:
+                    f"{last_fail.backend.retry_tokens_left():.2f}",
+            }, json.dumps(
                 {"error": f"all backends failed after "
                           f"{1 + self.retry_budget} attempt(s): "
                           f"{detail}"}).encode()
         return 503, {"Content-Type": "application/json",
+                     RETRY_BUDGET_HEADER: "0.00",
                      "Retry-After": max(1, math.ceil(
                          self.probe_interval_s))}, json.dumps(
             {"error": "no routable backend (all DEAD, draining, or "
@@ -676,8 +773,14 @@ class Gateway:
 
     @staticmethod
     def _client_headers(out: _Outcome) -> dict:
-        return {k: out.headers[k] for k in _PROXY_HEADERS
-                if k in out.headers}
+        h = {k: out.headers[k] for k in _PROXY_HEADERS
+             if k in out.headers}
+        # budget state rides every proxied answer: a client deciding
+        # whether to retry a 429/5xx sees the same bucket the gateway
+        # spends from, so the two can't jointly exceed the ratio
+        h[RETRY_BUDGET_HEADER] = \
+            f"{out.backend.retry_tokens_left():.2f}"
+        return h
 
     def _pick(self, exclude: list, model: str | None = None,
               affinity_key: bytes | None = None
@@ -793,10 +896,16 @@ class Gateway:
         b.begin()
         t0 = time.monotonic()
         try:
+            if self.faults is not None and self.faults.enabled:
+                # the injected NETWORK between gateway and backend:
+                # conn_reset raises ConnectionResetError and blackhole
+                # raises TimeoutError — both OSError subclasses, so
+                # they ride the real failure path below untouched
+                self.faults.inject("gateway", stop=self._stop)
             status, headers, payload = self._call(
                 b, "POST", path, body, self.request_timeout_s,
                 extra_headers={REQUEST_ID_HEADER: rid} if rid else None)
-        except (OSError, HTTPException) as e:
+        except (OSError, HTTPException, InjectedFault) as e:
             err = f"{b.name}: {type(e).__name__}: {e}"
             b.done_failure(err)
             return _Outcome("fail", 0, {}, b"", b, error=err)
@@ -875,6 +984,9 @@ class Gateway:
                     "hedge_wins": self.hedge_wins,
                     "exhausted": self.exhausted,
                     "no_backend": self.no_backend,
+                    "retry_budget_denied": self.retry_budget_denied,
+                    "retry_budget_ratio": self.retry_budget_ratio,
+                    "retry_budget_burst": self.retry_budget_burst,
                     "breaker_opens": sum(b.breaker_opens
                                          for b in self.backends),
                     "breaker_closes": sum(b.breaker_closes
@@ -901,6 +1013,8 @@ class Gateway:
                            "trace": self.tracer.summary(),
                            "backends": {b.name: b.report(now)
                                         for b in self.backends}}}
+        if self.faults is not None and self.faults.enabled:
+            out["gateway"]["faults"] = self.faults.stats()
         if include_backend_stats:
             agg: dict = {}
             for b in self.backends:
@@ -1100,6 +1214,12 @@ def render_gateway_metrics(gw: Gateway, edge: dict | None = None) -> str:
               help="Requests that failed every attempt")
     p.counter("dvt_gateway_no_backend_total", g["no_backend"],
               help="Requests with no routable backend at all")
+    p.counter("dvt_gateway_retry_budget_denied_total",
+              g["retry_budget_denied"],
+              help="Retries refused because the target backend's "
+                   "success-refilled token bucket was dry")
+    p.gauge("dvt_gateway_retry_budget_ratio", g["retry_budget_ratio"],
+            help="Tokens refilled per successful backend response")
     p.gauge("dvt_gateway_routable_backends",
             len(gw.routable_backends()),
             help="Backends currently accepting routed traffic")
@@ -1125,6 +1245,14 @@ def render_gateway_metrics(gw: Gateway, edge: dict | None = None) -> str:
         p.gauge("dvt_gateway_backend_ewma_seconds",
                 r["ewma_ms"] / 1e3 if r["ewma_ms"] is not None
                 else None, lab, help="Per-backend latency EWMA")
+        rb = r.get("retry_budget") or {}
+        p.gauge("dvt_gateway_backend_retry_tokens", rb.get("tokens"),
+                lab, help="Retry-budget tokens available (refilled "
+                          "by successes, spent by retries)")
+        p.counter("dvt_gateway_backend_retries_granted_total",
+                  rb.get("granted"), lab)
+        p.counter("dvt_gateway_backend_retries_denied_total",
+                  rb.get("denied"), lab)
         conns = r.get("conns") or {}
         p.counter("dvt_gateway_backend_conns_created_total",
                   conns.get("created"), lab,

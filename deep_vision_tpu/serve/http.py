@@ -145,6 +145,7 @@ from urllib.parse import parse_qs
 from deep_vision_tpu.obs.trace import REQUEST_ID_HEADER, new_request_id
 from deep_vision_tpu.serve.admission import TENANT_HEADER
 from deep_vision_tpu.serve.cache import ResponseCache, payload_digest
+from deep_vision_tpu.serve.cascade import DEGRADED as CASCADE_DEGRADED
 from deep_vision_tpu.serve.edge import (
     _CHUNK_END,
     DEFAULT_MAX_CONNECTIONS,
@@ -164,6 +165,12 @@ DEFAULT_MAX_BODY_BYTES = 32 * 2**20
 #: every cascaded 200 so clients and the bench can split per-tier
 #: latency without a debug span (serve/cascade.py)
 TIER_HEADER = "X-DVT-Tier"
+
+#: set ("1") on answers the brownout ladder degraded deliberately — a
+#: forced front-tier cascade answer (L2) or a stale response-cache hit
+#: (L2).  Clients that care about full quality can retry later; ones
+#: that don't get a fast answer instead of a 429 (serve/brownout.py)
+DEGRADED_HEADER = "X-DVT-Degraded"
 
 
 class ServeError(Exception):
@@ -272,6 +279,8 @@ def render_serve_metrics(stats: dict) -> str:
         _render_batch_metrics(p, stats["batch"])
     if isinstance(stats.get("cascade"), dict):
         _render_cascade_metrics(p, stats["cascade"])
+    if isinstance(stats.get("brownout"), dict):
+        _render_brownout_metrics(p, stats["brownout"])
     if isinstance(stats.get("models"), dict):
         for name, entry in stats["models"].items():
             if isinstance(entry.get("engine"), dict):
@@ -335,7 +344,7 @@ def render_serve_metrics(stats: dict) -> str:
         return p.render()
     for name, s in stats.items():
         if name in ("edge", "response_cache", "qos", "batch",
-                    "cascade"):
+                    "cascade", "brownout"):
             continue  # front-end blocks, rendered above
         _render_engine_metrics(p, name, s)
     return p.render()
@@ -378,6 +387,10 @@ def _render_edge_metrics(p, stats: dict) -> None:
                   help="Inference answers served from the response cache")
         p.counter("dvt_serve_cache_misses_total", rcache.get("misses"),
                   {}, help="Cacheable lookups that missed")
+        p.counter("dvt_serve_cache_stale_hits_total",
+                  rcache.get("stale_hits"), {},
+                  help="Brownout-L2 answers served from a retired "
+                       "params version (marked X-DVT-Degraded)")
         p.counter("dvt_serve_cache_evictions_total",
                   rcache.get("evictions"), {},
                   help="LRU evictions from the response cache")
@@ -483,6 +496,9 @@ def _render_batch_metrics(p, batch: dict) -> None:
     p.counter("dvt_batch_deferred_total", sched.get("deferred"), {},
               help="Trough checks that parked batch work behind "
                    "interactive pressure")
+    p.counter("dvt_batch_frozen_deferred_total",
+              sched.get("frozen_deferred"), {},
+              help="Cohort admissions frozen outright at brownout L1+")
     p.gauge("dvt_batch_occupancy", sched.get("occupancy"), {},
             help="Fraction of the trailing window batch shards kept "
                  "an engine busy (the trough-filling duty cycle)")
@@ -529,6 +545,22 @@ def _render_cascade_metrics(p, cas: dict) -> None:
                         "always-big QoS tenants")
     p.counter("dvt_cascade_recalibrations_total", cas.get("resets"),
               lab, help="Calibration drops after a tier version swap")
+    p.counter("dvt_cascade_samples_paused_total",
+              cas.get("samples_paused"), lab,
+              help="Dual-run calibration samples skipped at brownout "
+                   "L1+ (optional work shed first)")
+    p.counter("dvt_cascade_degraded_served_total",
+              cas.get("degraded_served"), lab,
+              help="Sub-threshold front answers forced at brownout L2 "
+                   "(marked X-DVT-Degraded)")
+    p.gauge("dvt_cascade_restored",
+            1 if cas.get("restored") else 0, lab,
+            help="1 when this boot's calibration was restored from "
+                 "the persisted ledger")
+    p.counter("dvt_cascade_ledger_write_errors_total",
+              cas.get("ledger_write_errors"), lab,
+              help="Calibration-ledger appends that failed to reach "
+                   "disk")
     for tier, hist in (cas.get("latency_hist") or {}).items():
         if hist:
             p.histogram("dvt_cascade_latency_seconds", hist,
@@ -536,6 +568,40 @@ def _render_cascade_metrics(p, cas: dict) -> None:
                         help="End-to-end cascade request latency by "
                              "answering tier (escalations land in "
                              "'big' and include the front attempt)")
+
+
+def _render_brownout_metrics(p, bo: dict) -> None:
+    """Emit the dvt_brownout_* series from the reserved ``brownout``
+    stats block (serve/brownout.py ``BrownoutController.stats()``;
+    docs/OBSERVABILITY.md tabulates these)."""
+    p.gauge("dvt_brownout_level", bo.get("level"), {},
+            help="Degradation ladder level: 0 normal, 1 shed-optional, "
+                 "2 degrade-quality, 3 hard-shed")
+    p.gauge("dvt_brownout_forced",
+            -1 if bo.get("forced") is None else bo.get("forced"), {},
+            help="Operator-pinned level (-1 = signals in control)")
+    p.counter("dvt_brownout_transitions_total",
+              bo.get("transitions_up"), {"direction": "up"},
+              help="Edge-triggered ladder level changes")
+    p.counter("dvt_brownout_transitions_total",
+              bo.get("transitions_down"), {"direction": "down"})
+    for lvl, n in sorted((bo.get("level_entries") or {}).items()):
+        p.counter("dvt_brownout_level_entries_total", n,
+                  {"level": str(lvl)},
+                  help="Times the ladder entered each level going up")
+    sig = bo.get("signals") or {}
+    p.gauge("dvt_brownout_pressure_ms", sig.get("pressure_ms"), {},
+            help="Max queue_depth x bucket exec EWMA across engines — "
+                 "the engage signal")
+    p.gauge("dvt_brownout_occupancy", sig.get("occupancy"), {},
+            help="Max engine compute duty cycle at the last tick")
+    p.gauge("dvt_brownout_shed_rate", sig.get("shed_rate"), {},
+            help="Admission sheds / offered over the last tick window")
+    p.counter("dvt_brownout_ticks_total", bo.get("ticks"), {},
+              help="Ladder decisions taken")
+    p.counter("dvt_brownout_signal_errors_total",
+              bo.get("signal_errors"), {},
+              help="Engine signal reads that raised mid-teardown")
 
 
 def _render_engine_metrics(p, name: str, s: dict) -> None:
@@ -650,6 +716,10 @@ def _render_engine_metrics(p, name: str, s: dict) -> None:
               lab, help="Spans sealed into the ring")
     p.counter("dvt_serve_slow_traces_total", tr.get("slow_sampled"),
               lab, help="Traces over the slow-request threshold")
+    p.counter("dvt_serve_slow_suppressed_total",
+              tr.get("slow_suppressed"), lab,
+              help="Slow-trace emissions dropped at brownout L1+ "
+                   "(ring and stage sums still record)")
     for stage, secs in (tr.get("stage_s_total") or {}).items():
         p.counter("dvt_serve_stage_seconds_total", secs,
                   {**lab, "stage": stage},
@@ -663,6 +733,7 @@ class _Handler(BaseHTTPRequestHandler):
     _span = None
     _raw_body = None  # raw payload bytes — the cache's content address
     _tier = None  # cascade tier that answered ("front"/"big")
+    _degraded = False  # True when brownout degraded this answer
     # chunked-response state: edge._handle sets _edge_stream on its
     # shim; _reply_stream parks the body generator on _stream for the
     # event loop to pump (serve/edge.py), or drains inline without it
@@ -805,6 +876,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._tier, result = cascade.infer(
                 x, deadline_ms=deadline_ms, span=self._span,
                 force_big=force_big)
+            if self._tier == CASCADE_DEGRADED:
+                # brownout L2 forced a sub-threshold front answer: the
+                # tier header stays "front" (it IS the front tier), the
+                # degraded marker carries the quality caveat
+                self._tier = "front"
+                self._degraded = True
         elif plane is not None:
             # plane routing: canary/shadow splits + cross-version
             # resubmission happen behind this call, not per-engine
@@ -859,6 +936,7 @@ class _Handler(BaseHTTPRequestHandler):
         """
         span = self._span
         qos = getattr(self.server, "qos", None)
+        bo = getattr(self.server, "brownout", None)
         tenant = ""
         t0 = time.monotonic()
         if qos is not None:
@@ -894,6 +972,14 @@ class _Handler(BaseHTTPRequestHandler):
                     str(getattr(model, "infer_dtype", "")),
                     payload_digest(self._raw_body))
                 blob = cache.get(key)
+                if blob is None and bo is not None and bo.at_least(2):
+                    # brownout L2: an exact miss may still have an
+                    # answer under a PRIOR params version — stale but
+                    # well-formed beats a 429 when the engine is
+                    # saturated; the response carries X-DVT-Degraded
+                    blob = cache.get_stale(key)
+                    if blob is not None:
+                        self._degraded = True
                 if blob is not None:
                     self._cache_hit = True
                     if span is not None:
@@ -908,7 +994,9 @@ class _Handler(BaseHTTPRequestHandler):
             adm = getattr(engine, "admission", None)
             shed = qos.check_pressure(
                 tenant, getattr(engine, "queue_depth", 0),
-                adm.max_queue if adm is not None else 0)
+                adm.max_queue if adm is not None else 0,
+                floor=bo.qos_pressure_floor() if bo is not None
+                else 0.0)
             if shed is not None:
                 raise self._shed_429(shed)
         _, row = self._infer_row(body, path_model)
@@ -952,6 +1040,9 @@ class _Handler(BaseHTTPRequestHandler):
         qos = getattr(srv, "qos", None)
         if qos is not None:
             out["qos"] = qos.stats()
+        bo = getattr(srv, "brownout", None)
+        if bo is not None:
+            out["brownout"] = bo.stats()
         return out
 
     def _add_batch_block(self, stats: dict) -> None:
@@ -1137,6 +1228,14 @@ class _Handler(BaseHTTPRequestHandler):
                 "text/plain; version=0.0.4; charset=utf-8")
         elif path == "/v1/jobs" or path.startswith("/v1/jobs/"):
             self._jobs_get(path)
+        elif path == "/v1/brownout":
+            bo = getattr(self.server, "brownout", None)
+            if bo is None:
+                self._reply(503, {"error": "brownout controller is not "
+                                           "enabled (cli.serve "
+                                           "--brownout)"})
+                return
+            self._reply(200, bo.stats())
         elif path == "/v1/traces":
             params = parse_qs(query)
             n = int(params.get("n", ["32"])[0])
@@ -1172,6 +1271,9 @@ class _Handler(BaseHTTPRequestHandler):
             if path == "/v1/jobs":
                 self._reply(*self._jobs_post())
                 return
+            if path == "/v1/brownout":
+                self._reply(*self._brownout_post())
+                return
             path_model = None
             parts = path.split("/")
             # /v1/models/<name>/<verb>: the multi-model and lifecycle
@@ -1198,15 +1300,19 @@ class _Handler(BaseHTTPRequestHandler):
             body = self._body()
             self._cache_hit = False
             self._tier = None
+            self._degraded = False
             blob = self._infer_route(path, body, path_model, debug)
             # X-DVT-Cache lets clients (and the trace bench) split
             # hit/miss latency without a debug span per request;
-            # X-DVT-Tier reports which cascade tier answered
+            # X-DVT-Tier reports which cascade tier answered;
+            # X-DVT-Degraded marks brownout-degraded answers
             headers = {}
             if self._cache_hit:
                 headers["X-DVT-Cache"] = "hit"
             if self._tier is not None:
                 headers[TIER_HEADER] = self._tier
+            if self._degraded:
+                headers[DEGRADED_HEADER] = "1"
             self._reply_raw(200, blob, "application/json",
                             headers=headers or None)
         except ServeError as e:
@@ -1251,6 +1357,31 @@ class _Handler(BaseHTTPRequestHandler):
                         eng.stop(drain_deadline=deadline)
         return {"status": "draining", "already_draining": already,
                 "drain_deadline_s": deadline}
+
+    def _brownout_post(self) -> tuple:
+        """POST /v1/brownout → (status, payload): the operator
+        override.  Body {"force": 0..3} pins the ladder at a level
+        (pre-shedding load before a known spike, or testing the
+        degraded path in prod); {"force": null} returns control to the
+        signals.  The reply is the controller's live stats so the
+        operator sees the resulting state in the same exchange."""
+        bo = getattr(self.server, "brownout", None)
+        if bo is None:
+            return 503, {"error": "brownout controller is not enabled "
+                                  "(cli.serve --brownout)"}
+        body = self._body()
+        if "force" not in body:
+            raise ServeError(400, "body needs 'force': 0..3 to pin the "
+                                  "ladder, null to release")
+        force = body["force"]
+        if force is not None:
+            try:
+                force = int(force)
+            except (TypeError, ValueError) as e:
+                raise ServeError(
+                    400, f"bad force level: {body['force']!r}") from e
+        bo.force(force)
+        return 200, bo.stats()
 
     def _lifecycle(self, name: str, verb: str) -> tuple:
         """POST /v1/models/<name>/reload|promote|rollback → (status,
@@ -1334,7 +1465,8 @@ class ServeServer:
                  tracer=None, plane=None, deploy=None, edge: bool = True,
                  max_connections: int = DEFAULT_MAX_CONNECTIONS,
                  http_workers: int = 8, response_cache=None, qos=None,
-                 jobs=None, batch_sched=None, cascade=None):
+                 jobs=None, batch_sched=None, cascade=None,
+                 brownout=None):
         if edge:
             self.httpd = EdgeServer((host, port), _Handler,
                                     max_connections=max_connections,
@@ -1367,6 +1499,10 @@ class ServeServer:
         # requests naming its big model route front-first with
         # calibrated escalation; needs the plane (both tiers live there)
         self.httpd.cascade = cascade
+        # brownout ladder (serve/brownout.py, None = off): the request
+        # path probes it for the L2 stale-cache/degraded answers and
+        # the L3 QoS pressure floor; /v1/brownout exposes force/stats
+        self.httpd.brownout = brownout
         if tracer is None:
             # share the first engine's tracer so handler-created spans
             # land in the same ring /v1/traces reads

@@ -379,6 +379,23 @@ class AgreementHistogram:
             self._total = [0] * self.bins
             self._agree = [0] * self.bins
 
+    def restore(self, total, agree):
+        """Adopt persisted per-bin counts — the cascade calibration
+        ledger's boot replay (serve/cascade.py).  Shape and sanity are
+        the caller's digest check's problem; this only enforces that
+        the counts fit THIS histogram's binning."""
+        total = [int(x) for x in total]
+        agree = [int(x) for x in agree]
+        if len(total) != self.bins or len(agree) != self.bins:
+            raise ValueError(f"persisted bins {len(total)} != "
+                             f"{self.bins}")
+        if any(a > t or t < 0 or a < 0
+               for t, a in zip(total, agree)):
+            raise ValueError("persisted counts are inconsistent")
+        with self._lock:
+            self._total = total
+            self._agree = agree
+
     def threshold(self, min_agreement: float,
                   min_sample: int) -> float | None:
         """Smallest bin lower-edge t where the agreement of all samples
@@ -473,6 +490,11 @@ class ModelControlPlane:
         self.rollbacks = 0  # guarded-by: _lock
         self.reverts = 0  # guarded-by: _lock
         self.resubmitted = 0  # guarded-by: _lock
+        # optional BrownoutController (serve/brownout.py): at L1+ the
+        # shadow duplicate is optional work and pauses (the shadow
+        # phase just compares more slowly); read racily, None = off
+        self.brownout = None
+        self.shadow_paused = 0  # guarded-by: _lock
 
     # -- deployment --------------------------------------------------------
 
@@ -656,7 +678,14 @@ class ModelControlPlane:
         # compared against the primary then discarded — the candidate
         # never answers a client while shadowing
         if shadow is not None and tick % shadow[1] == 0:
-            self._shadow_submit(shadow[0], image, inner)
+            bo = self.brownout
+            if bo is not None and bo.at_least(1):
+                # brownout L1+: the duplicate is optional work — the
+                # shadow phase compares more slowly, nothing breaks
+                with self._lock:
+                    self.shadow_paused += 1
+            else:
+                self._shadow_submit(shadow[0], image, inner)
 
     def _request_done(self, inner: Future, name, mv, image, deadline_ms,
                       span, fut: Future, retries: int, is_canary: bool):
@@ -800,7 +829,9 @@ class ModelControlPlane:
             self.reloads += 1
         worker.start()
         if wait:
-            worker.join()
+            # wait=True's contract is "return only once the reload has
+            # resolved" — compile time is unbounded, so no timeout
+            worker.join()  # dvtlint: disable=DVT007
             with self._lock:
                 versions = list(self._table.get(name, []))
             last = versions[-1].describe() if versions else None
@@ -1272,6 +1303,7 @@ class ModelControlPlane:
                      "rollbacks": self.rollbacks,
                      "reverts": self.reverts,
                      "resubmitted": self.resubmitted,
+                     "shadow_paused": self.shadow_paused,
                      "policy": self.policy.describe()}
         models = {}
         for name, (active, versions) in sorted(snapshot.items()):

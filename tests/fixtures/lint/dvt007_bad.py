@@ -1,0 +1,22 @@
+"""DVT007 bad: blocking primitives with no timeout — each one pins its
+thread forever the moment the peer stalls."""
+
+import queue
+import socket
+import threading
+from http.client import HTTPConnection
+
+
+def drain(q: "queue.Queue"):
+    return q.get()  # blocking queue get, no timeout
+
+
+def supervise(worker: threading.Thread, done: threading.Event):
+    done.wait()  # event wait, no timeout
+    worker.join()  # thread join, no timeout
+
+
+def dial(host, port):
+    conn = HTTPConnection(host, port)  # no connect timeout
+    sock = socket.create_connection((host, port))  # no connect timeout
+    return conn, sock

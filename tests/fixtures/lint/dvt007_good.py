@@ -1,0 +1,37 @@
+"""DVT007 good: every blocking primitive carries a timeout (or is
+provably non-blocking); the one deliberate forever-block is
+escape-hatched with its reason."""
+
+import queue
+import socket
+import threading
+from http.client import HTTPConnection
+
+
+def drain(q: "queue.Queue"):
+    return q.get(timeout=1.0)
+
+
+def drain_nonblocking(q: "queue.Queue"):
+    return q.get_nowait()
+
+
+def lookup(cfg: dict):
+    # dict.get takes a key — positional args mean "not a blocking get"
+    return cfg.get("key")
+
+
+def supervise(worker: threading.Thread, done: threading.Event):
+    if done.wait(timeout=5.0):
+        worker.join(timeout=5.0)
+
+
+def dial(host, port):
+    conn = HTTPConnection(host, port, timeout=10.0)
+    sock = socket.create_connection((host, port), timeout=10.0)
+    return conn, sock
+
+
+def reap(worker: threading.Thread):
+    # process shutdown: waiting forever for the worker IS the contract
+    worker.join()  # dvtlint: disable=DVT007

@@ -361,6 +361,92 @@ def test_429_propagates_with_retry_after():
             s.kill()
 
 
+def test_retry_budget_token_bucket():
+    """Backend-level bucket arithmetic: a retry spends 1.0, a REAL
+    success refills +ratio capped at burst, and the bucket starts full
+    so the first failover after boot is never blocked."""
+    b = Backend("127.0.0.1:1", retry_ratio=0.5, retry_burst=2.0)
+    assert b.retry_tokens_left() == 2.0
+    assert b.try_retry() and b.try_retry()
+    assert not b.try_retry()             # dry: the storm dies here
+    assert b.retries_granted == 2 and b.retries_denied == 1
+    b.begin()
+    b.done_success(0.01)
+    assert b.retry_tokens_left() == pytest.approx(0.5)
+    for _ in range(10):                  # refill is capped at burst
+        b.begin()
+        b.done_success(0.01)
+    assert b.retry_tokens_left() == pytest.approx(2.0)
+
+
+def test_retry_storm_is_bounded_by_budget():
+    """N aggressive closed-loop clients against a 100%-shedding fleet
+    must not amplify load: with zero successes nothing refills the
+    buckets, so granted retries stop at the boot burst per backend and
+    total upstream attempts stay at offered + burst x backends.  On
+    recovery the buckets refill +ratio per success — gradual re-arming,
+    not a thundering herd of banked retries on the first good answer."""
+    stubs = [StubBackend("a"), StubBackend("b")]
+    for s in stubs:
+        s.mode = "shed"
+    burst = 4.0
+    gw = Gateway([s.url for s in stubs], probe_interval_s=60,
+                 retry_budget=3, retry_budget_ratio=0.1,
+                 retry_budget_burst=burst, backoff_ms=1.0,
+                 backoff_max_ms=2.0).start()
+    srv = GatewayServer(gw, port=0).start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    offered = 25 * 4
+    codes = []
+    budgets = []
+    lock = threading.Lock()
+
+    def client():
+        for _ in range(25):
+            try:
+                _post(base)
+                with lock:
+                    codes.append(200)
+            except urllib.error.HTTPError as exc:
+                exc.read()
+                with lock:
+                    codes.append(exc.code)
+                    budgets.append(
+                        exc.headers.get("X-DVT-Retry-Budget"))
+
+    threads = [threading.Thread(target=client) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    try:
+        assert len(codes) == offered and set(codes) == {429}
+        # the budget state rode every shed back to the client, and by
+        # the end it reported dry — cooperating clients stop retrying
+        assert all(b is not None for b in budgets)
+        assert float(budgets[-1]) < 1.0
+        c = gw.counters()
+        # granted retries never exceed the boot burst across the fleet
+        assert c["retries"] <= burst * len(stubs)
+        assert c["retry_budget_denied"] > 0
+        assert sum(s.requests for s in stubs) <= \
+            offered + burst * len(stubs)
+        # recovery: successes refill +ratio each, so the post-outage
+        # allowance grows from ~0 — it does NOT snap back to burst
+        for s in stubs:
+            s.mode = "ok"
+        for _ in range(10):
+            status, _, _ = _post(base)
+            assert status == 200
+        for b in gw.backends:
+            assert b.retry_tokens_left() < 2.0
+    finally:
+        srv.shutdown()
+        gw.stop()
+        for s in stubs:
+            s.kill()
+
+
 def test_unavailable_healthz_leaves_routing_without_penalty():
     """A 503 healthz (draining) removes the backend from routing with
     NO breaker damage, and a 200 probe restores it."""

@@ -31,6 +31,7 @@ EXPECTED_BAD = {
     "DVT004": 4,  # time.*, np.random, print, attribute store
     "DVT005": 2,  # local t0 interval + self-attr interval
     "DVT006": 3,  # unannotated, bare, reasonless-noqa
+    "DVT007": 5,  # queue get, event wait, thread join, 2 timeout-less dials
 }
 
 
