@@ -50,6 +50,7 @@ serve-smoke: lint lint-test
 	$(PY) tests/obs_smoke.py
 	$(PY) tests/mesh_smoke.py
 	$(PY) tests/workload_smoke.py
+	$(PY) tests/detect_smoke.py
 	$(PY) tests/batch_smoke.py
 	$(PY) tests/cascade_smoke.py
 	$(PY) tests/brownout_smoke.py
@@ -115,6 +116,16 @@ workload-smoke:
 # accounting, the exact 4x generate D2H win, cache/verb/agree gates)
 workload-test:
 	$(PY) -m pytest tests/test_workloads.py -q -m serve
+
+# device-side detect decode end to end: YOLO behind the plane over
+# real HTTP (fault-injected), the decode -> threshold -> top-k ->
+# class-wise NMS epilogue compiled into the bucket programs (bulk D2H
+# is exactly K fixed rows per image, not the dense anchor pyramid), a
+# reload -> shadow (greedy-IoU agreement gate on live traffic) ->
+# canary -> operator-promote rollout under detect load with zero
+# client errors, and workload="detect" D2H accounting on /metrics
+detect-smoke:
+	$(PY) tests/detect_smoke.py
 
 # the offline batch tier end to end: a bulk job POSTed over HTTP
 # drains through the trough-filling scheduler while interactive
@@ -307,7 +318,7 @@ list:
 	serve-multi serve-chaos gateway-smoke gateway-test obs-smoke \
 	edge-smoke edge-test input-smoke input-test \
 	obs-test model-smoke model-test quant-smoke quant-test \
-	workload-smoke workload-test \
+	workload-smoke workload-test detect-smoke \
 	mesh-smoke mesh-test \
 	deploy-smoke deploy-test batch-smoke batch-test \
 	cascade-smoke cascade-test lint lint-test list

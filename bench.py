@@ -1070,8 +1070,8 @@ def bench_serve_obs(**kwargs) -> dict:
     return on
 
 
-def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
-                                     "dcgan"),
+def bench_serve_mix(models: tuple = ("lenet5", "yolov3_toy",
+                                     "hourglass_toy", "dcgan"),
                     loads: tuple = (8,), duration_s: float = 2.0,
                     max_batch: int = 8, max_wait_ms: float = 2.0,
                     pipeline_depth: int = 2,
@@ -1084,13 +1084,15 @@ def bench_serve_mix(models: tuple = ("lenet5", "hourglass_toy",
     picking a model per request from a Zipf-ish popularity
     distribution (weight ∝ 1/rank^s in list order — the first model
     is the hot one, the tail is the long tail that keeps getting
-    evicted).  The default mix spans three workloads — classify
-    (lenet5), pose (hourglass_toy), generate (dcgan) — so the bench
-    exercises the workload adapters' input codecs (latent vectors for
-    DCGAN) and fused epilogues (serve/workloads.py).  The JSON
-    reports per-model/per-workload p50/p95/p99 + img/s per load
-    point, per-engine D2H bytes/batch (where generate's on-device
-    uint8 encode shows its 4× output-wire win), and the weight
+    evicted).  The default mix spans ALL FOUR workloads — classify
+    (lenet5), detect (yolov3_toy), pose (hourglass_toy), generate
+    (dcgan) — so the bench exercises the workload adapters' input
+    codecs (latent vectors for DCGAN) and fused epilogues
+    (serve/workloads.py).  The JSON reports per-model/per-workload
+    p50/p95/p99 + img/s per load point, per-engine D2H bytes/batch
+    (where generate's on-device uint8 encode shows its 4× output-wire
+    win and detect's fused decode ships K boxes instead of the dense
+    pyramid), and the weight
     cache's hit rate / eviction / spill counters, so the latency tax
     of serving more models than the HBM budget holds is a tracked
     number, not folklore (docs/SERVING.md "Model lifecycle & weight
@@ -2832,10 +2834,11 @@ def main():
                         "per-workload p99 + D2H bytes/batch + cache "
                         "hit rate per load point (docs/SERVING.md)")
     p.add_argument("--serve-mix-models",
-                   default="lenet5,hourglass_toy,dcgan",
+                   default="lenet5,yolov3_toy,hourglass_toy,dcgan",
                    help="comma-separated configs for --serve-mix "
                         "(list order = popularity rank; default spans "
-                        "classify/pose/generate workloads)")
+                        "all four workloads: classify/detect/pose/"
+                        "generate)")
     p.add_argument("--hbm-budget-mb", type=float, default=0.0,
                    help="weight-cache device-byte budget for "
                         "--serve-mix (0 = uncapped)")

@@ -165,6 +165,19 @@ def _parse_mesh_arg(spec: str) -> tuple[int, int]:
     return sizes[0], sizes[1]
 
 
+def _detect_knobs(args) -> dict:
+    """The ``--detect-*`` flags as registry.load_checkpoint kwargs —
+    getattr'd so programmatic Namespace callers (smokes, tests) that
+    predate the knobs keep the device-decode defaults."""
+    return dict(
+        detect_decode=str(getattr(args, "detect_decode", "device")),
+        detect_topk=int(getattr(args, "detect_topk", 100) or 100),
+        detect_score_threshold=float(
+            getattr(args, "detect_score_threshold", 0.05)),
+        detect_iou_threshold=float(
+            getattr(args, "detect_iou_threshold", 0.5)))
+
+
 def build_server(args):
     """argparse namespace → (engine, ServeServer); shared with the smoke
     test so `make serve-smoke` boots exactly the production wiring.
@@ -225,7 +238,8 @@ def build_server(args):
                                       wire_dtype=wire_dtype,
                                       infer_dtype=infer_dtype,
                                       calib_batches=calib_batches,
-                                      calib_dir=calib_dir)
+                                      calib_dir=calib_dir,
+                                      **_detect_knobs(args))
     buckets = [int(b) for b in args.buckets.split(",")] if args.buckets \
         else None
     fault_spec = getattr(args, "faults", None)
@@ -500,7 +514,8 @@ def _build_plane_server(args, registry, wire_dtype: str,
             infer_dtype=infer_dtype,
             calib_batches=int(getattr(args, "calib_batches", 2) or 2),
             calib_dir=getattr(args, "calib_dir", None),
-            cascade_topk=front_k)
+            cascade_topk=front_k,
+            **_detect_knobs(args))
         plane.deploy(sm, workdir=workdir)
     cascade = None
     if cascade_spec is not None:
@@ -848,6 +863,31 @@ def main(argv=None):
                    help="entries in the front tier's fused device-side "
                         "top-k confidence epilogue (bounds top_k in "
                         "front-served responses)")
+    # -- detect decode (docs/SERVING.md "Workloads") --
+    p.add_argument("--detect-decode", choices=("device", "host"),
+                   default="device",
+                   help="where detection models decode: 'device' "
+                        "(default) fuses decode → score floor → top-k "
+                        "→ class-wise NMS into the bucket programs so "
+                        "D2H ships K fixed-size boxes per image (≥100× "
+                        "fewer bytes than the dense pyramid at 416²); "
+                        "'host' keeps the dense head outputs on the "
+                        "wire and decodes per request (the pre-fusion "
+                        "baseline)")
+    p.add_argument("--detect-topk", type=int, default=100,
+                   help="max detections per image in the fused detect "
+                        "decode (the K of the fixed-size output and "
+                        "the D2H bytes/image ≈ K·28)")
+    p.add_argument("--detect-score-threshold", type=float, default=0.05,
+                   help="compiled score FLOOR of the fused detect "
+                        "decode — per-request 'score_threshold' values "
+                        "above it trim host-side, values below it "
+                        "clamp to it (sub-floor boxes never survived "
+                        "NMS on device)")
+    p.add_argument("--detect-iou-threshold", type=float, default=0.5,
+                   help="IoU threshold of the fused class-wise NMS "
+                        "(YOLO family; CenterNet's peak decode is "
+                        "NMS-free)")
     # -- offline batch tier (docs/BATCH.md) --
     p.add_argument("--jobs-dir", default=None,
                    help="enable the offline batch-inference tier "

@@ -42,15 +42,31 @@ def broadcast_iou(box_a, box_b, eps: float = 1e-9):
     return inter / (union + eps)
 
 
+#: class-offset magnitude for class-wise NMS: boxes are normalized to
+#: [0, 1] (clipped decode keeps them within a few units), so shifting
+#: each box by ``class_id * 4`` puts different classes on disjoint
+#: diagonals — their IoU is exactly 0 and they can never suppress each
+#: other, while same-class IoU is unchanged (the standard batched
+#: class-aware NMS trick, static shapes preserved)
+_CLASS_OFFSET = 4.0
+
+
 def nms_single(boxes, scores, max_outputs: int, iou_threshold: float = 0.5,
-               score_threshold: float = 0.0):
+               score_threshold: float = 0.0, classes=None):
     """Greedy NMS for one image, static output size.
 
     boxes: (N, 4) corners; scores: (N,).  Returns (idx, sel_scores, valid):
     (K,) selected indices, their scores, and a 0/1 validity mask.
+    ``classes`` (N,) int switches to CLASS-WISE suppression: boxes only
+    suppress same-class neighbours (via the class-offset trick above);
+    None keeps the class-agnostic reference behavior.
     """
     scores = jnp.where(scores >= score_threshold, scores, -jnp.inf)
-    iou = broadcast_iou(boxes, boxes)  # (N, N)
+    iou_boxes = boxes
+    if classes is not None:
+        iou_boxes = boxes + (classes.astype(boxes.dtype)
+                             * _CLASS_OFFSET)[..., None]
+    iou = broadcast_iou(iou_boxes, iou_boxes)  # (N, N)
 
     def step(live_scores, _):
         i = jnp.argmax(live_scores)
@@ -68,8 +84,14 @@ def nms_single(boxes, scores, max_outputs: int, iou_threshold: float = 0.5,
 
 
 def batched_nms(boxes, scores, max_outputs: int, iou_threshold: float = 0.5,
-                score_threshold: float = 0.0):
-    """vmap of nms_single over the batch: (B,N,4),(B,N) → (B,K) each."""
+                score_threshold: float = 0.0, classes=None):
+    """vmap of nms_single over the batch: (B,N,4),(B,N) → (B,K) each.
+    ``classes`` (B,N) int enables class-wise suppression per image."""
+    if classes is not None:
+        return jax.vmap(
+            lambda b, s, c: nms_single(b, s, max_outputs, iou_threshold,
+                                       score_threshold, classes=c)
+        )(boxes, scores, classes)
     return jax.vmap(
         lambda b, s: nms_single(b, s, max_outputs, iou_threshold,
                                 score_threshold))(boxes, scores)

@@ -25,7 +25,13 @@ Routes (JSON in, JSON out):
                        tracer summary (per-stage time aggregates)
     POST /v1/classify  {"pixels": [[...]] | "image_b64": "...",
                         "model"?, "deadline_ms"?, "top_k"?}
-    POST /v1/detect    same inputs + "score_threshold"?; YOLO models
+    POST /v1/detect    same inputs + "score_threshold"?; detection
+                       models (YOLO, CenterNet) — decode → threshold →
+                       top-k → class-wise NMS run ON DEVICE in the
+                       fused epilogue, so D2H ships K fixed-size boxes
+                       per image, and the reply carries
+                       {"num_detections", "detections": [{box, score,
+                       class}]} with no padded/invalid rows
     POST /v1/pose      same image inputs; heatmap models (Stacked
                        Hourglass) — the traced on-device epilogue
                        decodes heatmaps to {"keypoints": [{x, y,

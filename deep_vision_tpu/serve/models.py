@@ -764,10 +764,12 @@ class ModelControlPlane:
         DISCARD the shadow output (it never reaches a client).  The
         workload adapter owns the metric (serve/workloads.py): top-1
         argmax for classify, PCK-style keypoint proximity for pose,
-        output-digest equality for generate; ``agree()`` returning
-        None means "not comparable" (detect pytrees, Shed/Quarantined
-        rows) — discarded without entering the compared count, the
-        same accounting shape as before workloads existed."""
+        output-digest equality for generate, greedy IoU≥0.5 class-
+        matched pairing fraction (the mAP proxy) for detect;
+        ``agree()`` returning None means "not comparable"
+        (Shed/Quarantined rows, host-path detect pyramids) — discarded
+        without entering the compared count, the same accounting shape
+        as before workloads existed."""
         try:
             pr, sr = p.result(), s.result()
         except Exception:  # noqa: BLE001 — either side failed: nothing to compare
@@ -863,6 +865,16 @@ class ModelControlPlane:
         # across reloads (workloads.ClassifyWorkload.make_epilogue
         # gates on this attribute at bucket-compile time)
         sm.cascade_topk = getattr(old, "cascade_topk", 0)
+        # detect models keep their fused decode knobs across reloads
+        # too (workloads.DetectWorkload.make_epilogue reads them at
+        # bucket-compile time) — a reload must not silently flip a
+        # host-pinned baseline to device decode or change K/thresholds
+        sm.detect_decode = getattr(old, "detect_decode", "device")
+        sm.detect_topk = getattr(old, "detect_topk", 100)
+        sm.detect_score_threshold = getattr(
+            old, "detect_score_threshold", 0.05)
+        sm.detect_iou_threshold = getattr(
+            old, "detect_iou_threshold", 0.5)
         sm.restored_step = info.get("step")
         sm.restore_fallback = bool(info.get("fallback"))
         sm.restored_mtime = info.get("mtime")

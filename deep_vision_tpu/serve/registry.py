@@ -131,6 +131,19 @@ class ServingModel:
         # reads (top1_class, top1_prob) off the bulk D2H instead of
         # dense logits.  0 = plain dense-logits serving.
         self.cascade_topk: int = 0
+        # detect decode knobs (serve/workloads.py DetectWorkload),
+        # read at bucket-compile time by make_epilogue and copied
+        # across reloads by models._load_model.  "device" (default)
+        # fuses decode → threshold → top-k → class-wise NMS into the
+        # bucket programs so D2H ships K fixed-size boxes per image;
+        # "host" keeps the dense pyramid on the wire and decodes in
+        # respond() — the A/B baseline and D2H-comparison path.  The
+        # score threshold is the compiled FLOOR: per-request
+        # thresholds above it trim host-side.
+        self.detect_decode: str = "device"
+        self.detect_topk: int = 100
+        self.detect_score_threshold: float = 0.05
+        self.detect_iou_threshold: float = 0.5
 
     def compile_bucket(self, batch: int):
         raise NotImplementedError
@@ -237,8 +250,14 @@ class ServingModel:
                 f"{devs} ({jax.devices()[0].platform})")
 
     def describe(self) -> dict:
+        d = {}
+        if self.workload.verb == "detect":
+            d["detect"] = {"decode": self.detect_decode,
+                           "top_k": self.detect_topk,
+                           "score_threshold": self.detect_score_threshold,
+                           "iou_threshold": self.detect_iou_threshold}
         return {"name": self.name, "task": self.task,
-                "workload": self.workload.verb,
+                "workload": self.workload.verb, **d,
                 "input_shape": list(self.input_shape),
                 "num_classes": self.num_classes,
                 "fixed_batch": self.fixed_batch,
@@ -471,7 +490,9 @@ class CheckpointServingModel(ServingModel):
         # AOT program as the model body — the output-side mirror of the
         # normalize prologue: pose decodes heatmaps→keypoints on device
         # (D2H moves K coordinate pairs, not H×W×K heatmaps), generate
-        # encodes [-1,1] floats→uint8 (D2H moves 1 byte/pixel)
+        # encodes [-1,1] floats→uint8 (D2H moves 1 byte/pixel), detect
+        # decodes + NMSes down to K fixed-size boxes per image (D2H
+        # moves ~K·28 B instead of the dense multi-scale pyramid)
         post = self.workload.make_epilogue(self)
 
         def _finish(out):  # dvtlint: traced
@@ -687,7 +708,12 @@ class ModelRegistry:
                         calib_batches: int = 2,
                         calib_dir: str | None = None,
                         ingest: str = "pallas",
-                        cascade_topk: int = 0) -> ServingModel:
+                        cascade_topk: int = 0,
+                        detect_decode: str = "device",
+                        detect_topk: int = 100,
+                        detect_score_threshold: float = 0.05,
+                        detect_iou_threshold: float = 0.5
+                        ) -> ServingModel:
         """``wire_dtype``: what clients ship and the engine H2D-transfers
         — "uint8" (raw 0–255 pixels, normalization fused into the bucket
         programs; the ``cli.serve`` default) or "float32" (the original
@@ -701,7 +727,15 @@ class ModelRegistry:
         serve-prologue ("pallas", the default) or the XLA fallback.
         ``cascade_topk`` > 0 marks a cascade FRONT tier: the classify
         workload fuses its confidence epilogue (softmax + top-K on
-        device) into the bucket programs (serve/cascade.py)."""
+        device) into the bucket programs (serve/cascade.py).
+
+        ``detect_*`` configure detection models' fused decode
+        (serve/workloads.py DetectWorkload): ``detect_decode="device"``
+        (default) traces decode → score floor → top-``detect_topk`` →
+        class-wise NMS into the bucket programs so the bulk D2H ships
+        K fixed-size boxes per image; "host" keeps the dense pyramid
+        rows and decodes per request in respond() — the A/B baseline.
+        Non-detect models ignore them."""
         from deep_vision_tpu.core.config import get_config
         from deep_vision_tpu.core.restore import load_state
 
@@ -715,6 +749,13 @@ class ModelRegistry:
                                     calib_dir=calib_dir,
                                     ingest=ingest)
         sm.cascade_topk = int(cascade_topk)
+        if str(detect_decode) not in ("device", "host"):
+            raise ValueError(f"detect_decode '{detect_decode}' "
+                             f"unsupported (have ('device', 'host'))")
+        sm.detect_decode = str(detect_decode)
+        sm.detect_topk = int(detect_topk)
+        sm.detect_score_threshold = float(detect_score_threshold)
+        sm.detect_iou_threshold = float(detect_iou_threshold)
         sm.restored_step = info.get("step")
         sm.restore_fallback = bool(info.get("fallback"))
         sm.restored_mtime = info.get("mtime")

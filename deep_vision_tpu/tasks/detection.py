@@ -287,7 +287,8 @@ def postprocess(outputs, num_classes: int, max_outputs: int = 100,
                 iou_threshold: float = 0.5, score_threshold: float = 0.1,
                 anchors: np.ndarray = YOLO_ANCHORS,
                 masks: np.ndarray = ANCHOR_MASKS,
-                pre_nms_top_k: int = 512):
+                pre_nms_top_k: int = 512,
+                class_aware: bool = False):
     """raw 3-scale outputs → (boxes (B,K,4) corners, scores (B,K),
     classes (B,K), valid (B,K)).
 
@@ -297,6 +298,13 @@ def postprocess(outputs, num_classes: int, max_outputs: int = 100,
     outside the top-k can never outrank one inside it, so results differ
     from exhaustive NMS only if >top_k−max_outputs of the leading boxes
     get suppressed — pick top_k ≫ max_outputs (default 512 ≫ 100).
+
+    ``class_aware=True`` makes suppression CLASS-WISE (a box only
+    suppresses same-class neighbours, via ops/boxes' class-offset
+    trick) — what the serving epilogue uses; the default keeps the
+    reference's class-agnostic eval behavior.  Fully jittable either
+    way: this whole function traces into the AOT bucket programs
+    (serve/workloads.DetectWorkload.make_epilogue).
     """
     all_boxes, all_scores, all_cls = [], [], []
     anchors = jnp.asarray(anchors)
@@ -317,7 +325,8 @@ def postprocess(outputs, num_classes: int, max_outputs: int = 100,
     boxes = jnp.take_along_axis(boxes, top_idx[..., None], axis=1)
     classes = jnp.take_along_axis(classes, top_idx, axis=1)
     idx, sel_scores, valid = batched_nms(
-        boxes, scores, max_outputs, iou_threshold, score_threshold)
+        boxes, scores, max_outputs, iou_threshold, score_threshold,
+        classes=classes if class_aware else None)
     sel_boxes = jnp.take_along_axis(boxes, idx[..., None], axis=1)
     sel_classes = jnp.take_along_axis(classes, idx, axis=1)
     return sel_boxes, sel_scores, sel_classes, valid

@@ -14,6 +14,27 @@ from deep_vision_tpu.core.config import (
 from deep_vision_tpu.models.centernet import CenterNet
 
 
+@register_config("centernet_toy")
+def centernet_toy():
+    """Small CenterNet at 64²→16² for smoke runs and the serving
+    device-decode tests (no reference counterpart — test
+    infrastructure): order-3 hourglass (2³ = 8 ≤ 64/4), one stack,
+    float32 so CPU tests skip the bf16 cast."""
+    return TrainConfig(
+        name="centernet_toy",
+        model=lambda: CenterNet(num_classes=3, num_stack=1, order=3,
+                                filters=(16, 16, 24, 24),
+                                dtype=jnp.float32),
+        task="centernet",
+        batch_size=8,
+        total_epochs=60,
+        optimizer=OptimizerConfig(name="adam", learning_rate=2.5e-4),
+        image_size=64,
+        num_classes=3,
+        half_precision=False,
+    )
+
+
 @register_config("centernet")
 def centernet():
     return TrainConfig(

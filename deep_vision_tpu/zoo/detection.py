@@ -41,6 +41,28 @@ def yolov3_voc():
     return _yolo("yolov3_voc", 20, 16)
 
 
+@register_config("yolov3_toy416")
+def yolov3_toy416():
+    """Tiny-width YOLOv3 at the REAL 416² input (no reference
+    counterpart — test infrastructure): the fixture for the serving
+    D2H-reduction gate, where the dense 3-scale pyramid is the full
+    10,647-anchor shape (52²+26²+13² grids × 3 anchors) but the model
+    body stays cheap enough to AOT-compile on a CPU host."""
+    return TrainConfig(
+        name="yolov3_toy416",
+        model=lambda: YoloV3(num_classes=3, dtype=jnp.float32,
+                             width=0.125, blocks=(1, 1, 1, 1, 1)),
+        task="detection",
+        batch_size=4,
+        total_epochs=60,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3,
+                                  grad_clip_norm=10.0),
+        image_size=416,
+        num_classes=3,
+        half_precision=False,
+    )
+
+
 @register_config("yolov3_toy")
 def yolov3_toy():
     """Tiny-width YOLOv3 at 64² for smoke runs, convergence tests, and

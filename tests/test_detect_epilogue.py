@@ -1,0 +1,383 @@
+"""Device-side detect decode (CPU, tier-1): the fused detect epilogue
+(serve/workloads.DetectWorkload.make_epilogue) traces decode → score
+floor → pre-NMS top-k → class-wise NMS into the AOT bucket programs so
+the drainer's bulk D2H ships K fixed-size boxes per image instead of
+the dense multi-scale pyramid.  Covered here:
+
+  * epilogue-vs-host-postprocess parity (identical kept set, scores
+    within 1e-5) on single-device, replicated, and 1×4 mesh engines
+    (conftest pins 8 virtual CPU devices);
+  * the ≥100× D2H reduction gate at the REAL 416² pyramid shape,
+    asserted from the engine's ``d2h_bytes_by_bucket`` counters;
+  * trim-by-valid ``respond``: ``num_detections``, no padded/invalid
+    rows, >= semantics at the threshold edge, empty-image answers;
+  * CenterNet through the same hook (family-switched decode, NMS-free);
+  * the detect shadow-agreement rule (greedy IoU≥0.5 class-matched
+    pairing): perfect / shifted / class-swapped / empty pairs;
+  * detect response-cache hits over real HTTP via ``X-DVT-Cache``.
+
+Heavyweight compiles live in module-scoped fixtures, one per config."""
+
+import copy
+import json
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deep_vision_tpu.ops.boxes import batched_nms, nms_single
+from deep_vision_tpu.serve.engine import BatchingEngine
+from deep_vision_tpu.serve.registry import ModelRegistry
+from deep_vision_tpu.serve.workloads import WORKLOADS
+
+pytestmark = pytest.mark.serve
+
+DETECT = WORKLOADS["detect"]
+#: fixed-size epilogue row: K·(16 + 4 + 4 + 4) bytes per image
+ROW_BYTES_PER_K = 16 + 4 + 4 + 4
+
+
+@pytest.fixture(scope="module")
+def yolo_serving(tmp_path_factory):
+    reg = ModelRegistry()
+    # empty workdir fixture → deterministic PRNGKey(0) random init
+    sm = reg.load_checkpoint(
+        "yolov3_toy", str(tmp_path_factory.mktemp("yolo_workdir")))
+    return reg, sm
+
+
+@pytest.fixture(scope="module")
+def yolo416_serving(tmp_path_factory):
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint(
+        "yolov3_toy416", str(tmp_path_factory.mktemp("yolo416_workdir")))
+    return reg, sm
+
+
+@pytest.fixture(scope="module")
+def centernet_serving(tmp_path_factory):
+    reg = ModelRegistry()
+    sm = reg.load_checkpoint(
+        "centernet_toy", str(tmp_path_factory.mktemp("cn_workdir")))
+    return reg, sm
+
+
+def _host_view(sm):
+    """The A/B baseline: same weights, epilogue disabled — dense
+    pyramid rows decoded host-side (the detect_decode knob the way
+    tests/test_workloads.py pins generate's output_wire)."""
+    sm_host = copy.copy(sm)
+    sm_host.detect_decode = "host"
+    return sm_host
+
+
+def _images(n, size):
+    return np.random.RandomState(0).randn(
+        n, size, size, 3).astype(np.float32)
+
+
+# -- parity: fused epilogue == host postprocess ----------------------------
+
+
+def test_epilogue_vs_host_postprocess_parity(yolo_serving):
+    """The device-decoded rows must match host ``postprocess`` over the
+    dense pyramid: identical kept set (classes + valid), boxes/scores
+    within 1e-5 — same knobs on both paths."""
+    import jax
+
+    from deep_vision_tpu.tasks.detection import postprocess
+
+    _, sm = yolo_serving
+    x = _images(2, 64)
+    dev = jax.device_get(sm.compile_bucket(2)(x))
+    assert set(dev) == {"boxes", "scores", "classes", "valid"}
+    k = sm.detect_topk
+    assert np.asarray(dev["boxes"]).shape == (2, k, 4)
+    assert np.asarray(dev["classes"]).dtype == np.int32
+
+    pyr = jax.device_get(_host_view(sm).compile_bucket(2)(x))
+    boxes, scores, classes, valid = postprocess(
+        pyr, sm.num_classes, max_outputs=sm.detect_topk,
+        iou_threshold=sm.detect_iou_threshold,
+        score_threshold=sm.detect_score_threshold, class_aware=True)
+    np.testing.assert_allclose(np.asarray(dev["boxes"]),
+                               np.asarray(boxes), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dev["scores"]),
+                               np.asarray(scores), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(dev["classes"]),
+                                  np.asarray(classes))
+    np.testing.assert_array_equal(np.asarray(dev["valid"]),
+                                  np.asarray(valid))
+
+    # respond() over either row shape answers identically
+    row_dev = {key: np.asarray(v)[0] for key, v in dev.items()}
+    row_host = [np.asarray(a)[0] for a in pyr]
+    r_dev = DETECT.respond(sm, {"score_threshold": 0.1}, row_dev)
+    r_host = DETECT.respond(_host_view(sm), {"score_threshold": 0.1},
+                            row_host)
+    assert r_dev["num_detections"] == r_host["num_detections"]
+    assert r_dev["detections"] == r_host["detections"]
+
+
+def test_replicated_and_mesh_engines_bit_identical(yolo_serving):
+    """for_device and 1×4 (data×model) mesh views of the same weights
+    produce BIT-identical device-decoded rows: tiny-yolo leaves sit
+    under the fallback sharder's min dim, so the mesh replicates and
+    the fused epilogue math is the same program."""
+    import jax
+
+    from deep_vision_tpu.parallel.mesh import make_mesh
+
+    _, sm = yolo_serving
+    x = _images(2, 64)
+    base = jax.device_get(sm.compile_bucket(2)(x))
+    devs = jax.devices()
+    views = {"replicated": sm.for_device(devs[1]),
+             "mesh_1x4": sm.for_mesh(
+                 make_mesh({"data": 1, "model": 4}, devices=devs[:4]))}
+    for label, view in views.items():
+        out = jax.device_get(view.compile_bucket(2)(x))
+        for key in base:
+            assert np.array_equal(np.asarray(base[key]),
+                                  np.asarray(out[key])), (label, key)
+
+
+# -- respond: trim-by-valid formatter --------------------------------------
+
+
+def test_respond_trims_to_valid_and_counts(yolo_serving):
+    _, sm = yolo_serving
+    k = sm.detect_topk
+    row = {"boxes": np.tile([0.1, 0.1, 0.4, 0.5], (k, 1)
+                            ).astype(np.float32),
+           "scores": np.linspace(0.9, 0.0, k, dtype=np.float32),
+           "classes": np.zeros(k, np.int32),
+           "valid": (np.arange(k) < 7).astype(np.float32)}
+    out = DETECT.respond(sm, {"score_threshold": 0.5}, row)
+    # valid rows 0..6 score 0.9 down to ~0.845 — all clear 0.5; the
+    # padded tail (valid=0) must NOT appear
+    assert out["num_detections"] == 7
+    assert len(out["detections"]) == 7
+    assert all(d["score"] >= 0.5 for d in out["detections"])
+
+    # >= at the threshold edge: a request threshold equal to a kept
+    # score keeps that box
+    edge = float(row["scores"][3])
+    out = DETECT.respond(sm, {"score_threshold": edge}, row)
+    assert out["num_detections"] == 4
+    assert out["detections"][-1]["score"] == pytest.approx(edge)
+
+    # sub-floor request thresholds clamp to the compiled floor (boxes
+    # under the floor never survived device NMS)
+    low = DETECT.respond(sm, {"score_threshold": 0.0}, row)
+    assert low["num_detections"] == 7
+
+
+def test_empty_image_answers_zero_detections(yolo_serving):
+    """A floor no random-init score can reach → all-invalid rows →
+    an empty, well-formed response (the empty-image edge)."""
+    import jax
+
+    _, sm = yolo_serving
+    sm_high = copy.copy(sm)
+    sm_high.detect_score_threshold = 2.0  # scores are products of σ's
+    out = jax.device_get(sm_high.compile_bucket(1)(_images(1, 64)))
+    assert float(np.asarray(out["valid"]).sum()) == 0.0
+    row = {key: np.asarray(v)[0] for key, v in out.items()}
+    resp = DETECT.respond(sm_high, {}, row)
+    assert resp["num_detections"] == 0
+    assert resp["detections"] == []
+
+
+# -- class-wise NMS (ops/boxes) --------------------------------------------
+
+
+def test_class_wise_nms_suppresses_within_class_only():
+    boxes = np.asarray([[0.1, 0.1, 0.5, 0.5],
+                        [0.12, 0.12, 0.5, 0.5],   # IoU≈0.9 with box 0
+                        [0.7, 0.7, 0.9, 0.9]], np.float32)
+    scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+    same = np.zeros(3, np.int32)
+    mixed = np.asarray([0, 1, 2], np.int32)
+
+    _, _, v_agnostic = nms_single(boxes, scores, 3)
+    _, _, v_same = nms_single(boxes, scores, 3, classes=same)
+    idx, _, v_mixed = nms_single(boxes, scores, 3, classes=mixed)
+    # same class (or no classes): the overlapping pair collapses
+    assert v_agnostic.sum() == 2 and v_same.sum() == 2
+    # different classes: nothing suppresses across classes
+    assert v_mixed.sum() == 3
+
+    # batched wrapper threads classes per image
+    _, _, bv = batched_nms(boxes[None], scores[None], 3,
+                           classes=mixed[None])
+    assert bv.sum() == 3
+
+
+# -- the ≥100× D2H gate at 416² --------------------------------------------
+
+
+def test_d2h_reduction_gate_416(yolo416_serving):
+    """At the real 416² pyramid (10,647 anchors × 8 channels × 4 B ≈
+    340 KB/image dense) the fused epilogue's fixed K-row output must
+    cut the drainer's bulk D2H ≥100× — asserted from the engine's own
+    ``d2h_bytes_by_bucket`` counters, device-decode engine vs the
+    host-path baseline engine over the same weights."""
+    _, sm = yolo416_serving
+    x = _images(1, 416)[0]
+
+    per_bucket = {}
+    for label, model in (("device", sm), ("host", _host_view(sm))):
+        eng = BatchingEngine(model, buckets=(1,), max_batch=1)
+        eng.start()
+        try:
+            out = eng.infer(x, timeout=300)
+        finally:
+            eng.stop()
+        if label == "device":
+            assert isinstance(out, dict) and "boxes" in out, type(out)
+        per_bucket[label] = eng.stats()["pipeline"]["d2h_bytes_by_bucket"]
+
+    dev_bytes = per_bucket["device"][1]
+    host_bytes = per_bucket["host"][1]
+    # the device row is exactly K·28 B: boxes (K,4) f32 + scores +
+    # classes(i32) + valid, nothing else crosses D2H
+    assert dev_bytes == sm.detect_topk * ROW_BYTES_PER_K, per_bucket
+    assert host_bytes >= 100 * dev_bytes, per_bucket
+
+
+# -- CenterNet through the same hook ---------------------------------------
+
+
+def test_centernet_device_decode(centernet_serving):
+    """The registry picks the decode by model family: a centernet-task
+    model serves /v1/detect with the NMS-free peak decode traced into
+    its bucket programs, same fixed-size row contract, boxes
+    normalized to [0,1]-space like YOLO's."""
+    import jax
+
+    _, sm = centernet_serving
+    assert sm.workload.verb == "detect"
+    x = _images(2, 64)
+    dev = jax.device_get(sm.compile_bucket(2)(x))
+    k = sm.detect_topk
+    assert np.asarray(dev["boxes"]).shape == (2, k, 4)
+    assert np.asarray(dev["scores"]).shape == (2, k)
+    # grid-coord decode normalized by G: unit-ish scale, not raw
+    # 16²-grid indices (random-init offset heads are unbounded, so
+    # only the order of magnitude is stable)
+    assert np.abs(np.asarray(dev["boxes"])).max() < 4.0
+
+    # host-path parity: the same decode math runs in respond()
+    pyr = jax.device_get(_host_view(sm).compile_bucket(2)(x))
+    row_dev = {key: np.asarray(v)[0] for key, v in dev.items()}
+    row_host = jax.tree_util.tree_map(lambda a: np.asarray(a)[0], pyr)
+    r_dev = DETECT.respond(sm, {"score_threshold": 0.05}, row_dev)
+    r_host = DETECT.respond(_host_view(sm), {"score_threshold": 0.05},
+                            row_host)
+    assert r_dev["num_detections"] == r_host["num_detections"] > 0
+    for a, b in zip(r_dev["detections"], r_host["detections"]):
+        assert a["class"] == b["class"]
+        assert a["score"] == pytest.approx(b["score"], abs=1e-5)
+        np.testing.assert_allclose(a["box"], b["box"], atol=1e-3)
+
+
+# -- shadow agreement: the mAP proxy ---------------------------------------
+
+
+def _det_row(boxes, classes, scores=None, k=8):
+    boxes = np.asarray(boxes, np.float32).reshape(-1, 4)
+    n = len(boxes)
+    row = {"boxes": np.zeros((k, 4), np.float32),
+           "scores": np.zeros(k, np.float32),
+           "classes": np.zeros(k, np.int32),
+           "valid": np.zeros(k, np.float32)}
+    row["boxes"][:n] = boxes
+    row["scores"][:n] = np.linspace(0.9, 0.5, n) if scores is None \
+        else np.asarray(scores, np.float32)
+    row["classes"][:n] = np.asarray(classes, np.int32)
+    row["valid"][:n] = 1.0
+    return row
+
+
+def test_detect_shadow_agreement_verdicts():
+    from deep_vision_tpu.serve.admission import Shed
+
+    a = _det_row([[0.1, 0.1, 0.3, 0.3], [0.5, 0.5, 0.8, 0.9]], [0, 2])
+    # perfect pair: every box IoU=1 with its same-class partner
+    assert DETECT.agree(a, a) is True
+    # shifted: both boxes displaced past IoU 0.5 → zero matches
+    shifted = _det_row([[0.35, 0.35, 0.55, 0.55],
+                        [0.05, 0.05, 0.35, 0.45]], [0, 2])
+    assert DETECT.agree(a, shifted) is False
+    # class-swapped: same geometry, labels exchanged → IoU pairs exist
+    # but the class gate rejects them all
+    swapped = _det_row([[0.1, 0.1, 0.3, 0.3], [0.5, 0.5, 0.8, 0.9]],
+                       [2, 0])
+    assert DETECT.agree(a, swapped) is False
+    # both empty: a candidate that also finds nothing is consistent
+    empty = _det_row(np.zeros((0, 4)), [])
+    assert DETECT.agree(empty, empty) is True
+    assert DETECT.agree(a, empty) is False
+    # count mismatch dilutes the fraction below min_match_frac
+    extra = _det_row([[0.1, 0.1, 0.3, 0.3], [0.5, 0.5, 0.8, 0.9],
+                      [0.0, 0.6, 0.2, 0.9], [0.6, 0.0, 0.9, 0.2]],
+                     [0, 2, 1, 1])
+    assert DETECT.agree(a, extra) is False
+    # not comparable: Shed-ish rows and dense host pyramids
+    assert DETECT.agree(a, Shed("x", "y")) is None
+    assert DETECT.agree([np.zeros((8, 8, 3, 8))], a) is None
+
+
+# -- response cache over real HTTP -----------------------------------------
+
+
+def test_detect_response_cache_hit(yolo_serving):
+    """Small canonical detect payloads are cacheable: a byte-identical
+    repeat answers from the response cache (X-DVT-Cache: hit) without
+    consuming engine capacity, and carries num_detections."""
+    from deep_vision_tpu.serve.cache import ResponseCache
+    from deep_vision_tpu.serve.http import ServeServer
+
+    reg, sm = yolo_serving
+    eng = BatchingEngine(sm, buckets=(1,), max_batch=1)
+    eng.start()
+    srv = ServeServer(reg, {sm.name: eng}, port=0,
+                      response_cache=ResponseCache(1 << 20))
+    srv.start_background()
+    base = f"http://127.0.0.1:{srv.port}"
+    body = json.dumps({"pixels": np.zeros((64, 64, 3)).tolist(),
+                       "score_threshold": 0.2}).encode()
+    try:
+        def post(path):
+            req = urllib.request.Request(
+                base + path, data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return r.status, dict(r.headers), json.loads(r.read())
+
+        status, headers, first = post("/v1/detect")
+        assert status == 200
+        assert "num_detections" in first
+        assert len(first["detections"]) == first["num_detections"]
+        assert headers.get("X-DVT-Cache") != "hit"
+
+        served = eng.served
+        status, headers, again = post("/v1/detect")
+        assert status == 200
+        assert headers.get("X-DVT-Cache") == "hit", headers
+        assert again == first
+        assert eng.served == served, "cache hit consumed engine capacity"
+
+        # wrong verb still 400s naming the right route
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            req = urllib.request.Request(
+                base + "/v1/classify", data=body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=60)
+        assert exc.value.code == 400
+        assert "/v1/detect" in json.loads(exc.value.read())["error"]
+    finally:
+        srv.shutdown()
+        eng.stop()

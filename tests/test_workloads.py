@@ -70,6 +70,7 @@ def test_workload_registry_tables():
         f"/v1/{v}" for v in sorted(WORKLOADS))
     assert workload_for_task("classification").verb == "classify"
     assert workload_for_task("detection").verb == "detect"
+    assert workload_for_task("centernet").verb == "detect"
     assert workload_for_task("pose").verb == "pose"
     assert workload_for_task("gan_dcgan").verb == "generate"
     assert workload_for_task("gan_cyclegan").verb == "generate"
@@ -275,7 +276,9 @@ def test_unknown_verb_404_lists_supported(dcgan_serving):
 def test_shadow_agreement_per_workload():
     """models.py delegates shadow comparison to the workload: top-1
     for classify, PCK proximity for pose, digest equality for
-    generate, not-comparable for detect and Shed-ish rows."""
+    generate, greedy IoU-matched pairing for detect's device-decoded
+    rows (dense host pyramids and Shed-ish rows stay not-comparable).
+    Detect verdict details live in tests/test_detect_epilogue.py."""
     from deep_vision_tpu.serve.admission import Shed
 
     cls = WORKLOADS["classify"]
@@ -285,7 +288,18 @@ def test_shadow_agreement_per_workload():
     assert cls.agree(a, b) is True
     assert cls.agree(a, c) is False
     assert cls.agree(a, Shed("x", "y")) is None
+    # dense pyramid rows (host decode path) are not comparable...
     assert WORKLOADS["detect"].agree(a, a) is None
+    # ...device-decoded dict rows are
+    det = {"boxes": np.asarray([[0.1, 0.1, 0.4, 0.4]], np.float32),
+           "scores": np.asarray([0.9], np.float32),
+           "classes": np.asarray([1], np.int32),
+           "valid": np.asarray([1.0], np.float32)}
+    miss = dict(det, boxes=np.asarray([[0.6, 0.6, 0.9, 0.9]],
+                                      np.float32))
+    assert WORKLOADS["detect"].agree(det, det) is True
+    assert WORKLOADS["detect"].agree(det, miss) is False
+    assert WORKLOADS["detect"].agree(det, Shed("x", "y")) is None
 
     pose = WORKLOADS["pose"]
     kp = {"keypoints": np.zeros((8, 2), np.float32),
