@@ -1331,6 +1331,8 @@ def bench_serve_mix(models: tuple = ("lenet5", "yolov3_toy",
 
 
 def bench_serve_cascade(front: str = "lenet5", big: str = "lenet5_big",
+                        tiers: tuple | None = None,
+                        quant_front: bool = False,
                         loads: tuple = (4, 8), duration_s: float = 2.0,
                         max_batch: int = 8, max_wait_ms: float = 2.0,
                         pipeline_depth: int = 2,
@@ -1344,16 +1346,23 @@ def bench_serve_cascade(front: str = "lenet5", big: str = "lenet5_big",
     big-model-only serving vs the cascade router (serve/cascade.py)
     over the same control plane, at matched top-1 quality.
 
-    Both tiers TRAIN first (subprocess ``cli.train --synthetic``, a
-    couple of epochs on the blob dataset) — an untrained pair has no
+    ``tiers`` names the whole chain (default the 2-tier
+    ``front``/``big`` pair; ``--tiers 3`` on the CLI picks
+    lenet5_nano:lenet5:lenet5_big) and ``quant_front`` serves tier 0
+    int8-resident (``--cascade-quant-front``, synthetic-calibrated PTQ
+    — the production boot path).
+
+    Every tier TRAINS first (subprocess ``cli.train --synthetic``, a
+    couple of epochs on the blob dataset) — an untrained chain has no
     meaningful agreement structure, so the calibration story would be
-    vacuous.  The cascade then calibrates from live dual-run samples
-    exactly as in production (no histogram backdoor), a labeled
-    held-out set scores top-1 accuracy for big-only vs cascade (the
-    matched-quality check), and closed-loop clients sweep ``loads``
-    twice per point — big-only, then cascade — for the img/s ratio.
-    Reports escalation rate, threshold, per-tier p50/p99, and the
-    accuracy deltas; docs/PERF.md records the methodology."""
+    vacuous.  The cascade then calibrates EVERY hop from live dual-run
+    samples exactly as in production (no histogram backdoor), a
+    labeled held-out set scores top-1 accuracy for big-only vs cascade
+    (the matched-quality check), and closed-loop clients sweep
+    ``loads`` twice per point — big-only, then cascade — for the
+    img/s ratio.  Reports escalation rate, per-hop thresholds,
+    per-tier p50/p99, and the accuracy deltas; docs/PERF.md records
+    the methodology."""
     import os
     import subprocess
     import sys
@@ -1374,6 +1383,10 @@ def bench_serve_cascade(front: str = "lenet5", big: str = "lenet5_big",
     from deep_vision_tpu.serve.workloads import ClassifyWorkload
 
     top1 = ClassifyWorkload.top1
+    if tiers is None:
+        tiers = (front, big)
+    tiers = tuple(tiers)
+    front, big = tiers[0], tiers[-1]
     registry = ModelRegistry()
     admissions: dict = {}
 
@@ -1391,14 +1404,15 @@ def bench_serve_cascade(front: str = "lenet5", big: str = "lenet5_big",
     plane = ModelControlPlane(registry, engine_factory,
                               admission_factory=admission_for)
     out: dict = {"metric": "serve_cascade_speedup", "unit": "x",
-                 "front": front, "big": big,
+                 "front": front, "big": big, "tiers": list(tiers),
+                 "quant_front": bool(quant_front),
                  "train_epochs": train_epochs,
                  "min_agreement": min_agreement,
                  "sample_period": sample_period,
                  "min_sample": min_sample,
                  "max_batch": max_batch, "max_wait_ms": max_wait_ms}
     with tempfile.TemporaryDirectory() as wd:
-        for name in (front, big):
+        for name in tiers:
             t0 = time.perf_counter()
             subprocess.run(
                 [sys.executable, "-m", "deep_vision_tpu.cli.train",
@@ -1411,16 +1425,22 @@ def bench_serve_cascade(front: str = "lenet5", big: str = "lenet5_big",
             print(f"[cascade] trained {name} in "
                   f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
         # float32 wire: the tiers see the exact training distribution
-        # (the synthetic blobs are float images, not 0-255 pixels)
-        fsm = registry.load_checkpoint(front, os.path.join(wd, front),
-                                       cascade_topk=5)
-        bsm = registry.load_checkpoint(big, os.path.join(wd, big))
+        # (the synthetic blobs are float images, not 0-255 pixels).
+        # Non-final tiers carry the fused confidence epilogue; tier 0
+        # optionally serves int8-resident (synthetic-calibrated PTQ)
+        sms = []
+        for i, name in enumerate(tiers):
+            sms.append(registry.load_checkpoint(
+                name, os.path.join(wd, name),
+                cascade_topk=5 if i < len(tiers) - 1 else 0,
+                infer_dtype="int8" if quant_front and i == 0
+                else "float32"))
         cfg = get_config(big)
         try:
-            plane.deploy(fsm)
-            plane.deploy(bsm)
+            for sm in sms:
+                plane.deploy(sm)
             plane.warmup()
-            spec = CascadeSpec(front, big,
+            spec = CascadeSpec(*tiers,
                                min_agreement=min_agreement,
                                sample_period=sample_period,
                                min_sample=min_sample)
@@ -1439,21 +1459,26 @@ def bench_serve_cascade(front: str = "lenet5", big: str = "lenet5_big",
             big_acc = sum(c == y for c, y in zip(big_cls, labels)) \
                 / len(labels)
 
-            # -- calibrate through the REAL sampling path -------------
+            # -- calibrate EVERY hop through the REAL sampling path ---
+            def uncalibrated():
+                return [h.index for h in router.hops
+                        if h.threshold is None]
+
             warm = 0
-            while router.threshold is None \
-                    and warm < 40 * sample_period * min_sample:
+            cap = 40 * sample_period * min_sample * len(router.hops)
+            while uncalibrated() and warm < cap:
                 router.infer(imgs[warm % len(imgs)], timeout=120)
                 warm += 1
-            out["calibrated"] = router.threshold is not None
+            out["calibrated"] = not uncalibrated()
             out["threshold"] = router.threshold
+            out["hop_thresholds"] = [h.threshold for h in router.hops]
             out["warm_requests"] = warm
 
             # -- quality: cascade answers on the same held-out set ----
-            cas_cls, tiers = [], {"front": 0, "big": 0}
+            cas_cls, tier_counts = [], {}
             for x in imgs:
                 tier, row = router.infer(x, timeout=120)
-                tiers[tier] += 1
+                tier_counts[tier] = tier_counts.get(tier, 0) + 1
                 cas_cls.append(top1(row)[0])
             cas_acc = sum(c == y for c, y in zip(cas_cls, labels)) \
                 / len(labels)
@@ -1464,7 +1489,7 @@ def bench_serve_cascade(front: str = "lenet5", big: str = "lenet5_big",
                 "big_top1_acc": round(big_acc, 4),
                 "cascade_top1_acc": round(cas_acc, 4),
                 "matched_top1": round(matched, 4),
-                "holdout_tiers": tiers}
+                "holdout_tiers": tier_counts}
 
             # -- throughput: big-only vs cascade per load point -------
             def sweep(infer_one):
@@ -1529,6 +1554,11 @@ def bench_serve_cascade(front: str = "lenet5", big: str = "lenet5_big",
                     "escalation_rate": rstats["escalation_rate"],
                     "samples": rstats["samples"],
                     "agreement": rstats["agreement"],
+                    "hops": [{"hop": h["hop"], "tier": h["tier"],
+                              "threshold": h["threshold"],
+                              "agreement": h["agreement"],
+                              "escalations": h["escalations"]}
+                             for h in rstats["hops"]],
                     "latency": rstats["latency"]},
                 "device_kind": jax.devices()[0].device_kind})
         finally:
@@ -2854,14 +2884,36 @@ def main():
                         "top-1, escalation rate, per-tier p50/p99 "
                         "(docs/PERF.md, serve/cascade.py)")
     p.add_argument("--cascade", default="",
-                   help="'front:big' pair — the tiers for "
-                        "--serve-cascade (default lenet5:lenet5_big) "
+                   help="'t0:...:big' chain — the tiers for "
+                        "--serve-cascade (default lenet5:lenet5_big, "
+                        "or the 3-tier nano chain with --tiers 3) "
                         "and, when set, the cascade column source for "
                         "--serve-mix (both names must be in "
                         "--serve-mix-models; '' = no cascade column)")
+    p.add_argument("--tiers", type=int, default=2,
+                   help="chain length for --serve-cascade when "
+                        "--cascade is unset: 3 picks "
+                        "lenet5_nano:lenet5:lenet5_big, anything else "
+                        "the 2-tier pair")
+    p.add_argument("--cascade-quant-front", action="store_true",
+                   help="serve the --serve-cascade tier 0 "
+                        "int8-resident (PTQ at load, synthetic "
+                        "calibration) — the --cascade-quant-front "
+                        "production boot path")
     p.add_argument("--cascade-min-agreement", type=float, default=0.95,
                    help="calibration agreement floor for "
                         "--serve-cascade")
+    p.add_argument("--cascade-sample-period", type=int, default=10,
+                   help="dual-run every Nth request per hop during "
+                        "--serve-cascade calibration (larger = less "
+                        "sampling tax, slower calibration)")
+    p.add_argument("--cascade-min-sample", type=int, default=50,
+                   help="dual-run samples a --serve-cascade hop needs "
+                        "before it derives a threshold")
+    p.add_argument("--cascade-train-epochs", type=int, default=2,
+                   help="synthetic training epochs per tier for "
+                        "--serve-cascade (more epochs tightens "
+                        "front-vs-big agreement)")
     p.add_argument("--serve-edge", action="store_true",
                    help="HTTP front-end A/B: selector event loop "
                         "(keep-alive + pipelining + bounded conns) vs "
@@ -2972,14 +3024,21 @@ def main():
             cascade=args.cascade or None)))
         return
     if args.serve_cascade:
-        pair = args.cascade or "lenet5:lenet5_big"
-        front, _, big = pair.partition(":")
+        if args.cascade:
+            chain = tuple(t.strip() for t in args.cascade.split(":"))
+        elif args.tiers >= 3:
+            chain = ("lenet5_nano", "lenet5", "lenet5_big")
+        else:
+            chain = ("lenet5", "lenet5_big")
         print(json.dumps(bench_serve_cascade(
-            front=front.strip(), big=big.strip(),
+            tiers=chain, quant_front=args.cascade_quant_front,
             loads=tuple(int(c) for c in args.serve_loads.split(",")),
             duration_s=args.serve_duration, max_batch=args.batch or 8,
             pipeline_depth=args.serve_pipeline_depth,
-            min_agreement=args.cascade_min_agreement)))
+            min_agreement=args.cascade_min_agreement,
+            sample_period=args.cascade_sample_period,
+            min_sample=args.cascade_min_sample,
+            train_epochs=args.cascade_train_epochs)))
         return
     if args.deploy:
         # the autoscale half needs a spare device for add_replica();

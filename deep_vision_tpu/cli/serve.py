@@ -175,7 +175,13 @@ def _detect_knobs(args) -> dict:
         detect_score_threshold=float(
             getattr(args, "detect_score_threshold", 0.05)),
         detect_iou_threshold=float(
-            getattr(args, "detect_iou_threshold", 0.5)))
+            getattr(args, "detect_iou_threshold", 0.5)),
+        detect_soft_nms=str(getattr(args, "detect_soft_nms", "off")
+                            or "off"),
+        detect_soft_sigma=float(
+            getattr(args, "detect_soft_sigma", 0.5)),
+        detect_max_per_class=int(
+            getattr(args, "detect_max_per_class", 0) or 0))
 
 
 def build_server(args):
@@ -404,12 +410,36 @@ def _build_plane_server(args, registry, wire_dtype: str,
             sample_period=int(getattr(args, "cascade_sample_period",
                                       10)),
             min_sample=int(getattr(args, "cascade_min_sample", 200)),
-            topk=int(getattr(args, "cascade_topk", 5)))
-        for tier in (cascade_spec.front, cascade_spec.big):
+            topk=int(getattr(args, "cascade_topk", 5)),
+            per_class=bool(getattr(args, "cascade_per_class", False)),
+            class_min_sample=int(getattr(args,
+                                         "cascade_class_min_sample",
+                                         50)))
+        for tier in cascade_spec.tiers:
             if tier not in names:
                 raise ValueError(
                     f"--cascade tier '{tier}' is not served; --models "
-                    f"must include both cascade tiers (got {names})")
+                    f"must include every cascade tier (got {names})")
+        # every tier must speak the SAME verb (the chain escalates one
+        # request through all of them), and the verb needs a
+        # CascadeWorkloadRule (classify/detect today) — checked here,
+        # before any checkpoint restore
+        from deep_vision_tpu.core.config import get_config
+        from deep_vision_tpu.serve.workloads import workload_for_task
+
+        tier_verbs = {t: workload_for_task(get_config(t).task).verb
+                      for t in cascade_spec.tiers}
+        if len(set(tier_verbs.values())) > 1:
+            raise ValueError(
+                f"--cascade tiers must share one workload verb, got "
+                f"{tier_verbs}")
+        verb = tier_verbs[cascade_spec.big]
+        if workload_for_task(
+                get_config(cascade_spec.big).task).cascade_rule() \
+                is None:
+            raise ValueError(
+                f"--cascade: the '{verb}' workload has no cascade "
+                f"rule (classify and detect cascade today)")
     buckets = [int(b) for b in args.buckets.split(",")] if args.buckets \
         else None
     fault_spec = getattr(args, "faults", None)
@@ -503,17 +533,30 @@ def _build_plane_server(args, registry, wire_dtype: str,
                               admission_factory=admission_for)
     for name in names:
         workdir = os.path.join(args.workdir, name)
-        # the cascade's FRONT tier fuses the (top1_idx, top1_prob)
-        # confidence epilogue into its bucket programs; the big tier
-        # keeps dense logits so escalated answers are bit-identical to
-        # big-only serving (serve/cascade.py)
+        # every NON-FINAL cascade tier fuses the (top1_idx, top1_prob)
+        # confidence epilogue into its bucket programs (classify; the
+        # detect decode epilogue already carries the signal); the big
+        # tier keeps its plain outputs so escalated answers are
+        # bit-identical to big-only serving (serve/cascade.py)
         front_k = cascade_spec.topk if cascade_spec is not None \
-            and name == cascade_spec.front else 0
+            and name in cascade_spec.tiers \
+            and name != cascade_spec.big else 0
+        tier_infer = infer_dtype
+        tier_calib = getattr(args, "calib_dir", None)
+        if cascade_spec is not None \
+                and getattr(args, "cascade_quant_front", False) \
+                and name == cascade_spec.front:
+            # --cascade-quant-front: tier 0 serves int8-resident
+            # weights, PTQ-calibrated at boot on the same held-out
+            # directory the accuracy gate uses (synthetic when neither
+            # is given).  The other tiers keep --infer-dtype.
+            tier_infer = "int8"
+            tier_calib = tier_calib or getattr(args, "gate_dir", None)
         sm = registry.load_checkpoint(
             name, workdir, wire_dtype=wire_dtype,
-            infer_dtype=infer_dtype,
+            infer_dtype=tier_infer,
             calib_batches=int(getattr(args, "calib_batches", 2) or 2),
-            calib_dir=getattr(args, "calib_dir", None),
+            calib_dir=tier_calib,
             cascade_topk=front_k,
             **_detect_knobs(args))
         plane.deploy(sm, workdir=workdir)
@@ -839,13 +882,16 @@ def main(argv=None):
     # -- confidence-routed cascade (docs/SERVING.md "Cascaded
     #    serving") --
     p.add_argument("--cascade", default=None,
-                   help="'front:big' — route classify requests "
-                        "addressed to the BIG model through the cheap "
-                        "FRONT tier first, escalating only when the "
-                        "front's top-1 confidence falls below a "
-                        "threshold calibrated from live dual-run "
-                        "samples; both names must appear in --models "
-                        "(serve/cascade.py; uncalibrated = all-big)")
+                   help="'t0:t1:...:big' — route classify/detect "
+                        "requests addressed to the BIG model through "
+                        "the chain of cheaper tiers first, escalating "
+                        "past each hop whose confidence falls below "
+                        "that hop's threshold, calibrated from live "
+                        "tier-vs-big dual-run samples; every name "
+                        "must appear in --models and share one verb "
+                        "(serve/cascade.py; an uncalibrated hop "
+                        "escalates through — fully uncalibrated = "
+                        "all-big)")
     p.add_argument("--cascade-min-agreement", type=float, default=0.98,
                    help="calibration target: smallest confidence "
                         "threshold whose measured front-vs-big top-1 "
@@ -860,9 +906,27 @@ def main(argv=None):
                         "traffic may stop at the front tier; below it "
                         "the cascade fails closed to all-big")
     p.add_argument("--cascade-topk", type=int, default=5,
-                   help="entries in the front tier's fused device-side "
+                   help="entries in the cheap tiers' fused device-side "
                         "top-k confidence epilogue (bounds top_k in "
-                        "front-served responses)")
+                        "cheap-tier-served responses)")
+    p.add_argument("--cascade-quant-front", action="store_true",
+                   help="serve tier 0 with int8-resident weights: PTQ "
+                        "at boot (serve/quant.py) calibrated on "
+                        "--calib-dir, falling back to the --gate-dir "
+                        "holdout, then deterministic synthetic batches "
+                        "— the cheapest front the stack can build "
+                        "without retraining")
+    p.add_argument("--cascade-per-class", action="store_true",
+                   help="calibrate a per-CLASS threshold axis at every "
+                        "hop: classes with enough of their own "
+                        "dual-run sample get their own threshold, so "
+                        "a class the cheap tier is systematically "
+                        "wrong about escalates even at confidences "
+                        "the pooled threshold would serve")
+    p.add_argument("--cascade-class-min-sample", type=int, default=50,
+                   help="dual-run samples a single class needs before "
+                        "its own threshold activates (below it the "
+                        "class uses the pooled threshold)")
     # -- detect decode (docs/SERVING.md "Workloads") --
     p.add_argument("--detect-decode", choices=("device", "host"),
                    default="device",
@@ -888,6 +952,22 @@ def main(argv=None):
                    help="IoU threshold of the fused class-wise NMS "
                         "(YOLO family; CenterNet's peak decode is "
                         "NMS-free)")
+    p.add_argument("--detect-soft-nms", choices=("off", "gaussian",
+                                                 "linear"),
+                   default="off",
+                   help="suppression rule of the fused NMS: 'off' "
+                        "(default) is hard greedy NMS; 'gaussian' / "
+                        "'linear' switch to Soft-NMS score decay "
+                        "(Bodla et al. 2017) — overlapping boxes "
+                        "survive with decayed scores instead of dying "
+                        "at the IoU threshold")
+    p.add_argument("--detect-soft-sigma", type=float, default=0.5,
+                   help="gaussian Soft-NMS decay width "
+                        "exp(-iou²/sigma); ignored for 'off'/'linear'")
+    p.add_argument("--detect-max-per-class", type=int, default=0,
+                   help="cap detections per class in the fused decode "
+                        "output (0 = uncapped) — stops one dense class "
+                        "from monopolizing the fixed K rows")
     # -- offline batch tier (docs/BATCH.md) --
     p.add_argument("--jobs-dir", default=None,
                    help="enable the offline batch-inference tier "
@@ -1005,14 +1085,18 @@ def main(argv=None):
               f"/v1/models/<name>/reload")
     cascade = getattr(server.httpd, "cascade", None)
     if cascade is not None:
-        print(f"[serve] cascade: {cascade.spec.front} -> "
-              f"{cascade.spec.big} — requests "
-              f"for '{cascade.spec.big}' answer from the front tier "
-              f"when calibrated confidence allows "
+        print(f"[serve] cascade: "
+              f"{' -> '.join(cascade.spec.tiers)} — requests "
+              f"for '{cascade.spec.big}' answer from the cheapest "
+              f"tier whose calibrated confidence allows "
               f"(min_agreement={cascade.spec.min_agreement}, "
               f"sample_period={cascade.spec.sample_period}, "
-              f"min_sample={cascade.spec.min_sample}; uncalibrated = "
-              f"all-big)")
+              f"min_sample={cascade.spec.min_sample}"
+              + (", per_class" if cascade.spec.per_class else "")
+              + (", int8 front"
+                 if getattr(args, "cascade_quant_front", False)
+                 else "")
+              + "; uncalibrated hops escalate through)")
     deploy = getattr(server.httpd, "deploy", None)
     if deploy is not None:
         bits = []

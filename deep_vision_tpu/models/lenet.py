@@ -46,6 +46,31 @@ class LeNet5Big(nn.Module):
         return x.astype(jnp.float32)
 
 
+class LeNet5Nano(nn.Module):
+    """A deliberately tiny MNIST-shape classifier — the N-tier
+    cascade's tier-0 below LeNet-5 (serve/cascade.py,
+    bench.py --serve-cascade --tiers 3).
+
+    Same 32×32×1 input and class count as the other two so all three
+    tiers are interchangeable on the wire: one strided conv8@5×5 →
+    pool → dense, ~5K params (~12× fewer than LeNet-5) — the
+    mobile-below-mobile end of the reference zoo's compute span."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(8, (5, 5), strides=(2, 2), padding="VALID",
+                    dtype=self.dtype)(x)                               # 32→14
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), (2, 2))                             # 14→7
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
 class LeNet5(nn.Module):
     num_classes: int = 10
     dtype: Any = jnp.float32

@@ -51,8 +51,27 @@ def broadcast_iou(box_a, box_b, eps: float = 1e-9):
 _CLASS_OFFSET = 4.0
 
 
+def _per_class_cap(idx, valid, classes, max_per_class: int):
+    """Invalidate selections past the ``max_per_class``-th VALID box of
+    each class, in selection (descending-score) order.
+
+    idx/valid: (K,) the scan's outputs; classes: (N,) per-box labels.
+    Rank is computed with a (K, K) lower-triangular same-class mask —
+    K is the small static output count, so the quadratic is trivial and
+    shapes stay static (no sort, no segment ops)."""
+    k = idx.shape[0]
+    sel_cls = classes[idx]  # (K,) class of each selection
+    same = sel_cls[:, None] == sel_cls[None, :]
+    earlier = jnp.tril(jnp.ones((k, k), bool))  # j <= i
+    # 1-based occurrence index among VALID same-class selections
+    rank = jnp.sum(same & earlier & (valid > 0.0)[None, :], axis=1)
+    return valid * (rank <= max_per_class).astype(valid.dtype)
+
+
 def nms_single(boxes, scores, max_outputs: int, iou_threshold: float = 0.5,
-               score_threshold: float = 0.0, classes=None):
+               score_threshold: float = 0.0, classes=None,
+               soft: str = "off", soft_sigma: float = 0.5,
+               max_per_class: int = 0):
     """Greedy NMS for one image, static output size.
 
     boxes: (N, 4) corners; scores: (N,).  Returns (idx, sel_scores, valid):
@@ -60,6 +79,21 @@ def nms_single(boxes, scores, max_outputs: int, iou_threshold: float = 0.5,
     ``classes`` (N,) int switches to CLASS-WISE suppression: boxes only
     suppress same-class neighbours (via the class-offset trick above);
     None keeps the class-agnostic reference behavior.
+
+    ``soft`` picks the suppression rule (Bodla et al. 2017, Soft-NMS):
+    "off" is the reference hard rule (overlap past ``iou_threshold`` →
+    score killed); "gaussian" decays every overlapping neighbour by
+    ``exp(-iou² / soft_sigma)``; "linear" scales neighbours past the
+    IoU threshold by ``1 - iou``.  Soft-decayed boxes die only when
+    their score falls below ``score_threshold``, so heavily-overlapped
+    but high-scoring boxes survive with reduced rank — the reported
+    ``sel_scores`` are the DECAYED scores, matching the paper.  The
+    class-offset trick composes for free: cross-class IoU is exactly 0,
+    so the decay factor is exp(0)=1 (no cross-class decay).
+
+    ``max_per_class > 0`` (needs ``classes``) caps how many boxes each
+    class may keep — the per-class K that stops one dense class from
+    monopolizing the fixed K-row epilogue output.
     """
     scores = jnp.where(scores >= score_threshold, scores, -jnp.inf)
     iou_boxes = boxes
@@ -67,31 +101,67 @@ def nms_single(boxes, scores, max_outputs: int, iou_threshold: float = 0.5,
         iou_boxes = boxes + (classes.astype(boxes.dtype)
                              * _CLASS_OFFSET)[..., None]
     iou = broadcast_iou(iou_boxes, iou_boxes)  # (N, N)
+    n = scores.shape[0]
 
-    def step(live_scores, _):
-        i = jnp.argmax(live_scores)
-        best = live_scores[i]
-        valid = jnp.isfinite(best)
-        # suppress neighbours of the chosen box + the box itself
-        suppress = (iou[i] > iou_threshold) | (
-            jnp.arange(scores.shape[0]) == i)
-        live_scores = jnp.where(valid & suppress, -jnp.inf, live_scores)
-        return live_scores, (i, jnp.where(valid, best, 0.0),
-                             valid.astype(jnp.float32))
+    if soft == "off":
+        def step(live_scores, _):
+            i = jnp.argmax(live_scores)
+            best = live_scores[i]
+            valid = jnp.isfinite(best)
+            # suppress neighbours of the chosen box + the box itself
+            suppress = (iou[i] > iou_threshold) | (jnp.arange(n) == i)
+            live_scores = jnp.where(valid & suppress, -jnp.inf,
+                                    live_scores)
+            return live_scores, (i, jnp.where(valid, best, 0.0),
+                                 valid.astype(jnp.float32))
+    else:
+        if soft not in ("gaussian", "linear"):
+            raise ValueError(
+                f"soft must be 'off', 'gaussian' or 'linear', "
+                f"got {soft!r}")
+
+        def step(live_scores, _):
+            i = jnp.argmax(live_scores)
+            best = live_scores[i]
+            valid = jnp.isfinite(best)
+            if soft == "gaussian":
+                decay = jnp.exp(-(iou[i] ** 2) / soft_sigma)
+            else:
+                decay = jnp.where(iou[i] > iou_threshold,
+                                  1.0 - iou[i], 1.0)
+            decayed = live_scores * decay
+            # decayed scores under the floor die; the chosen box
+            # always leaves the pool
+            decayed = jnp.where(decayed >= score_threshold, decayed,
+                                -jnp.inf)
+            decayed = jnp.where(jnp.arange(n) == i, -jnp.inf, decayed)
+            live_scores = jnp.where(valid, decayed, live_scores)
+            return live_scores, (i, jnp.where(valid, best, 0.0),
+                                 valid.astype(jnp.float32))
 
     _, (idx, sel, valid) = lax.scan(step, scores, None, length=max_outputs)
+    if max_per_class and max_per_class > 0 and classes is not None:
+        valid = _per_class_cap(idx, valid, classes, int(max_per_class))
+        sel = sel * valid
     return idx, sel, valid
 
 
 def batched_nms(boxes, scores, max_outputs: int, iou_threshold: float = 0.5,
-                score_threshold: float = 0.0, classes=None):
+                score_threshold: float = 0.0, classes=None,
+                soft: str = "off", soft_sigma: float = 0.5,
+                max_per_class: int = 0):
     """vmap of nms_single over the batch: (B,N,4),(B,N) → (B,K) each.
-    ``classes`` (B,N) int enables class-wise suppression per image."""
+    ``classes`` (B,N) int enables class-wise suppression per image;
+    ``soft``/``soft_sigma``/``max_per_class`` thread straight through
+    (static knobs, baked into the traced program)."""
     if classes is not None:
         return jax.vmap(
-            lambda b, s, c: nms_single(b, s, max_outputs, iou_threshold,
-                                       score_threshold, classes=c)
+            lambda b, s, c: nms_single(
+                b, s, max_outputs, iou_threshold, score_threshold,
+                classes=c, soft=soft, soft_sigma=soft_sigma,
+                max_per_class=max_per_class)
         )(boxes, scores, classes)
     return jax.vmap(
         lambda b, s: nms_single(b, s, max_outputs, iou_threshold,
-                                score_threshold))(boxes, scores)
+                                score_threshold, soft=soft,
+                                soft_sigma=soft_sigma))(boxes, scores)

@@ -1,68 +1,89 @@
-"""Confidence-routed model cascade: serve the cheap model when it's
+"""Confidence-routed model cascade: serve the cheapest model that is
 sure.
 
 Classic production-vision economics (ROADMAP): the zoo spans ~50× in
 compute for the same task, and most traffic doesn't need the big
 model.  The ``CascadeRouter`` layers on the multi-model plane
-(serve/models.py) and routes every classify request addressed to the
-BIG model name through a cheap FRONT tier first; the request only
-escalates to the big tier when the front's top-1 softmax confidence
-falls below a *calibrated* threshold.
+(serve/models.py) and routes every request addressed to the BIG model
+name through an N-TIER CHAIN of cheaper tiers first
+(``--cascade t0:t1:...:big``); a request walks the chain front-to-back
+and escalates past each tier whose confidence falls below that HOP's
+*calibrated* threshold, with the final tier always authoritative.
 
 Addressing contract: clients name the big model — that name is the
 quality contract — and the cascade transparently answers from the
-front tier when it is confident, reporting which tier actually
-answered in the ``X-DVT-Tier`` response header.  Requests that name
-the front model directly bypass the cascade (it is still an ordinary
-routable model), and "always-big" QoS tenants (serve/admission.py)
-force every request straight to the big tier.
+cheapest tier that is confident, reporting which tier actually
+answered in the ``X-DVT-Tier`` response header ("front", "t1", ...,
+"big").  Requests that name a cheap tier directly bypass the cascade
+(every tier is still an ordinary routable model), and "always-big" QoS
+tenants (serve/admission.py) force every request straight to the big
+tier.
 
-Calibration inverts the PR 9 shadow-sampling machinery: every
-``sample_period``-th request runs BOTH tiers — the client gets the big
-tier's answer (authoritative), and the front-vs-big top-1 agreement is
-recorded into an ``AgreementHistogram`` at the front's confidence
-bucket.  The threshold is then the smallest confidence whose measured
-at-or-above agreement clears ``min_agreement``.  Fail-closed is the
-core safety property: with no threshold (sample thinner than
-``min_sample``, or no confidence level agrees enough) ALL traffic goes
-to the big tier, and a version swap of either tier (reload, promote,
-revert) resets calibration through the plane's version listener —
-new weights shift the confidence distribution, so the old threshold is
-invalid until the sample rebuilds.
+Calibration is per-HOP and inverts the PR 9 shadow-sampling machinery:
+every ``sample_period``-th request ARRIVING at hop i dual-runs tier i
+AND the big tier — the client gets the big answer (authoritative), and
+tier-i-vs-big agreement is recorded into hop i's
+``AgreementHistogram`` at tier i's confidence bucket.  Each hop's
+threshold is then the smallest confidence whose measured at-or-above
+agreement clears ``min_agreement``; because every hop calibrates
+against the FINAL tier, serving from any hop claims tier-vs-big
+quality directly (no transitivity assumption across hops).  What
+"confidence" and "agreement" mean is the verb's business: a
+``CascadeWorkloadRule`` (serve/workloads.py) resolved from the big
+tier's workload supplies both — classify uses fused top-1
+probability + top-1 match, detect uses device-decoded valid-count +
+max-score with the greedy-IoU mAP-proxy pairing.
 
-The escalation decision is device-cheap: the front tier's bucket
-programs carry a fused confidence epilogue
-(workloads.ClassifyWorkload.make_epilogue, the PR 15 pose-epilogue
-pattern) so the router reads ``(top1_class, top1_prob)`` off the bulk
-D2H row instead of the dense logits.  An escalated image re-enters the
-big tier's admission queue carrying its REMAINING deadline — original
-budget minus the time the front attempt burned — and its original
-trace span, so a cascaded request never gets double SLO budget and the
-big tier's admission controller judges it by what's actually left.
+With ``per_class=True`` each hop also keeps a per-CLASS histogram
+axis: classes with enough of their own sample get their own
+thresholds, so a class the cheap tier is systematically wrong about
+escalates at confidences where the pooled threshold would have served
+it.  A class without a qualifying sample falls back to the pooled
+threshold — and escalates (fail-closed) when that is None too.
+
+Fail-closed is the core safety property, applied per hop: an
+UNCALIBRATED hop escalates THROUGH — the request skips that tier
+entirely (no wasted compute, no guessed answer) and proceeds down the
+chain, so a fully-uncalibrated chain serves everything from big.  Any
+tier failure (Shed, Quarantined, raise) escalates the same way.  A
+version swap of tier i (reload, promote, revert) resets ONLY hop i's
+calibration; a swap of the BIG tier resets every hop (big is every
+hop's comparison target).
+
+The escalation decision is device-cheap: cheap classify tiers carry
+the fused confidence epilogue (workloads.ClassifyWorkload
+.make_epilogue), detect tiers their fused decode epilogue, so the
+router reads the signal off the bulk D2H row instead of dense outputs.
+An escalated image re-enters the NEXT tier's admission queue carrying
+its REMAINING deadline — original budget minus everything earlier
+tiers burned — and its original trace span, so a twice-escalated
+request never exceeds its original SLO budget and each tier's
+admission controller judges it by what's actually left.
 
 Brownout hooks (serve/brownout.py, optional — ``router.brownout``
 defaults to None and nothing changes): at L1+ the dual-run calibration
-sampling PAUSES (each skipped slot counted in ``samples_paused``; the
-would-be sample routes like ordinary traffic) — under overload the
-duplicate big-tier run is the first capacity to reclaim.  At L2+ a
-non-premium request whose front confidence falls BELOW the calibrated
-threshold is served the front answer anyway, resolved with the
-``DEGRADED`` tier token so the HTTP layer marks it ``X-DVT-Degraded``
-— quality traded for the escalation's big-tier slot, visibly, and
-only when a threshold exists (uncalibrated traffic stays fail-closed
-all-big: no threshold means no quality claim to degrade from).
-Always-big tenants bypass both hooks — premium degrades last.
+sampling PAUSES at every hop (each skipped slot counted in
+``samples_paused``) — under overload the duplicate big-tier run is the
+first capacity to reclaim.  At L2+ a non-premium request whose
+confidence falls BELOW a hop's calibrated threshold is served that
+tier's answer anyway, resolved with a ``<tier>-degraded`` token so the
+HTTP layer marks it ``X-DVT-Degraded`` — quality traded for the
+escalation's slot, visibly, and only where a threshold exists
+(uncalibrated hops stay fail-closed escalate-through: no threshold
+means no quality claim to degrade from).  Always-big tenants bypass
+both hooks — premium degrades last.
 
 Calibration persists across restarts when ``root`` names a ledger
 directory (``<workdir>/_cascade`` in production — the deploy-ledger
-JSONL idiom, deploy/history.py): every threshold CHANGE appends the
-histogram counts plus the combined params digest, every version-swap
-reset appends a reset record, and boot replays the tail — the
+JSONL idiom, deploy/history.py): every hop's threshold CHANGE appends
+that hop's histogram counts plus the combined digest of ALL tiers,
+every version-swap reset appends a reset record naming its hop (or all
+hops, for a big swap), and boot replays the tail per hop — a hop's
 histogram and threshold are adopted only when the persisted digest
-matches both live tiers (and the threshold is RE-derived from the
-restored counts, so retuned ``min_agreement`` knobs apply
-immediately).  Any mismatch stays fail-closed, exactly as if the
-ledger did not exist.
+matches EVERY live tier (a reload of ANY tier while down rejects the
+whole record), and thresholds are RE-derived from the restored counts
+so retuned ``min_agreement`` knobs apply immediately.  Any mismatch
+stays fail-closed, exactly as if the ledger did not exist.
 
 All chaining is ``Future.add_done_callback`` — the router never blocks
 an engine worker thread.  Lock order: ``CascadeRouter._lock`` is a
@@ -88,83 +109,145 @@ _log = get_logger("dvt.serve.cascade")
 
 FRONT = "front"
 BIG = "big"
-# tier token for a brownout-L2 front answer served BELOW the
-# calibrated threshold — serve/http.py maps it to X-DVT-Tier: front
-# plus X-DVT-Degraded: 1
-DEGRADED = "front-degraded"
+#: suffix marking a brownout-L2 answer served BELOW the hop's
+#: calibrated threshold — serve/http.py strips it for X-DVT-Tier and
+#: adds X-DVT-Degraded: 1
+DEGRADED_SUFFIX = "-degraded"
+# the tier-0 degraded token, kept as a module constant for import
+# compatibility (serve/http.py, tests)
+DEGRADED = FRONT + DEGRADED_SUFFIX
 
 _DEFAULT_DEADLINE_MS = 30_000.0
 
 
-class CascadeSpec:
-    """Parsed ``--cascade front:big`` plus the calibration knobs — one
-    immutable value the CLI hands to the router and the boot print."""
+def is_degraded(token: str) -> bool:
+    """True for any hop's brownout-L2 degraded tier token."""
+    return isinstance(token, str) and token.endswith(DEGRADED_SUFFIX)
 
-    def __init__(self, front: str, big: str, *,
+
+def base_tier(token: str) -> str:
+    """The answering tier token with any degraded suffix stripped."""
+    if is_degraded(token):
+        return token[: -len(DEGRADED_SUFFIX)]
+    return token
+
+
+class CascadeSpec:
+    """Parsed ``--cascade t0:t1:...:big`` plus the calibration knobs —
+    one immutable value the CLI hands to the router and the boot
+    print.  Two positional names keep the PR 17 front:big form."""
+
+    def __init__(self, *tiers: str,
                  min_agreement: float = 0.98,
                  sample_period: int = 10,
                  min_sample: int = 200,
                  bins: int = 20,
-                 topk: int = 5):
-        if not front or not big or front == big:
+                 topk: int = 5,
+                 per_class: bool = False,
+                 class_min_sample: int = 50):
+        names = [str(t).strip() for t in tiers]
+        if len(names) < 2 or any(not n for n in names) \
+                or len(set(names)) != len(names):
             raise ValueError(
-                f"cascade needs two distinct model names, got "
-                f"{front!r}:{big!r}")
-        self.front = front
-        self.big = big
+                f"cascade needs >= 2 distinct model names, got "
+                f"{':'.join(names)!r}")
+        self.tiers = tuple(names)
+        self.front = names[0]
+        self.big = names[-1]
         self.min_agreement = float(min_agreement)
         self.sample_period = max(1, int(sample_period))
         self.min_sample = max(1, int(min_sample))
         self.bins = max(1, int(bins))
         self.topk = max(1, int(topk))
+        self.per_class = bool(per_class)
+        self.class_min_sample = max(1, int(class_min_sample))
 
     @classmethod
     def parse(cls, spec: str, **kw) -> "CascadeSpec":
-        front, sep, big = str(spec).partition(":")
-        if not sep:
+        names = [t.strip() for t in str(spec).split(":")]
+        if len(names) < 2:
             raise ValueError(
-                f"--cascade wants 'front:big', got {spec!r}")
-        return cls(front.strip(), big.strip(), **kw)
+                f"--cascade wants 't0:t1:...:big', got {spec!r}")
+        return cls(*names, **kw)
+
+    @property
+    def chain(self) -> str:
+        return ":".join(self.tiers)
+
+    def tier_token(self, i: int) -> str:
+        """The public tier token for chain position ``i``: "front" for
+        tier 0, "t<i>" for mid tiers, "big" for the final tier — the
+        X-DVT-Tier header values and the ``served`` stats keys (the
+        2-tier tokens are unchanged from PR 17)."""
+        if i == len(self.tiers) - 1:
+            return BIG
+        return FRONT if i == 0 else f"t{i}"
 
     def describe(self) -> dict:
         return {"front": self.front, "big": self.big,
+                "tiers": list(self.tiers),
                 "min_agreement": self.min_agreement,
                 "sample_period": self.sample_period,
                 "min_sample": self.min_sample,
-                "bins": self.bins, "topk": self.topk}
+                "bins": self.bins, "topk": self.topk,
+                "per_class": self.per_class,
+                "class_min_sample": self.class_min_sample}
+
+
+class _Hop:
+    """One hop's calibration state: tier i vs the big tier.  Mutable
+    fields are guarded by the router's leaf lock (the histogram has its
+    own internal lock)."""
+
+    def __init__(self, index: int, tier: str, token: str,
+                 bins: int, per_class: bool):
+        self.index = index
+        self.tier = tier
+        self.token = token
+        self.hist = AgreementHistogram(bins=bins, per_class=per_class)
+        # None = uncalibrated → fail closed (escalate-through)
+        self.threshold: float | None = None
+        self.class_thresholds: dict = {}
+        self.tick = 0
+        self.escalations = 0
+        self.samples = 0
+        self.samples_discarded = 0
+        self.restored = False
 
 
 class CascadeRouter:
-    """Route classify traffic addressed to ``spec.big`` through the
-    front tier, escalating below the calibrated threshold."""
+    """Route traffic addressed to ``spec.big`` down the tier chain,
+    escalating past each hop whose confidence misses its calibrated
+    threshold."""
 
     def __init__(self, plane, spec: CascadeSpec,
                  root: str | None = None):
         self.plane = plane
         self.spec = spec
-        self.hist = AgreementHistogram(bins=spec.bins)
         self._lock = new_lock("serve.cascade.CascadeRouter._lock")
-        # None = uncalibrated → fail closed (all-big); guarded-by: _lock
-        self._threshold: float | None = None
-        self._tick = 0  # guarded-by: _lock
+        self.hops = [
+            _Hop(i, name, spec.tier_token(i), spec.bins, spec.per_class)
+            for i, name in enumerate(spec.tiers[:-1])
+        ]  # hop mutable state guarded-by: _lock
+        self._tokens = [h.token for h in self.hops] + [BIG]
         # optional BrownoutController (serve/brownout.py) — the L1
-        # sampling pause and L2 degraded-front hooks; read racily
+        # sampling pause and L2 degraded hooks; read racily
         self.brownout = None
-        self.served = {FRONT: 0, BIG: 0}  # guarded-by: _lock
+        self.served = {t: 0 for t in self._tokens}  # guarded-by: _lock
         self.escalations = 0  # guarded-by: _lock
-        self.escalated_shed = 0  # no deadline left post-front; guarded-by: _lock
+        self.escalated_shed = 0  # no deadline left mid-chain; guarded-by: _lock
         self.escalated_lowconf = 0  # guarded-by: _lock
-        self.escalated_error = 0  # front Shed/Quarantined/raise; guarded-by: _lock
+        self.escalated_error = 0  # tier Shed/Quarantined/raise; guarded-by: _lock
         self.forced_big = 0  # always-big tenants; guarded-by: _lock
         self.samples = 0  # dual-run calibration requests; guarded-by: _lock
         self.samples_discarded = 0  # guarded-by: _lock
         self.samples_paused = 0  # brownout L1 skipped slots; guarded-by: _lock
-        self.degraded_served = 0  # brownout L2 below-threshold fronts; guarded-by: _lock
+        self.degraded_served = 0  # brownout L2 below-threshold answers; guarded-by: _lock
         self.calibrations = 0  # threshold (re)computed; guarded-by: _lock
         self.resets = 0  # version-swap calibration drops; guarded-by: _lock
-        self._latency = {FRONT: LatencyHistogram(),
-                         BIG: LatencyHistogram()}  # guarded-by: _lock
-        self._top1 = ClassifyWorkload.top1
+        self._latency = {t: LatencyHistogram()
+                         for t in self._tokens}  # guarded-by: _lock
+        self._rule = self._resolve_rule()
         # calibration ledger (None = memory-only, the test default)
         self._root = root
         self.restored = False
@@ -174,88 +257,113 @@ class CascadeRouter:
             self._restore()
         plane.add_version_listener(self._on_version_swap)
 
+    def _resolve_rule(self):
+        """The verb's CascadeWorkloadRule, from the BIG tier's workload
+        (every tier shares the verb — cli.serve validates the chain).
+        Falls back to the classify rule when the plane can't resolve
+        the tier yet (bare test planes) — the PR 17 behavior."""
+        try:
+            rule = self.plane.resolve(self.spec.big) \
+                .workload.cascade_rule()
+            if rule is not None:
+                return rule
+        except (KeyError, AttributeError):
+            pass
+        return ClassifyWorkload().cascade_rule()
+
     # -- routing table ------------------------------------------------------
 
     def serves(self, name: str) -> bool:
         """True when requests addressed to ``name`` route through the
-        cascade (only the big/logical name; the front model stays
-        directly addressable)."""
+        cascade (only the big/logical name; cheap tiers stay directly
+        addressable)."""
         return name == self.spec.big
 
     @property
+    def hist(self) -> AgreementHistogram:
+        """Hop 0's histogram — the 2-tier compatibility alias."""
+        return self.hops[0].hist
+
+    @property
     def threshold(self) -> float | None:
+        """Hop 0's pooled threshold — the 2-tier compatibility alias."""
         with self._lock:
-            return self._threshold
+            return self.hops[0].threshold
 
     def params_digest(self) -> str | None:
-        """Combined version identity of BOTH tiers — the response-cache
-        digest slot, so a reload of either tier stops old keys from
-        matching.  None (uncacheable) unless both tiers carry digests,
-        same contract as a single model without one."""
-        try:
-            f = getattr(self.plane.resolve(self.spec.front),
-                        "params_digest", None)
-            b = getattr(self.plane.resolve(self.spec.big),
-                        "params_digest", None)
-        except KeyError:
-            return None
-        if not f or not b:
-            return None
-        return f"{f}+{b}"
+        """Combined version identity of ALL tiers — the response-cache
+        digest slot and the calibration-ledger key, so a reload of ANY
+        tier stops old cache keys and persisted calibrations from
+        matching.  None (uncacheable) unless every tier carries a
+        digest, same contract as a single model without one."""
+        digests = []
+        for name in self.spec.tiers:
+            try:
+                d = getattr(self.plane.resolve(name),
+                            "params_digest", None)
+            except KeyError:
+                return None
+            if not d:
+                return None
+            digests.append(d)
+        return "+".join(digests)
 
     def canary_active(self) -> bool:
-        """Cache inserts pause while EITHER tier runs a canary — a
+        """Cache inserts pause while ANY tier runs a canary — a
         canary-served answer must not be filed under the steady-state
         combined digest."""
-        return self.plane.canary_active(self.spec.front) \
-            or self.plane.canary_active(self.spec.big)
+        return any(self.plane.canary_active(name)
+                   for name in self.spec.tiers)
+
+    def describe_member(self, name: str) -> dict | None:
+        """The ``cascade`` block for ``name``'s /v1/models entry: chain
+        membership, hop role, and where that hop's threshold came from
+        — None for models outside the chain."""
+        if name not in self.spec.tiers:
+            return None
+        i = self.spec.tiers.index(name)
+        out = {"chain": self.spec.chain, "tier": self.spec.tier_token(i)}
+        if name == self.spec.big:
+            out.update(role="big", hop=None,
+                       threshold_source="authoritative")
+            return out
+        out["role"] = "front" if i == 0 else "mid"
+        out["hop"] = i
+        hop = self.hops[i]
+        with self._lock:
+            calibrated = hop.threshold is not None \
+                or bool(hop.class_thresholds)
+            restored = hop.restored
+        out["threshold_source"] = (
+            "restored" if restored else
+            "calibrated" if calibrated else "uncalibrated")
+        return out
 
     # -- request path -------------------------------------------------------
 
     def submit(self, image, deadline_ms: float | None = None,
                span=None, force_big: bool = False) -> Future:
         """Route one request.  The future resolves to ``(tier, row)``
-        where ``tier`` is "front"/"big" (the ``X-DVT-Tier`` header) and
-        ``row`` is exactly what the named tier's engine produced —
-        including Shed/Quarantined verdicts, which the HTTP layer maps
-        to status codes the same way as for a plain model."""
+        where ``tier`` is the answering tier's token ("front"/"t1"/...
+        /"big", the ``X-DVT-Tier`` header; a ``-degraded`` suffix marks
+        brownout-L2 answers) and ``row`` is exactly what that tier's
+        engine produced — including Shed/Quarantined verdicts, which
+        the HTTP layer maps to status codes the same way as for a plain
+        model."""
         fut: Future = Future()
         t0 = time.monotonic()
         if deadline_ms is None:
             deadline_ms = _DEFAULT_DEADLINE_MS
         deadline_ms = float(deadline_ms)
-        with self._lock:
-            self._tick += 1
-            tick = self._tick
-            thr = self._threshold
-            if force_big:
-                self.forced_big += 1
         if force_big:
+            with self._lock:
+                self.forced_big += 1
             if span is not None:
                 span.mark("cascade_forced_big")
-            self._submit_big(image, deadline_ms, span, fut, t0)
+            self._submit_final(image, deadline_ms, span, fut, t0)
             return fut
-        bo = self.brownout
-        if tick % self.spec.sample_period == 0:
-            if bo is None or not bo.at_least(1):
-                self._submit_sample(image, deadline_ms, span, fut, t0)
-                return fut
-            # brownout L1+: the dual-run sample is optional work —
-            # skip the slot and route the request like any other
-            with self._lock:
-                self.samples_paused += 1
-        if thr is None:
-            # fail closed: uncalibrated traffic belongs to the big tier
-            self._submit_big(image, deadline_ms, span, fut, t0)
-            return fut
-        # decided at submit time so one request sees one policy even
-        # if the ladder moves while the front tier runs
-        degrade = bo is not None and bo.at_least(2)
-        ffut = self.plane.submit(self.spec.front, image, deadline_ms,
-                                 span=span)
-        ffut.add_done_callback(
-            lambda f: self._front_done(f, image, deadline_ms, span,
-                                       fut, t0, thr, degrade))
+        self._enter_hop(0, image, deadline_ms, deadline_ms, span, fut,
+                        t0)
         return fut
 
     def infer(self, image, deadline_ms: float | None = None,
@@ -265,55 +373,114 @@ class CascadeRouter:
         return self.submit(image, deadline_ms, span=span,
                            force_big=force_big).result(timeout)
 
-    def _submit_big(self, image, deadline_ms, span, fut: Future, t0):
-        bfut = self.plane.submit(self.spec.big, image, deadline_ms,
+    def _enter_hop(self, i: int, image, deadline_ms, budget_ms, span,
+                   fut: Future, t0):
+        """One request arrives at hop ``i`` with ``budget_ms`` of its
+        original ``deadline_ms`` left: maybe dual-run a calibration
+        sample, escalate-through when the hop is uncalibrated, else run
+        the tier and decide on its answer."""
+        if i >= len(self.hops):
+            self._submit_final(image, budget_ms, span, fut, t0)
+            return
+        hop = self.hops[i]
+        bo = self.brownout
+        with self._lock:
+            hop.tick += 1
+            tick = hop.tick
+            calibrated = hop.threshold is not None \
+                or bool(hop.class_thresholds)
+        if tick % self.spec.sample_period == 0:
+            if bo is None or not bo.at_least(1):
+                self._submit_sample(hop, image, budget_ms, span, fut,
+                                    t0)
+                return
+            # brownout L1+: the dual-run sample is optional work —
+            # skip the slot and route the request like any other
+            with self._lock:
+                self.samples_paused += 1
+        if not calibrated:
+            # fail closed: an uncalibrated hop escalates THROUGH — the
+            # tier is not run, no compute wasted on an answer nobody
+            # would trust
+            self._enter_hop(i + 1, image, deadline_ms, budget_ms, span,
+                            fut, t0)
+            return
+        # decided at submit time so one request sees one policy even
+        # if the ladder moves while the tier runs
+        degrade = bo is not None and bo.at_least(2)
+        tfut = self.plane.submit(hop.tier, image, budget_ms, span=span)
+        tfut.add_done_callback(
+            lambda f: self._hop_done(hop, f, image, deadline_ms, span,
+                                     fut, t0, degrade))
+
+    def _submit_final(self, image, budget_ms, span, fut: Future, t0):
+        bfut = self.plane.submit(self.spec.big, image, budget_ms,
                                  span=span)
         bfut.add_done_callback(lambda f: self._finish(f, fut, t0, BIG))
 
-    def _front_done(self, ffut: Future, image, deadline_ms, span,
-                    fut: Future, t0, thr: float,
-                    degrade: bool = False):
-        """Front answered (engine worker thread — never block): serve
-        it when confident, escalate otherwise."""
+    def _threshold_for(self, hop: _Hop, cls) -> float | None:
+        """The threshold governing this answer: the class's own entry
+        when the per-class axis has a qualifying sample for it — which
+        may be ``None`` (a measured-bad class fails closed and always
+        escalates) — else the hop's pooled threshold (None → escalate,
+        fail-closed)."""
+        with self._lock:
+            if cls is not None and hop.class_thresholds:
+                key = int(cls)
+                if key in hop.class_thresholds:
+                    return hop.class_thresholds[key]
+            return hop.threshold
+
+    def _hop_done(self, hop: _Hop, tfut: Future, image, deadline_ms,
+                  span, fut: Future, t0, degrade: bool = False):
+        """Tier ``hop.index`` answered (engine worker thread — never
+        block): serve it when confident, escalate otherwise."""
         try:
-            row = ffut.result()
-        except Exception:  # noqa: BLE001 — front failure must not reach the client; big owns the contract
-            self._escalate(image, deadline_ms, span, fut, t0, "error")
+            row = tfut.result()
+        except Exception:  # noqa: BLE001 — tier failure must not reach the client; big owns the contract
+            self._escalate(hop, image, deadline_ms, span, fut, t0,
+                           "error")
             return
         if isinstance(row, (Shed, Quarantined)):
-            # front shed/quarantined: the request still deserves the
-            # big tier's attempt — the client addressed the big name
-            self._escalate(image, deadline_ms, span, fut, t0, "error")
+            # tier shed/quarantined: the request still deserves the
+            # rest of the chain — the client addressed the big name
+            self._escalate(hop, image, deadline_ms, span, fut, t0,
+                           "error")
             return
-        _, conf = self._top1(row)
+        cls, conf = self._rule.signal(row)
         if conf is None:
-            # no confidence on the row (front missing its epilogue and
-            # a non-classify shape): never guess — escalate
-            self._escalate(image, deadline_ms, span, fut, t0, "error")
+            # no signal on the row (a tier missing its epilogue, a
+            # foreign shape): never guess — escalate
+            self._escalate(hop, image, deadline_ms, span, fut, t0,
+                           "error")
             return
-        if conf >= thr:
+        thr = self._threshold_for(hop, cls)
+        if thr is not None and conf >= thr:
             if span is not None:
-                span.mark("cascade_front_served")
-            self._finish_row(row, fut, t0, FRONT)
+                span.mark(f"cascade_{hop.token}_served")
+            self._finish_row(row, fut, t0, hop.token)
             return
-        if degrade:
-            # brownout L2: trade quality for the escalation's big-tier
-            # slot — the front answer stands, marked degraded
+        if degrade and thr is not None:
+            # brownout L2: trade quality for the escalation's slot —
+            # this tier's answer stands, marked degraded
             with self._lock:
                 self.degraded_served += 1
             if span is not None:
-                span.mark("cascade_degraded_front")
-            self._finish_row(row, fut, t0, FRONT, degraded=True)
+                span.mark("cascade_degraded")
+            self._finish_row(row, fut, t0, hop.token, degraded=True)
             return
-        self._escalate(image, deadline_ms, span, fut, t0, "lowconf")
+        self._escalate(hop, image, deadline_ms, span, fut, t0,
+                       "lowconf")
 
-    def _escalate(self, image, deadline_ms, span, fut: Future, t0,
-                  why: str):
-        """Re-admit on the big tier with the REMAINING deadline —
-        original budget minus the front attempt — so escalation never
-        doubles the SLO budget."""
+    def _escalate(self, hop: _Hop, image, deadline_ms, span,
+                  fut: Future, t0, why: str):
+        """Re-enter the next hop with the REMAINING deadline — original
+        budget minus everything earlier tiers burned — so a
+        twice-escalated request never exceeds its original SLO
+        budget."""
         with self._lock:
             self.escalations += 1
+            hop.escalations += 1
             if why == "lowconf":
                 self.escalated_lowconf += 1
             else:
@@ -324,15 +491,14 @@ class CascadeRouter:
                 self.escalated_shed += 1
             self._finish_row(
                 Shed("deadline",
-                     f"cascade escalation: front attempt consumed the "
-                     f"{deadline_ms:.0f}ms budget"),
+                     f"cascade escalation at hop {hop.index}: earlier "
+                     f"tiers consumed the {deadline_ms:.0f}ms budget"),
                 fut, t0, BIG)
             return
         if span is not None:
             span.mark("cascade_escalate")
-        bfut = self.plane.submit(self.spec.big, image, remaining_ms,
-                                 span=span)
-        bfut.add_done_callback(lambda f: self._finish(f, fut, t0, BIG))
+        self._enter_hop(hop.index + 1, image, deadline_ms,
+                        remaining_ms, span, fut, t0)
 
     def _finish(self, inner: Future, fut: Future, t0, tier: str):
         try:
@@ -347,19 +513,23 @@ class CascadeRouter:
         with self._lock:
             self.served[tier] += 1
             self._latency[tier].record(time.monotonic() - t0)
-        fut.set_result((DEGRADED if degraded else tier, row))
+        fut.set_result(
+            (tier + DEGRADED_SUFFIX if degraded else tier, row))
 
     # -- calibration --------------------------------------------------------
 
-    def _submit_sample(self, image, deadline_ms, span, fut: Future, t0):
-        """Dual-run calibration sample: BOTH tiers execute, the client
-        gets the big answer (authoritative), and front-vs-big top-1
-        agreement lands in the histogram at the front's confidence
-        bucket.  Same holder-pair idiom as the plane's shadow compare."""
+    def _submit_sample(self, hop: _Hop, image, budget_ms, span,
+                       fut: Future, t0):
+        """Dual-run calibration sample at hop ``hop.index``: the tier
+        AND the big tier execute, the client gets the big answer
+        (authoritative), and tier-vs-big agreement lands in the hop's
+        histogram at the tier's confidence bucket.  Same holder-pair
+        idiom as the plane's shadow compare."""
         with self._lock:
             self.samples += 1
-        ffut = self.plane.submit(self.spec.front, image, deadline_ms)
-        bfut = self.plane.submit(self.spec.big, image, deadline_ms,
+            hop.samples += 1
+        tfut = self.plane.submit(hop.tier, image, budget_ms)
+        bfut = self.plane.submit(self.spec.big, image, budget_ms,
                                  span=span)
         holder: dict = {}
 
@@ -371,70 +541,105 @@ class CascadeRouter:
                 if ready:
                     holder["_done"] = True
             if ready:
-                self._record_sample(holder["f"], holder["b"])
+                self._record_sample(hop, holder["f"], holder["b"])
 
-        ffut.add_done_callback(lambda f: arrived("f", f))
+        tfut.add_done_callback(lambda f: arrived("f", f))
         bfut.add_done_callback(lambda f: arrived("b", f))
         bfut.add_done_callback(lambda f: self._finish(f, fut, t0, BIG))
 
-    def _record_sample(self, ffut: Future, bfut: Future):
+    def _record_sample(self, hop: _Hop, tfut: Future, bfut: Future):
         try:
-            fr, br = ffut.result(), bfut.result()
+            tr, br = tfut.result(), bfut.result()
         except Exception:  # noqa: BLE001 — either side failed: nothing to compare
             with self._lock:
                 self.samples_discarded += 1
+                hop.samples_discarded += 1
             return
-        fcls, fconf = self._top1(fr)
-        bcls, _ = self._top1(br)
-        if fcls is None or fconf is None or bcls is None:
+        cls, conf = self._rule.signal(tr)
+        agreed = self._rule.agree(tr, br)
+        if conf is None or agreed is None:
             with self._lock:
                 self.samples_discarded += 1
+                hop.samples_discarded += 1
             return
-        self.hist.record(fconf, fcls == bcls)
-        self._recalibrate()
+        hop.hist.record(conf, agreed, cls=cls)
+        self._recalibrate(hop)
 
-    def _recalibrate(self):
-        thr = self.hist.threshold(self.spec.min_agreement,
-                                  self.spec.min_sample)
+    def _recalibrate(self, hop: _Hop | None = None):
+        """Recompute one hop's thresholds from its histogram (default
+        hop 0, the 2-tier compatibility surface) and persist on
+        change."""
+        if hop is None:
+            hop = self.hops[0]
+        thr = hop.hist.threshold(self.spec.min_agreement,
+                                 self.spec.min_sample)
+        cls_thr = {}
+        if self.spec.per_class:
+            cls_thr = hop.hist.class_thresholds(
+                self.spec.min_agreement, self.spec.class_min_sample)
         with self._lock:
-            old = self._threshold
-            self._threshold = thr
-            changed = thr != old
+            changed = thr != hop.threshold \
+                or cls_thr != hop.class_thresholds
+            hop.threshold = thr
+            hop.class_thresholds = cls_thr
             if changed:
                 self.calibrations += 1
         if changed:
             event(_log, "cascade_calibrated",
-                  front=self.spec.front, big=self.spec.big,
-                  threshold=thr,
-                  samples=self.hist.stats()["samples"])
-            h = self.hist.stats()
-            self._append_ledger({"event": "calibrated",
-                                 "threshold": thr,
-                                 "digest": self.params_digest(),
-                                 "bins": h["bins"],
-                                 "total": h["total"],
-                                 "agree": h["agree"]})
+                  chain=self.spec.chain, hop=hop.index, tier=hop.tier,
+                  threshold=thr, classes=len(cls_thr),
+                  samples=hop.hist.stats()["samples"])
+            h = hop.hist.stats()
+            rec = {"event": "calibrated",
+                   "hop": hop.index,
+                   "tier": hop.tier,
+                   "threshold": thr,
+                   "digest": self.params_digest(),
+                   "bins": h["bins"],
+                   "total": h["total"],
+                   "agree": h["agree"]}
+            if self.spec.per_class:
+                rec["class_counts"] = hop.hist.class_counts()
+            self._append_ledger(rec)
+
+    def _reset_hop(self, hop: _Hop):
+        hop.hist.reset()
+        with self._lock:
+            had = hop.threshold is not None \
+                or bool(hop.class_thresholds)
+            hop.threshold = None
+            hop.class_thresholds = {}
+            hop.restored = False
+            self.resets += 1
+        return had
 
     def _on_version_swap(self, name: str):
-        """Plane version listener: a reload/promote/revert of either
-        tier invalidates the calibration — fail closed and resample."""
-        if name not in (self.spec.front, self.spec.big):
+        """Plane version listener: a reload/promote/revert of tier i
+        invalidates ONLY hop i's calibration (its answer distribution
+        changed; other hops compare different tiers against big) —
+        while a swap of the BIG tier invalidates every hop (big is
+        every hop's comparison target).  Fail closed and resample."""
+        if name not in self.spec.tiers:
             return
-        self.hist.reset()
-        with self._lock:
-            had = self._threshold is not None
-            self._threshold = None
-            self.resets += 1
+        if name == self.spec.big:
+            had = False
+            for hop in self.hops:
+                had = self._reset_hop(hop) or had
+            self._append_ledger({"event": "reset", "model": name})
+        else:
+            hop = self.hops[self.spec.tiers.index(name)]
+            had = self._reset_hop(hop)
+            self._append_ledger({"event": "reset", "model": name,
+                                 "hop": hop.index})
         if had:
             event(_log, "cascade_recalibrating", model=name,
-                  front=self.spec.front, big=self.spec.big)
-        self._append_ledger({"event": "reset", "model": name})
+                  chain=self.spec.chain)
 
     # -- calibration persistence --------------------------------------------
 
     def _ledger_path(self) -> str:
         safe = "".join(c if c.isalnum() or c in "-_." else "_"
-                       for c in f"{self.spec.front}+{self.spec.big}")
+                       for c in "+".join(self.spec.tiers))
         return os.path.join(self._root, f"{safe}.jsonl")
 
     def _append_ledger(self, record: dict):
@@ -445,6 +650,7 @@ class CascadeRouter:
             return
         record = {"ts": round(time.time(), 3),
                   "front": self.spec.front, "big": self.spec.big,
+                  "tiers": list(self.spec.tiers),
                   **record}
         try:
             with open(self._ledger_path(), "a", encoding="utf-8") as f:
@@ -456,12 +662,13 @@ class CascadeRouter:
                   error=f"{type(e).__name__}: {e}")
 
     def _restore(self):
-        """Boot-time replay: adopt the ledger's newest calibration iff
-        its params digest matches BOTH live tiers.  A trailing reset, a
-        digest mismatch (either tier reloaded while down), a torn tail
-        line, or no ledger at all each leave the router exactly where
-        it started — uncalibrated and fail-closed."""
-        last = None
+        """Boot-time replay: adopt each hop's newest calibration iff
+        its params digest matches EVERY live tier — the ledger key
+        covers the whole chain, so ANY tier reloaded while down rejects
+        the record.  A trailing reset for the hop, a digest mismatch, a
+        torn tail line, or no ledger at all each leave that hop exactly
+        where it started — uncalibrated and fail-closed."""
+        last: dict = {}  # hop index -> last record affecting it
         try:
             with open(self._ledger_path(), encoding="utf-8") as f:
                 for line in f:
@@ -469,55 +676,121 @@ class CascadeRouter:
                     if not line:
                         continue
                     try:
-                        last = json.loads(line)
+                        rec = json.loads(line)
                     except ValueError:
                         continue  # torn tail line from a crash
+                    ev = rec.get("event")
+                    if ev == "calibrated":
+                        hop = int(rec.get("hop", 0))
+                        if 0 <= hop < len(self.hops):
+                            last[hop] = rec
+                    elif ev == "reset":
+                        hop = rec.get("hop")
+                        if hop is None:
+                            # a big-tier swap (or a PR 18 2-tier record
+                            # without hop info): every hop resets —
+                            # unless it named the front tier, which
+                            # only ever had hop 0
+                            if rec.get("model") == self.spec.front:
+                                last[0] = rec
+                            else:
+                                last = {i: rec
+                                        for i in range(len(self.hops))}
+                        elif 0 <= int(hop) < len(self.hops):
+                            last[int(hop)] = rec
         except OSError:
             return  # no ledger yet — first boot
-        if not last or last.get("event") != "calibrated":
-            return
         digest = self.params_digest()
-        if digest is None or last.get("digest") != digest:
-            event(_log, "cascade_restore_stale",
-                  front=self.spec.front, big=self.spec.big,
-                  ledger_digest=last.get("digest"), live_digest=digest)
-            return
-        try:
-            self.hist.restore(last["total"], last["agree"])
-        except (KeyError, TypeError, ValueError) as e:
-            event(_log, "cascade_restore_invalid",
-                  error=f"{type(e).__name__}: {e}")
-            return
-        # RE-derive the threshold from the restored counts instead of
-        # trusting the stored one: retuned --cascade-min-agreement /
-        # min-sample knobs apply to the old sample immediately, and a
-        # sample now too thin for the knobs stays fail-closed
-        thr = self.hist.threshold(self.spec.min_agreement,
-                                  self.spec.min_sample)
+        restored_any = False
+        for i, rec in sorted(last.items()):
+            if rec.get("event") != "calibrated":
+                continue
+            hop = self.hops[i]
+            if digest is None or rec.get("digest") != digest:
+                event(_log, "cascade_restore_stale",
+                      chain=self.spec.chain, hop=i,
+                      ledger_digest=rec.get("digest"),
+                      live_digest=digest)
+                continue
+            try:
+                hop.hist.restore(rec["total"], rec["agree"],
+                                 per_class=rec.get("class_counts"))
+            except (KeyError, TypeError, ValueError) as e:
+                event(_log, "cascade_restore_invalid", hop=i,
+                      error=f"{type(e).__name__}: {e}")
+                continue
+            # RE-derive thresholds from the restored counts instead of
+            # trusting the stored ones: retuned --cascade-min-agreement
+            # / min-sample knobs apply to the old sample immediately,
+            # and a sample now too thin for the knobs stays fail-closed
+            thr = hop.hist.threshold(self.spec.min_agreement,
+                                     self.spec.min_sample)
+            cls_thr = {}
+            if self.spec.per_class:
+                cls_thr = hop.hist.class_thresholds(
+                    self.spec.min_agreement,
+                    self.spec.class_min_sample)
+            calibrated = thr is not None or bool(cls_thr)
+            with self._lock:
+                hop.threshold = thr
+                hop.class_thresholds = cls_thr
+                hop.restored = calibrated
+            restored_any = restored_any or calibrated
+            event(_log, "cascade_restored",
+                  chain=self.spec.chain, hop=i, tier=hop.tier,
+                  threshold=thr, classes=len(cls_thr),
+                  samples=hop.hist.stats()["samples"],
+                  calibrated=calibrated)
         with self._lock:
-            self._threshold = thr
-            self.restored = thr is not None
-        event(_log, "cascade_restored",
-              front=self.spec.front, big=self.spec.big,
-              threshold=thr, samples=self.hist.stats()["samples"],
-              calibrated=thr is not None)
+            self.restored = restored_any
 
     # -- observability ------------------------------------------------------
 
+    def _hop_stats(self, hop: _Hop) -> dict:
+        """One hop's block for ``stats()["hops"]`` — caller holds no
+        locks; this takes the router lock briefly."""
+        hstats = hop.hist.stats()
+        with self._lock:
+            out = {
+                "hop": hop.index,
+                "tier": hop.tier,
+                "token": hop.token,
+                "threshold": hop.threshold,
+                "calibrated": hop.threshold is not None
+                or bool(hop.class_thresholds),
+                "class_thresholds": {str(c): v for c, v in
+                                     sorted(hop.class_thresholds
+                                            .items())},
+                "restored": hop.restored,
+                "escalations": hop.escalations,
+                "samples": hop.samples,
+                "samples_discarded": hop.samples_discarded,
+            }
+        out["agreement"] = hstats["agreement"]
+        out["sample_size"] = hstats["samples"]
+        return out
+
     def stats(self) -> dict:
         """The reserved ``cascade`` block in /v1/stats — serve/http.py
-        renders the ``dvt_cascade_*`` /metrics series from it, and the
-        gateway folds it into its fleet view."""
-        hstats = self.hist.stats()
+        renders the ``dvt_cascade_*`` series from it, and the gateway
+        folds it into its fleet view.  Top-level threshold/agreement
+        keys mirror hop 0 (the PR 17 2-tier surface); ``hops`` carries
+        the full per-hop picture."""
+        hop0 = self.hops[0]
+        h0stats = hop0.hist.stats()
+        hop_blocks = [self._hop_stats(h) for h in self.hops]
         with self._lock:
             served = dict(self.served)
-            routed = served[FRONT] + self.escalated_lowconf \
-                + self.escalated_shed
+            routed = sum(served[t] for t in served if t != BIG) \
+                + self.escalated_lowconf + self.escalated_shed
             out = {
                 "front": self.spec.front,
                 "big": self.spec.big,
-                "threshold": self._threshold,
-                "calibrated": self._threshold is not None,
+                "tiers": list(self.spec.tiers),
+                "per_class": self.spec.per_class,
+                "threshold": hop0.threshold,
+                "calibrated": hop0.threshold is not None
+                or bool(hop0.class_thresholds),
                 "min_agreement": self.spec.min_agreement,
                 "sample_period": self.spec.sample_period,
                 "min_sample": self.spec.min_sample,
@@ -526,8 +799,8 @@ class CascadeRouter:
                 "escalated_lowconf": self.escalated_lowconf,
                 "escalated_error": self.escalated_error,
                 "escalated_shed": self.escalated_shed,
-                # of the requests the front tier actually judged, how
-                # many it sent upstairs — the live economics gauge
+                # of the requests cheap tiers actually judged, how
+                # many went upstairs — the live economics gauge
                 "escalation_rate": ((self.escalated_lowconf
                                      + self.escalated_shed) / routed)
                 if routed else None,
@@ -541,14 +814,15 @@ class CascadeRouter:
                 "restored": self.restored,
                 "ledger_root": self._root,
                 "ledger_write_errors": self.ledger_write_errors,
-                "agreement": hstats["agreement"],
-                "agreement_bins": {"bins": hstats["bins"],
-                                   "samples": hstats["samples"],
-                                   "total": hstats["total"],
-                                   "agree": hstats["agree"]},
+                "agreement": h0stats["agreement"],
+                "agreement_bins": {"bins": h0stats["bins"],
+                                   "samples": h0stats["samples"],
+                                   "total": h0stats["total"],
+                                   "agree": h0stats["agree"]},
                 "latency": {t: h.percentiles()
                             for t, h in self._latency.items()},
                 "latency_hist": {t: h.state_dict()
                                  for t, h in self._latency.items()},
             }
+        out["hops"] = hop_blocks
         return out

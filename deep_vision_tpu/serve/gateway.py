@@ -1047,14 +1047,17 @@ class Gateway:
     def _aggregate_cascade(agg: dict):
         """Fold each backend's reserved ``cascade`` stats block into
         one fleet view: summed tier/escalation/sample counters, a
-        fleet-wide escalation rate, and per-tier latency percentiles
-        from bin-wise-merged histograms (true fleet quantiles, same
-        construction as the backend-latency merge above).  None when no
-        backend runs a cascade."""
+        fleet-wide escalation rate, per-HOP escalation/sample/
+        agreement-sample folds keyed by (hop, tier) across the chain,
+        and per-tier latency percentiles from bin-wise-merged
+        histograms (true fleet quantiles, same construction as the
+        backend-latency merge above).  None when no backend runs a
+        cascade."""
         served: dict = {}
         esc = esc_low = esc_shed = samples = forced = 0
         backends = []
         hists: dict = {}
+        hops: dict = {}  # hop index -> folded per-hop block
         for bname, bstats in agg.items():
             cas = bstats.get("cascade") \
                 if isinstance(bstats, dict) else None
@@ -1068,6 +1071,22 @@ class Gateway:
             esc_shed += int(cas.get("escalated_shed") or 0)
             samples += int(cas.get("samples") or 0)
             forced += int(cas.get("forced_big") or 0)
+            for hop in (cas.get("hops") or []):
+                if not isinstance(hop, dict):
+                    continue
+                i = hop.get("hop")
+                agg_hop = hops.setdefault(
+                    i, {"hop": i, "tier": hop.get("tier"),
+                        "token": hop.get("token"),
+                        "escalations": 0, "samples": 0,
+                        "sample_size": 0, "calibrated_backends": 0})
+                agg_hop["escalations"] += int(
+                    hop.get("escalations") or 0)
+                agg_hop["samples"] += int(hop.get("samples") or 0)
+                agg_hop["sample_size"] += int(
+                    hop.get("sample_size") or 0)
+                if hop.get("calibrated"):
+                    agg_hop["calibrated_backends"] += 1
             for tier, h in (cas.get("latency_hist") or {}).items():
                 if not h:
                     continue
@@ -1082,7 +1101,11 @@ class Gateway:
                     pass  # malformed or mismatched bins: skip
         if not backends:
             return None
-        routed = served.get("front", 0) + esc_low + esc_shed
+        # everything a non-final tier answered was "judged" by the
+        # chain; escalations that ended big-served or shed complete the
+        # denominator (the 2-tier formula, generalized)
+        routed = sum(n for t, n in served.items() if t != "big") \
+            + esc_low + esc_shed
         return {"backends": backends,
                 "served": served,
                 "escalations": esc,
@@ -1090,6 +1113,7 @@ class Gateway:
                 if routed else None,
                 "samples": samples,
                 "forced_big": forced,
+                "hops": [hops[i] for i in sorted(hops)],
                 "latency": {t: h.percentiles()
                             for t, h in hists.items()}}
 
@@ -1278,13 +1302,24 @@ def render_gateway_metrics(gw: Gateway, edge: dict | None = None) -> str:
                   help="Cascade escalations summed across backends")
         p.gauge("dvt_gateway_cascade_escalation_rate",
                 cas.get("escalation_rate"),
-                help="Fleet-wide fraction of front-judged requests "
-                     "escalated to the big tier")
+                help="Fleet-wide fraction of cheap-tier-judged "
+                     "requests escalated down the chain")
         for tier, n in sorted((cas.get("served") or {}).items()):
             p.counter("dvt_gateway_cascade_requests_total", n,
                       {"tier": str(tier)},
                       help="Cascade answers fleet-wide by answering "
                            "tier")
+        for hop in (cas.get("hops") or []):
+            hlab = {"hop": str(hop.get("hop")),
+                    "tier": str(hop.get("tier"))}
+            p.counter("dvt_gateway_cascade_hop_escalations_total",
+                      hop.get("escalations"), hlab,
+                      help="Requests this hop escalated onward, "
+                           "summed across backends")
+            p.gauge("dvt_gateway_cascade_hop_calibrated_backends",
+                    hop.get("calibrated_backends"), hlab,
+                    help="Backends where this hop currently holds a "
+                         "calibrated threshold")
     tr = g.get("trace") or {}
     p.counter("dvt_gateway_traces_finished_total", tr.get("finished"),
               help="Gateway spans sealed into the ring")

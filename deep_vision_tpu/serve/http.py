@@ -151,7 +151,8 @@ from urllib.parse import parse_qs
 from deep_vision_tpu.obs.trace import REQUEST_ID_HEADER, new_request_id
 from deep_vision_tpu.serve.admission import TENANT_HEADER
 from deep_vision_tpu.serve.cache import ResponseCache, payload_digest
-from deep_vision_tpu.serve.cascade import DEGRADED as CASCADE_DEGRADED
+from deep_vision_tpu.serve.cascade import base_tier as cascade_base_tier
+from deep_vision_tpu.serve.cascade import is_degraded as cascade_degraded
 from deep_vision_tpu.serve.edge import (
     _CHUNK_END,
     DEFAULT_MAX_CONNECTIONS,
@@ -521,27 +522,57 @@ def _render_cascade_metrics(p, cas: dict) -> None:
     docs/OBSERVABILITY.md tabulates these)."""
     lab = {"front": str(cas.get("front")), "big": str(cas.get("big"))}
     p.counter("dvt_cascade_escalations_total", cas.get("escalations"),
-              lab, help="Requests the front tier sent to the big tier "
-                        "(low confidence, front errors, and "
+              lab, help="Requests a cheap tier escalated down the "
+                        "chain (low confidence, tier errors, and "
                         "deadline-exhausted escalations)")
-    for tier in ("front", "big"):
-        p.counter("dvt_cascade_requests_total",
-                  (cas.get("served") or {}).get(tier),
+    for tier, n in sorted((cas.get("served") or {}).items()):
+        p.counter("dvt_cascade_requests_total", n,
                   {**lab, "tier": tier},
                   help="Cascade requests answered, by the tier that "
                        "produced the answer")
     p.gauge("dvt_cascade_escalation_rate", cas.get("escalation_rate"),
-            lab, help="Of requests the front tier judged, the fraction "
-                      "escalated — the live cascade-economics gauge")
-    p.gauge("dvt_cascade_threshold", cas.get("threshold"), lab,
-            help="Calibrated confidence threshold (absent while "
-                 "uncalibrated — fail-closed, all traffic big)")
+            lab, help="Of requests the cheap tiers judged, the "
+                      "fraction escalated — the live "
+                      "cascade-economics gauge")
+    # per-HOP threshold/agreement/calibrated series: each hop
+    # calibrates tier-i-vs-big independently, so one scalar cannot
+    # describe an N-tier chain
+    for hop in (cas.get("hops") or []):
+        hlab = {**lab, "hop": str(hop.get("hop")),
+                "tier": str(hop.get("tier"))}
+        p.gauge("dvt_cascade_threshold", hop.get("threshold"), hlab,
+                help="Calibrated confidence threshold per hop (absent "
+                     "while uncalibrated — fail-closed, that hop "
+                     "escalates through)")
+        cls_thr = hop.get("class_thresholds") or {}
+        # None entries are fail-closed classes (measured-bad) — they
+        # have no threshold value to chart
+        vals = sorted(v for v in cls_thr.values() if v is not None)
+        if vals:
+            mid = vals[len(vals) // 2]
+            p.gauge("dvt_cascade_class_threshold_min", vals[0], hlab,
+                    help="Smallest per-class calibrated threshold at "
+                         "this hop (per-class axis active)")
+            p.gauge("dvt_cascade_class_threshold_median", mid, hlab,
+                    help="Median per-class calibrated threshold at "
+                         "this hop")
+            p.gauge("dvt_cascade_class_threshold_max", vals[-1], hlab,
+                    help="Largest per-class calibrated threshold at "
+                         "this hop")
+            p.gauge("dvt_cascade_class_thresholds", len(vals), hlab,
+                    help="Classes with their own calibrated threshold "
+                         "at this hop")
+        p.gauge("dvt_cascade_hop_agreement", hop.get("agreement"),
+                hlab, help="Tier-vs-big agreement over this hop's "
+                           "live calibration sample")
+        p.counter("dvt_cascade_hop_escalations_total",
+                  hop.get("escalations"), hlab,
+                  help="Requests this hop escalated onward")
     p.gauge("dvt_cascade_calibrated",
             1 if cas.get("calibrated") else 0, lab,
-            help="1 while a calibrated threshold routes traffic to "
-                 "the front tier")
+            help="1 while hop 0 holds a calibrated threshold")
     p.gauge("dvt_cascade_agreement", cas.get("agreement"), lab,
-            help="Front-vs-big top-1 agreement over the live "
+            help="Hop-0 tier-vs-big agreement over the live "
                  "calibration sample")
     p.counter("dvt_cascade_calibration_samples_total",
               cas.get("samples"), lab,
@@ -882,11 +913,11 @@ class _Handler(BaseHTTPRequestHandler):
             self._tier, result = cascade.infer(
                 x, deadline_ms=deadline_ms, span=self._span,
                 force_big=force_big)
-            if self._tier == CASCADE_DEGRADED:
-                # brownout L2 forced a sub-threshold front answer: the
-                # tier header stays "front" (it IS the front tier), the
-                # degraded marker carries the quality caveat
-                self._tier = "front"
+            if cascade_degraded(self._tier):
+                # brownout L2 forced a sub-threshold answer at some
+                # hop: the tier header names that tier (it DID answer),
+                # the degraded marker carries the quality caveat
+                self._tier = cascade_base_tier(self._tier)
                 self._degraded = True
         elif plane is not None:
             # plane routing: canary/shadow splits + cross-version
@@ -1095,6 +1126,21 @@ class _Handler(BaseHTTPRequestHandler):
         if cascade is not None:
             stats["cascade"] = cascade.stats()
 
+    def _models_with_cascade(self, models: dict) -> dict:
+        """Annotate /v1/models entries for chain members with the
+        router's ``cascade`` block (chain, hop role, threshold source)
+        — models outside the chain pass through untouched."""
+        cascade = getattr(self.server, "cascade", None)
+        if cascade is None:
+            return models
+        for name, entry in models.items():
+            if not isinstance(entry, dict):
+                continue
+            block = cascade.describe_member(name)
+            if block is not None:
+                entry["cascade"] = block
+        return models
+
     def _job_results_ndjson(self, job_id: str):
         """The results stream body: one JSON line per completed item
         (contiguous shard prefix, manifest order) and a trailing
@@ -1211,11 +1257,12 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, stats)
         elif path == "/v1/models":
             if plane is not None:
-                self._reply(200, {"models": plane.models()})
+                self._reply(200, {"models": self._models_with_cascade(
+                    plane.models())})
                 return
-            self._reply(200, {"models": {
+            self._reply(200, {"models": self._models_with_cascade({
                 name: {"model": self.server.registry.get(name).describe()}
-                for name in self.server.registry.names()}})
+                for name in self.server.registry.names()})})
         elif path == "/metrics":
             if plane is not None:
                 stats = plane.stats()

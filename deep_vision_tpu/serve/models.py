@@ -347,54 +347,111 @@ class ModelVersion:
 
 
 class AgreementHistogram:
-    """Front-vs-big top-1 agreement per front-confidence bucket — the
-    cascade calibration sample (serve/cascade.py).
+    """Tier-vs-big agreement per tier-confidence bucket — one cascade
+    hop's calibration sample (serve/cascade.py).
 
     Fixed bins over [0, 1): sample i lands in
-    ``floor(conf * bins)`` and records whether the front tier's top-1
+    ``floor(conf * bins)`` and records whether the cheap tier's answer
     matched the big tier's.  ``threshold()`` answers the calibration
     question: the smallest confidence at which routing everything
-    at-or-above it to the front tier still clears the operator's
+    at-or-above it to the cheap tier still clears the operator's
     agreement floor — computed from suffix sums, so it is exactly "the
-    measured agreement of the traffic the front tier would answer".
+    measured agreement of the traffic the cheap tier would answer".
     Deterministic for a given sample sequence (no RNG anywhere), which
-    is what makes calibration testable with a seeded sample."""
+    is what makes calibration testable with a seeded sample.
 
-    def __init__(self, bins: int = 20):
+    ``per_class=True`` adds a per-CLASS axis: each sample ALSO lands in
+    its predicted class's own (bins)-count row, and
+    ``class_thresholds()`` derives an independent threshold per class
+    from the classes whose own sample is thick enough — so a class the
+    cheap tier is systematically wrong about escalates at confidences
+    where the pooled histogram would have served it (skewed-class
+    calibration, the ROADMAP follow-up).  Class rows are lazy (a dict
+    keyed by class id), so no class count is needed up front."""
+
+    def __init__(self, bins: int = 20, per_class: bool = False):
         self.bins = max(1, int(bins))
+        self.per_class = bool(per_class)
         self._lock = new_lock("serve.models.AgreementHistogram._lock")
         self._total = [0] * self.bins  # guarded-by: _lock
         self._agree = [0] * self.bins  # guarded-by: _lock
+        # class id -> per-bin counts, lazily created; guarded-by: _lock
+        self._cls_total: dict = {}
+        self._cls_agree: dict = {}
 
-    def record(self, confidence: float, agreed: bool):
+    def record(self, confidence: float, agreed: bool, cls=None):
         conf = min(max(float(confidence), 0.0), 1.0)
         i = min(int(conf * self.bins), self.bins - 1)
         with self._lock:
             self._total[i] += 1
             if agreed:
                 self._agree[i] += 1
+            if self.per_class and cls is not None:
+                c = int(cls)
+                t = self._cls_total.setdefault(c, [0] * self.bins)
+                a = self._cls_agree.setdefault(c, [0] * self.bins)
+                t[i] += 1
+                if agreed:
+                    a[i] += 1
 
     def reset(self):
         with self._lock:
             self._total = [0] * self.bins
             self._agree = [0] * self.bins
+            self._cls_total = {}
+            self._cls_agree = {}
 
-    def restore(self, total, agree):
-        """Adopt persisted per-bin counts — the cascade calibration
-        ledger's boot replay (serve/cascade.py).  Shape and sanity are
-        the caller's digest check's problem; this only enforces that
-        the counts fit THIS histogram's binning."""
+    @staticmethod
+    def _check_counts(bins: int, total, agree) -> tuple:
         total = [int(x) for x in total]
         agree = [int(x) for x in agree]
-        if len(total) != self.bins or len(agree) != self.bins:
-            raise ValueError(f"persisted bins {len(total)} != "
-                             f"{self.bins}")
+        if len(total) != bins or len(agree) != bins:
+            raise ValueError(f"persisted bins {len(total)} != {bins}")
         if any(a > t or t < 0 or a < 0
                for t, a in zip(total, agree)):
             raise ValueError("persisted counts are inconsistent")
+        return total, agree
+
+    def restore(self, total, agree, per_class=None):
+        """Adopt persisted per-bin counts — the cascade calibration
+        ledger's boot replay (serve/cascade.py).  Shape and sanity are
+        the caller's digest check's problem; this only enforces that
+        the counts fit THIS histogram's binning.  ``per_class`` maps
+        class id (JSON string keys fine) to {"total", "agree"} rows and
+        is ignored unless this histogram tracks the class axis."""
+        total, agree = self._check_counts(self.bins, total, agree)
+        cls_total: dict = {}
+        cls_agree: dict = {}
+        if self.per_class and per_class:
+            for key, row in per_class.items():
+                c = int(key)
+                t, a = self._check_counts(
+                    self.bins, row["total"], row["agree"])
+                cls_total[c] = t
+                cls_agree[c] = a
         with self._lock:
             self._total = total
             self._agree = agree
+            self._cls_total = cls_total
+            self._cls_agree = cls_agree
+
+    @staticmethod
+    def _derive(bins: int, total, agree, min_agreement: float,
+                min_sample: int) -> float | None:
+        """The suffix-sum walk over ONE count row (the pooled histogram
+        or a single class's) — see ``threshold`` for the contract."""
+        if sum(total) < max(1, int(min_sample)):
+            return None
+        suf_t = suf_a = 0
+        best = None
+        # walk top bin down so each step extends the suffix by one bin;
+        # the LAST qualifying populated edge is the smallest qualifying t
+        for i in range(bins - 1, -1, -1):
+            suf_t += total[i]
+            suf_a += agree[i]
+            if total[i] > 0 and suf_a / suf_t >= float(min_agreement):
+                best = i / bins
+        return best
 
     def threshold(self, min_agreement: float,
                   min_sample: int) -> float | None:
@@ -412,29 +469,52 @@ class AgreementHistogram:
         with self._lock:
             total = list(self._total)
             agree = list(self._agree)
-        if sum(total) < max(1, int(min_sample)):
-            return None
-        suf_t = suf_a = 0
-        best = None
-        # walk top bin down so each step extends the suffix by one bin;
-        # the LAST qualifying populated edge is the smallest qualifying t
-        for i in range(self.bins - 1, -1, -1):
-            suf_t += total[i]
-            suf_a += agree[i]
-            if total[i] > 0 and suf_a / suf_t >= float(min_agreement):
-                best = i / self.bins
-        return best
+        return self._derive(self.bins, total, agree,
+                            min_agreement, min_sample)
+
+    def class_thresholds(self, min_agreement: float,
+                         min_sample: int) -> dict:
+        """Per-class thresholds for every class whose OWN sample clears
+        ``min_sample``: the class's qualifying threshold, or ``None``
+        when no confidence level clears the floor — a measured-bad
+        class FAILS CLOSED (always escalates) instead of riding the
+        pooled threshold it is known to violate.  Classes absent from
+        the map (sample too thin) fall back to the pooled threshold."""
+        with self._lock:
+            rows = {c: (list(self._cls_total[c]),
+                        list(self._cls_agree[c]))
+                    for c in self._cls_total}
+        out = {}
+        for c, (total, agree) in sorted(rows.items()):
+            if sum(total) < max(1, int(min_sample)):
+                continue
+            out[c] = self._derive(self.bins, total, agree,
+                                  min_agreement, min_sample)
+        return out
 
     def stats(self) -> dict:
         with self._lock:
             total = list(self._total)
             agree = list(self._agree)
+            cls_n = {c: sum(t) for c, t in self._cls_total.items()}
         n = sum(total)
-        return {"bins": self.bins,
-                "samples": n,
-                "agreement": (sum(agree) / n) if n else None,
-                "total": total,
-                "agree": agree}
+        out = {"bins": self.bins,
+               "samples": n,
+               "agreement": (sum(agree) / n) if n else None,
+               "total": total,
+               "agree": agree}
+        if self.per_class:
+            out["class_samples"] = {str(c): cls_n[c]
+                                    for c in sorted(cls_n)}
+        return out
+
+    def class_counts(self) -> dict:
+        """Per-class count rows for the persistence ledger — JSON-safe
+        {class id as str: {"total": [...], "agree": [...]}}."""
+        with self._lock:
+            return {str(c): {"total": list(self._cls_total[c]),
+                             "agree": list(self._cls_agree[c])}
+                    for c in sorted(self._cls_total)}
 
 
 class ModelControlPlane:
@@ -875,6 +955,10 @@ class ModelControlPlane:
             old, "detect_score_threshold", 0.05)
         sm.detect_iou_threshold = getattr(
             old, "detect_iou_threshold", 0.5)
+        sm.detect_soft_nms = getattr(old, "detect_soft_nms", "off")
+        sm.detect_soft_sigma = getattr(old, "detect_soft_sigma", 0.5)
+        sm.detect_max_per_class = getattr(
+            old, "detect_max_per_class", 0)
         sm.restored_step = info.get("step")
         sm.restore_fallback = bool(info.get("fallback"))
         sm.restored_mtime = info.get("mtime")

@@ -143,17 +143,30 @@ def synthetic_calibration_batches(input_shape, n_batches: int = 2,
 def load_calibration_dir(calib_dir: str, input_shape,
                          n_batches: int = 2,
                          batch_size: int = 8) -> list:
-    """Held-out calibration data: ``*.npy`` files under ``calib_dir``,
-    each a uint8 HWC image or NHWC batch of ``input_shape`` images,
-    loaded in sorted order (deterministic) and re-batched."""
-    paths = sorted(glob.glob(os.path.join(calib_dir, "*.npy")))
+    """Held-out calibration data: ``*.npy`` files (or ``*.npz``
+    archives — the first array whose key is ``image``/``images``, else
+    the first array) under ``calib_dir``, each a uint8 HWC image or
+    NHWC batch of ``input_shape`` images, loaded in sorted order
+    (deterministic) and re-batched — the layout ``--gate-dir`` holdouts
+    share, so one directory feeds both the accuracy gate and int8
+    calibration."""
+    paths = sorted(glob.glob(os.path.join(calib_dir, "*.npy"))
+                   + glob.glob(os.path.join(calib_dir, "*.npz")))
     if not paths:
         raise FileNotFoundError(
-            f"no *.npy calibration files under {calib_dir}")
+            f"no *.npy/*.npz calibration files under {calib_dir}")
     imgs = []
     want = tuple(input_shape)
     for p in paths:
         a = np.load(p)
+        if isinstance(a, np.lib.npyio.NpzFile):
+            with a as z:
+                keys = list(z.files)
+                if not keys:
+                    raise ValueError(f"{p}: empty npz archive")
+                key = next((k for k in ("image", "images")
+                            if k in keys), keys[0])
+                a = z[key]
         if a.ndim == len(want):
             a = a[None]
         if a.ndim != len(want) + 1 or tuple(a.shape[1:]) != want:

@@ -144,6 +144,13 @@ class ServingModel:
         self.detect_topk: int = 100
         self.detect_score_threshold: float = 0.05
         self.detect_iou_threshold: float = 0.5
+        # suppression-rule knobs (ops/boxes.py): "off" keeps the
+        # reference hard NMS bit-identical; "gaussian"/"linear" switch
+        # to Soft-NMS score decay.  max_per_class > 0 caps how many
+        # boxes each class keeps in the fixed-K output (0 = uncapped).
+        self.detect_soft_nms: str = "off"
+        self.detect_soft_sigma: float = 0.5
+        self.detect_max_per_class: int = 0
 
     def compile_bucket(self, batch: int):
         raise NotImplementedError
@@ -255,7 +262,10 @@ class ServingModel:
             d["detect"] = {"decode": self.detect_decode,
                            "top_k": self.detect_topk,
                            "score_threshold": self.detect_score_threshold,
-                           "iou_threshold": self.detect_iou_threshold}
+                           "iou_threshold": self.detect_iou_threshold,
+                           "soft_nms": self.detect_soft_nms,
+                           "soft_sigma": self.detect_soft_sigma,
+                           "max_per_class": self.detect_max_per_class}
         return {"name": self.name, "task": self.task,
                 "workload": self.workload.verb, **d,
                 "input_shape": list(self.input_shape),
@@ -712,7 +722,10 @@ class ModelRegistry:
                         detect_decode: str = "device",
                         detect_topk: int = 100,
                         detect_score_threshold: float = 0.05,
-                        detect_iou_threshold: float = 0.5
+                        detect_iou_threshold: float = 0.5,
+                        detect_soft_nms: str = "off",
+                        detect_soft_sigma: float = 0.5,
+                        detect_max_per_class: int = 0
                         ) -> ServingModel:
         """``wire_dtype``: what clients ship and the engine H2D-transfers
         — "uint8" (raw 0–255 pixels, normalization fused into the bucket
@@ -735,7 +748,10 @@ class ModelRegistry:
         class-wise NMS into the bucket programs so the bulk D2H ships
         K fixed-size boxes per image; "host" keeps the dense pyramid
         rows and decodes per request in respond() — the A/B baseline.
-        Non-detect models ignore them."""
+        ``detect_soft_nms`` ("gaussian"/"linear") switches the fused
+        NMS to Soft-NMS score decay with ``detect_soft_sigma``, and
+        ``detect_max_per_class`` > 0 caps each class's share of the
+        fixed-K output.  Non-detect models ignore them."""
         from deep_vision_tpu.core.config import get_config
         from deep_vision_tpu.core.restore import load_state
 
@@ -756,6 +772,13 @@ class ModelRegistry:
         sm.detect_topk = int(detect_topk)
         sm.detect_score_threshold = float(detect_score_threshold)
         sm.detect_iou_threshold = float(detect_iou_threshold)
+        if str(detect_soft_nms) not in ("off", "gaussian", "linear"):
+            raise ValueError(f"detect_soft_nms '{detect_soft_nms}' "
+                             f"unsupported (have ('off', 'gaussian', "
+                             f"'linear'))")
+        sm.detect_soft_nms = str(detect_soft_nms)
+        sm.detect_soft_sigma = float(detect_soft_sigma)
+        sm.detect_max_per_class = int(detect_max_per_class)
         sm.restored_step = info.get("step")
         sm.restore_fallback = bool(info.get("fallback"))
         sm.restored_mtime = info.get("mtime")

@@ -166,9 +166,36 @@ class Workload:
         pre-workload behavior for detection pytrees)."""
         return None
 
+    def cascade_rule(self):
+        """This verb's :class:`CascadeWorkloadRule`, or None when the
+        verb cannot cascade (no escalation signal on its rows — pose
+        and generate today).  serve/cascade.py resolves the rule from
+        the BIG tier's workload at router construction."""
+        return None
+
     def describe(self) -> dict:
         return {"verb": self.verb, "slo": self.slo.describe(),
                 "cacheable_bytes": self.cacheable_bytes}
+
+
+class CascadeWorkloadRule:
+    """How one verb's rows drive the cascade (serve/cascade.py).
+
+    ``signal(row)`` extracts the escalation signal from a cheap tier's
+    row: ``(class, confidence)`` where ``confidence`` ∈ [0, 1] feeds
+    the hop's AgreementHistogram bucket and threshold comparison, and
+    ``class`` keys the optional per-class threshold axis (None = no
+    class, pooled threshold only).  ``(None, None)`` means the row
+    carries no signal (Shed/Quarantined, dense host rows) — the router
+    never guesses and escalates.  ``agree(tier_row, big_row)`` scores
+    one dual-run calibration sample: True/False, or None for
+    not-comparable (discarded).  Stateless, like the adapters."""
+
+    def signal(self, row) -> tuple:
+        raise NotImplementedError
+
+    def agree(self, tier_row, big_row):
+        raise NotImplementedError
 
 
 class ClassifyWorkload(Workload):
@@ -258,6 +285,9 @@ class ClassifyWorkload(Workload):
             return None
         return p == s
 
+    def cascade_rule(self):
+        return _ClassifyCascadeRule()
+
 
 class DetectWorkload(Workload):
     """Both detection families (YOLOv3 multi-scale heads, CenterNet
@@ -298,6 +328,18 @@ class DetectWorkload(Workload):
                 float(getattr(model, "detect_score_threshold", 0.05)),
                 float(getattr(model, "detect_iou_threshold", 0.5)))
 
+    @staticmethod
+    def nms_knobs(model) -> tuple:
+        """The suppression-variant knobs ``(soft_nms, soft_sigma,
+        max_per_class)`` — same attribute-threading contract as
+        ``knobs`` (``--detect-soft-nms`` / ``--detect-soft-sigma`` /
+        ``--detect-max-per-class``), defaults keeping the reference
+        hard-NMS behavior.  Kept separate so ``knobs``'s 3-tuple shape
+        stays stable for existing callers."""
+        return (str(getattr(model, "detect_soft_nms", "off") or "off"),
+                float(getattr(model, "detect_soft_sigma", 0.5)),
+                int(getattr(model, "detect_max_per_class", 0) or 0))
+
     def make_epilogue(self, model):
         """Detection decode fused into the bucket programs, family-
         switched on the model's task: YOLO traces the full
@@ -313,6 +355,7 @@ class DetectWorkload(Workload):
         if getattr(model, "detect_decode", "device") != "device":
             return None
         k, floor, iou = self.knobs(model)
+        soft, sigma, per_cls_k = self.nms_knobs(model)
         num_classes = int(model.num_classes)
         if getattr(model, "task", "") == "centernet":
             import jax.numpy as jnp
@@ -338,7 +381,9 @@ class DetectWorkload(Workload):
         def post(out):  # dvtlint: traced
             boxes, scores, classes, valid = postprocess(
                 out, num_classes, max_outputs=k, iou_threshold=iou,
-                score_threshold=floor, class_aware=True)
+                score_threshold=floor, class_aware=True,
+                soft_nms=soft, soft_sigma=sigma,
+                max_per_class=per_cls_k)
             return {"boxes": boxes, "scores": scores,
                     "classes": classes.astype(jnp.int32),
                     "valid": valid}
@@ -372,9 +417,11 @@ class DetectWorkload(Workload):
                     "valid": (scores >= floor).astype(np.float32)}
         from deep_vision_tpu.tasks.detection import postprocess
 
+        soft, sigma, per_cls_k = self.nms_knobs(model)
         boxes, scores, classes, valid = postprocess(
             outs, model.num_classes, max_outputs=k, iou_threshold=iou,
-            score_threshold=floor, class_aware=True)
+            score_threshold=floor, class_aware=True,
+            soft_nms=soft, soft_sigma=sigma, max_per_class=per_cls_k)
         return {"boxes": np.asarray(boxes[0]),
                 "scores": np.asarray(scores[0]),
                 "classes": np.asarray(classes[0]),
@@ -465,6 +512,64 @@ class DetectWorkload(Workload):
                 taken[cand[j]] = True
                 matched += 1
         return matched / max(n_p, n_s) >= self.min_match_frac
+
+    def cascade_rule(self):
+        return _DetectCascadeRule(self)
+
+
+class _ClassifyCascadeRule(CascadeWorkloadRule):
+    """Classify cascades on the fused top-1: confidence is the front
+    row's ``topk_prob[0]`` (softmax of dense logits for hosts without
+    the epilogue), class is its ``topk_class[0]``, and a dual-run
+    sample agrees when the two tiers' top-1 classes match — exactly
+    the PR 17 behavior, now behind the rule interface."""
+
+    def signal(self, row) -> tuple:
+        return ClassifyWorkload.top1(row)
+
+    def agree(self, tier_row, big_row):
+        t, _ = ClassifyWorkload.top1(tier_row)
+        b, _ = ClassifyWorkload.top1(big_row)
+        if t is None or b is None:
+            return None
+        return t == b
+
+
+class _DetectCascadeRule(CascadeWorkloadRule):
+    """Detect cascades on the device-decoded row: the escalation
+    signal is valid-count + max-score — an empty answer (zero valid
+    boxes) signals confidence 0.0 so empty scenes escalate unless the
+    calibration sample proves the cheap tier reliably agrees on them
+    (bin 0 qualifying), and a non-empty answer signals its best box's
+    score with that box's class keying the per-class axis.  Dual-run
+    agreement is the greedy-IoU mAP proxy (``DetectWorkload.agree``).
+    Dense host rows carry no signal → ``(None, None)`` → escalate."""
+
+    def __init__(self, workload):
+        self._workload = workload
+
+    def signal(self, row) -> tuple:
+        import numpy as np
+
+        if not isinstance(row, dict):
+            return None, None
+        try:
+            s = np.asarray(row["scores"], np.float32).reshape(-1)
+            c = np.asarray(row["classes"]).reshape(-1)
+            v = np.asarray(row["valid"], np.float32).reshape(-1)
+        except (KeyError, TypeError, ValueError):
+            return None, None
+        if s.shape[0] != v.shape[0] or c.shape[0] != v.shape[0]:
+            return None, None
+        keep = v > 0
+        if not keep.any():
+            return None, 0.0
+        s, c = s[keep], c[keep]
+        j = int(np.argmax(s))
+        return int(c[j]), float(min(max(s[j], 0.0), 1.0))
+
+    def agree(self, tier_row, big_row):
+        return self._workload.agree(tier_row, big_row)
 
 
 class PoseWorkload(Workload):

@@ -288,7 +288,9 @@ def postprocess(outputs, num_classes: int, max_outputs: int = 100,
                 anchors: np.ndarray = YOLO_ANCHORS,
                 masks: np.ndarray = ANCHOR_MASKS,
                 pre_nms_top_k: int = 512,
-                class_aware: bool = False):
+                class_aware: bool = False,
+                soft_nms: str = "off", soft_sigma: float = 0.5,
+                max_per_class: int = 0):
     """raw 3-scale outputs → (boxes (B,K,4) corners, scores (B,K),
     classes (B,K), valid (B,K)).
 
@@ -305,6 +307,13 @@ def postprocess(outputs, num_classes: int, max_outputs: int = 100,
     reference's class-agnostic eval behavior.  Fully jittable either
     way: this whole function traces into the AOT bucket programs
     (serve/workloads.DetectWorkload.make_epilogue).
+
+    ``soft_nms``/``soft_sigma`` switch suppression to Soft-NMS decay
+    and ``max_per_class`` caps each class's kept boxes — the
+    ``--detect-*`` serving knobs, threaded to ops/boxes.nms_single
+    (per-class K needs ``class_aware=True``; it is ignored in
+    class-agnostic mode where per-box labels do not partition the
+    kept set).
     """
     all_boxes, all_scores, all_cls = [], [], []
     anchors = jnp.asarray(anchors)
@@ -326,7 +335,9 @@ def postprocess(outputs, num_classes: int, max_outputs: int = 100,
     classes = jnp.take_along_axis(classes, top_idx, axis=1)
     idx, sel_scores, valid = batched_nms(
         boxes, scores, max_outputs, iou_threshold, score_threshold,
-        classes=classes if class_aware else None)
+        classes=classes if class_aware else None,
+        soft=soft_nms, soft_sigma=soft_sigma,
+        max_per_class=max_per_class if class_aware else 0)
     sel_boxes = jnp.take_along_axis(boxes, idx[..., None], axis=1)
     sel_classes = jnp.take_along_axis(classes, idx, axis=1)
     return sel_boxes, sel_scores, sel_classes, valid

@@ -8,7 +8,30 @@ from deep_vision_tpu.core.config import (
     TrainConfig,
     register_config,
 )
-from deep_vision_tpu.models.lenet import LeNet5, LeNet5Big
+from deep_vision_tpu.models.lenet import LeNet5, LeNet5Big, LeNet5Nano
+
+
+@register_config("lenet5_nano")
+def lenet5_nano() -> TrainConfig:
+    """The N-tier cascade's tier 0 below lenet5: identical wire
+    contract (32×32×1, 10 classes) at ~12× less compute than LeNet-5 —
+    the front of the lenet5_nano:lenet5:lenet5_big chain
+    ``bench.py --serve-cascade --tiers 3`` and the cascade smoke run
+    (serve/cascade.py)."""
+    return TrainConfig(
+        name="lenet5_nano",
+        model=lambda: LeNet5Nano(),
+        task="classification",
+        batch_size=64,
+        total_epochs=50,
+        optimizer=OptimizerConfig(name="adam", learning_rate=1e-3),
+        scheduler=SchedulerConfig(
+            name="plateau", kwargs=dict(mode="max", factor=0.1, patience=10)),
+        half_precision=False,
+        image_size=32,
+        channels=1,
+        num_classes=10,
+    )
 
 
 @register_config("lenet5")

@@ -1,14 +1,24 @@
 """`make cascade-smoke`: the confidence-routed cascade end to end
-through the real CLI wiring (cli.serve.build_server with --models
-lenet5,lenet5_big --cascade lenet5:lenet5_big) on a random port, with
-an injected transient compute fault.  Clients address the BIG model;
-the smoke hammers it from threads while asserting: fail-closed all-big
-service before calibration, live dual-run calibration flipping the
-router to the front tier (X-DVT-Tier header), an always-big QoS tenant
-(X-DVT-Tenant) never leaving the big tier, a mid-load front-tier
-reload resetting and then RE-calibrating the threshold with zero
-client errors, and every /metrics line parsing as prometheus text with
-the dvt_cascade_* series present (docs/SERVING.md "Cascaded serving").
+through the real CLI wiring (cli.serve.build_server) on random ports.
+
+Lane 1 — a THREE-tier int8-fronted classify chain (--models
+lenet5_nano,lenet5,lenet5_big --cascade lenet5_nano:lenet5:lenet5_big
+--cascade-quant-front) with an injected transient compute fault.
+Clients address the BIG model; the smoke hammers it from threads while
+asserting: fail-closed all-big service before calibration, per-hop
+dual-run calibration flipping hops to serve (X-DVT-Tier front / t1),
+an always-big QoS tenant (X-DVT-Tenant) never leaving the big tier,
+/v1/models carrying the per-tier ``cascade`` block, a mid-load FRONT
+reload resetting ONLY hop 0 (hop 1's sample survives) then
+RE-calibrating, a mid-load MID reload resetting ONLY hop 1 (hop 0
+stays calibrated) — all with zero client errors — and every /metrics
+line parsing as prometheus text with the per-hop dvt_cascade_* series
+present (docs/SERVING.md "Cascaded serving").
+
+Lane 2 — a detect cascade (yolov3_toy:centernet_toy) with the
+Soft-NMS + per-class-K epilogue knobs on, proving the cascade routes
+non-classify verbs through the device-decoded signal.
+
 Run directly, not under pytest; chained into `make serve-smoke`."""
 
 import argparse
@@ -27,7 +37,8 @@ import numpy as np
 # as `python tests/cascade_smoke.py` from the checkout
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-FRONT, BIG = "lenet5", "lenet5_big"
+NANO, FRONT, BIG = "lenet5_nano", "lenet5", "lenet5_big"
+DET_FRONT, DET_BIG = "yolov3_toy", "centernet_toy"
 
 # prometheus text exposition: `name{labels} value` / `# HELP|TYPE ...`
 _METRIC_LINE = re.compile(
@@ -36,7 +47,7 @@ _METRIC_LINE = re.compile(
 
 def _args(workdir: str) -> argparse.Namespace:
     return argparse.Namespace(
-        model=None, models=f"{FRONT},{BIG}", workdir=workdir,
+        model=None, models=f"{NANO},{FRONT},{BIG}", workdir=workdir,
         stablehlo=None, host="127.0.0.1", port=0, max_batch=4,
         max_wait_ms=2.0, buckets=None, max_queue=64, warmup=True,
         verbose=False, pipeline_depth=2,
@@ -46,15 +57,39 @@ def _args(workdir: str) -> argparse.Namespace:
         serve_devices=1, shard_batches=False, wire_dtype="float32",
         infer_dtype="float32",
         # random-init tiers rarely agree, so the smoke calibrates on
-        # machinery, not quality: ANY observed agreement qualifies
-        cascade=f"{FRONT}:{BIG}", cascade_min_agreement=0.0,
-        cascade_sample_period=3, cascade_min_sample=10, cascade_topk=3,
-        # fast canary so the mid-load reload promotes in seconds
+        # machinery, not quality: ANY observed agreement qualifies.
+        # min_sample=6 lets the starved MIDDLE hop (it only sees
+        # traffic while hop 0 is uncalibrated) reach calibration
+        cascade=f"{NANO}:{FRONT}:{BIG}", cascade_min_agreement=0.0,
+        cascade_sample_period=3, cascade_min_sample=6, cascade_topk=3,
+        cascade_quant_front=True,
+        # fast canary so the mid-load reloads promote in seconds; the
+        # phase timeout stays under the client HTTP timeout so a
+        # starved canary resolves instead of hanging wait=True
         hbm_budget_mb=0.0, canary_frac=0.5, canary_min_requests=3,
         canary_max_error_rate=1.0, canary_max_p99_ratio=50.0,
-        shadow_frac=0.0, phase_timeout_s=60.0,
+        shadow_frac=0.0, phase_timeout_s=20.0,
         qos=("premium:rate=0,always_big=1,tenants=acme;"
              "standard:rate=0;default=standard"))
+
+
+def _detect_args(workdir: str) -> argparse.Namespace:
+    return argparse.Namespace(
+        model=None, models=f"{DET_FRONT},{DET_BIG}", workdir=workdir,
+        stablehlo=None, host="127.0.0.1", port=0, max_batch=2,
+        max_wait_ms=2.0, buckets=None, max_queue=64, warmup=True,
+        verbose=False, pipeline_depth=2,
+        faults="compute:exception:times=1", fault_seed=0,
+        serve_devices=1, shard_batches=False, wire_dtype="float32",
+        infer_dtype="float32",
+        cascade=f"{DET_FRONT}:{DET_BIG}", cascade_min_agreement=0.0,
+        cascade_sample_period=3, cascade_min_sample=6, cascade_topk=4,
+        # the detect epilogue variants ride the same CLI wiring
+        detect_soft_nms="gaussian", detect_soft_sigma=0.5,
+        detect_max_per_class=2,
+        hbm_budget_mb=0.0, canary_frac=0.5, canary_min_requests=3,
+        canary_max_error_rate=1.0, canary_max_p99_ratio=50.0,
+        shadow_frac=0.0, phase_timeout_s=60.0)
 
 
 def _get(base: str, path: str):
@@ -62,12 +97,13 @@ def _get(base: str, path: str):
         return r.status, json.loads(r.read())
 
 
-def _post(base: str, path: str, payload: dict, headers: dict = None):
+def _post(base: str, path: str, payload: dict, headers: dict = None,
+          timeout: float = 60):
     hdrs = {"Content-Type": "application/json"}
     hdrs.update(headers or {})
     req = urllib.request.Request(
         base + path, data=json.dumps(payload).encode(), headers=hdrs)
-    with urllib.request.urlopen(req, timeout=60) as r:
+    with urllib.request.urlopen(req, timeout=timeout) as r:
         return r.status, json.loads(r.read()), dict(r.headers)
 
 
@@ -87,6 +123,20 @@ def _wait_for(what: str, predicate, deadline_s: float = 60.0):
     raise AssertionError(f"timed out after {deadline_s}s waiting for {what}")
 
 
+def _check_metrics(base: str, required: tuple) -> str:
+    """Every /metrics line must parse; the named series must exist."""
+    with urllib.request.urlopen(base + "/metrics", timeout=60) as r:
+        text = r.read().decode()
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        assert _METRIC_LINE.match(ln), f"unparseable metric: {ln!r}"
+        float(ln.rsplit(" ", 1)[1])  # value must be a number
+    for series in required:
+        assert series in text, f"missing {series} in /metrics"
+    return text
+
+
 def smoke(workdir: str) -> None:
     from deep_vision_tpu.cli.serve import build_server
 
@@ -97,16 +147,30 @@ def smoke(workdir: str) -> None:
     imgs = [rng.uniform(0.0, 1.0, (32, 32, 1)).tolist()
             for _ in range(8)]
     try:
-        # -- fail closed: uncalibrated router serves everything big ---
+        # -- fail closed: uncalibrated chain serves everything big ----
         cas = _cascade_stats(base)
-        assert cas["calibrated"] is False and cas["threshold"] is None, cas
+        assert cas["tiers"] == [NANO, FRONT, BIG], cas["tiers"]
+        assert len(cas["hops"]) == 2, cas["hops"]
+        assert all(h["threshold"] is None for h in cas["hops"]), cas
         s, out, hdrs = _post(base, f"/v1/models/{BIG}/classify",
                              {"pixels": imgs[0]})
         assert s == 200 and out["top"], out
         assert hdrs.get("X-DVT-Tier") == "big", hdrs
 
+        # -- /v1/models: every chain member carries its cascade block -
+        _, models = _get(base, "/v1/models")
+        entries = models["models"]
+        assert entries[NANO]["cascade"]["role"] == "front"
+        assert entries[NANO]["cascade"]["hop"] == 0
+        assert entries[NANO]["model"]["infer_dtype"] == "int8", \
+            entries[NANO]["model"]  # --cascade-quant-front
+        assert entries[FRONT]["cascade"]["role"] == "mid"
+        assert entries[FRONT]["cascade"]["hop"] == 1
+        assert entries[BIG]["cascade"]["role"] == "big"
+
         # -- hammer the big model's route; every failure is a bug -----
-        errors, served, tiers = [], [0], {"front": 0, "big": 0}
+        errors, served = [], [0]
+        tiers = {"front": 0, "t1": 0, "big": 0}
         stop = threading.Event()
         lock = threading.Lock()
 
@@ -120,30 +184,49 @@ def smoke(workdir: str) -> None:
                         {"pixels": imgs[i % len(imgs)]})
                     assert s == 200 and out["top"], out
                     tier = hdrs.get("X-DVT-Tier")
-                    assert tier in ("front", "big"), hdrs
+                    assert tier in tiers, hdrs
                     with lock:
                         served[0] += 1
                         tiers[tier] += 1
                 except Exception as e:  # noqa: BLE001 — any failure is a lost request
                     errors.append(repr(e))
 
+        def direct_hammer():
+            # paced direct-route traffic on the MIDDLE tier: once the
+            # chain calibrates, almost nothing reaches lenet5 through
+            # the router, and its reload canary would starve without
+            # its own route carrying requests
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    s, out, _ = _post(
+                        base, f"/v1/models/{FRONT}/classify",
+                        {"pixels": imgs[i % len(imgs)]})
+                    assert s == 200 and out["top"], out
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+                time.sleep(0.05)
+
         threads = [threading.Thread(target=hammer, daemon=True)
-                   for _ in range(3)]
+                   for _ in range(2)]
+        threads.append(threading.Thread(target=direct_hammer,
+                                        daemon=True))
         for t in threads:
             t.start()
 
-        # dual-run sampling calibrates the threshold under live load
+        # dual-run sampling calibrates hop 0 under live load
         cas = _wait_for(
-            "threshold calibration from dual-run samples",
-            lambda: (lambda c: c if c["calibrated"] else None)(
-                _cascade_stats(base)))
-        assert cas["samples"] >= 10 and cas["calibrations"] >= 1, cas
+            "hop 0 calibration from dual-run samples",
+            lambda: (lambda c: c if c["hops"][0]["calibrated"]
+                     else None)(_cascade_stats(base)))
+        assert cas["samples"] >= 6 and cas["calibrations"] >= 1, cas
         # min_agreement=0 calibrates at the lowest POPULATED bin, so
-        # the front tier now answers confident traffic directly
+        # the int8 front tier now answers confident traffic directly
         _wait_for("front tier serving past calibration",
                   lambda: tiers["front"] or None)
 
-        # -- always-big tenant: premium QoS never sees the front ------
+        # -- always-big tenant: premium QoS never leaves the big tier -
         for _ in range(5):
             s, out, hdrs = _post(base, f"/v1/models/{BIG}/classify",
                                  {"pixels": imgs[0]},
@@ -152,58 +235,158 @@ def smoke(workdir: str) -> None:
         cas = _cascade_stats(base)
         assert cas["forced_big"] >= 5, cas
 
-        # the FRONT tier still answers its own direct route, epilogue
-        # and all (dict rows respond identically to dense ones)
-        s, out, hdrs = _post(base, f"/v1/models/{FRONT}/classify",
+        # the NANO tier still answers its own direct route, int8
+        # weights and all (the cascade serves the BIG name only)
+        s, out, hdrs = _post(base, f"/v1/models/{NANO}/classify",
                              {"pixels": imgs[0]})
         assert s == 200 and out["top"], out
-        assert "X-DVT-Tier" not in hdrs, hdrs  # cascade serves BIG only
+        assert "X-DVT-Tier" not in hdrs, hdrs
 
-        # -- mid-load front-tier reload: reset, then REcalibrate ------
+        # -- mid-load FRONT reload: hop 0 resets ALONE, hop 1's -------
+        # sample survives, and the pass-through traffic while hop 0
+        # recalibrates feeds hop 1 to ITS calibration
+        hop1_samples = cas["hops"][1]["samples"]
         resets_before = cas["resets"]
-        errors_before = len(errors)
-        s, out, _ = _post(base, f"/v1/models/{FRONT}/reload",
-                          {"force": True, "wait": True})
+        s, out, _ = _post(base, f"/v1/models/{NANO}/reload",
+                          {"force": True, "wait": True}, timeout=300)
         assert s == 200, out
         cas = _wait_for(
-            "cascade reset after front reload",
+            "hop 0 reset after front reload",
             lambda: (lambda c: c if c["resets"] > resets_before
                      else None)(_cascade_stats(base)))
+        assert cas["hops"][1]["samples"] >= hop1_samples, \
+            (cas["hops"], hop1_samples)  # per-hop reset: hop 1 kept
         cas = _wait_for(
-            "recalibration after front reload",
+            "hop 0 recalibration + hop 1 calibration after reload",
             lambda: (lambda c: c
-                     if c["calibrated"] and c["calibrations"] >= 2
+                     if c["hops"][0]["calibrated"]
+                     and c["hops"][1]["calibrated"]
+                     and c["calibrations"] >= 2
                      else None)(_cascade_stats(base)))
+        # while hop 0 was uncalibrated its traffic escalated THROUGH
+        # to the now-calibrated middle tier, which served some of it
+        _wait_for("middle tier serving (X-DVT-Tier: t1)",
+                  lambda: tiers["t1"] or None)
+
+        # -- mid-load MID reload: hop 1 resets ALONE ------------------
+        resets_before = cas["resets"]
+        s, out, _ = _post(base, f"/v1/models/{FRONT}/reload",
+                          {"force": True, "wait": True}, timeout=300)
+        assert s == 200, out
+        cas = _wait_for(
+            "hop 1 reset after mid reload",
+            lambda: (lambda c: c if c["resets"] > resets_before
+                     else None)(_cascade_stats(base)))
+        assert cas["hops"][0]["calibrated"], cas["hops"]  # hop 0 kept
+        assert cas["hops"][1]["threshold"] is None, cas["hops"]
+
         stop.set()
         for t in threads:
             t.join(timeout=10)
-        assert len(errors) == errors_before == 0, errors[:5]
-        assert served[0] > 0 and tiers["front"] > 0, (served, tiers)
+        assert len(errors) == 0, errors[:5]
+        assert served[0] > 0 and tiers["front"] > 0 and tiers["t1"] > 0, \
+            (served, tiers)
 
-        # -- /metrics: every line parses; cascade series present ------
-        with urllib.request.urlopen(base + "/metrics", timeout=60) as r:
-            text = r.read().decode()
-        for ln in text.splitlines():
-            if not ln or ln.startswith("#"):
-                continue
-            assert _METRIC_LINE.match(ln), f"unparseable metric: {ln!r}"
-            float(ln.rsplit(" ", 1)[1])  # value must be a number
-        for series in ("dvt_cascade_requests_total",
-                       "dvt_cascade_escalations_total",
-                       "dvt_cascade_threshold",
-                       "dvt_cascade_calibrated",
-                       "dvt_cascade_calibration_samples_total",
-                       "dvt_cascade_forced_big_total",
-                       "dvt_cascade_recalibrations_total",
-                       "dvt_cascade_latency_seconds"):
-            assert series in text, f"missing {series} in /metrics"
-        print(f"cascade-smoke PASS: {served[0]} requests "
-              f"(front {tiers['front']}, big {tiers['big']}), 0 errors "
-              f"through a fault-injected mid-load front reload; "
-              f"threshold {cas['threshold']:.2f} recalibrated "
+        # -- /metrics: every line parses; per-hop series present ------
+        text = _check_metrics(base, (
+            "dvt_cascade_requests_total",
+            "dvt_cascade_escalations_total",
+            "dvt_cascade_threshold",
+            'hop="0"',  # per-hop labels (alphabetical label order)
+            "dvt_cascade_hop_agreement",
+            "dvt_cascade_hop_escalations_total",
+            "dvt_cascade_calibrated",
+            "dvt_cascade_calibration_samples_total",
+            "dvt_cascade_forced_big_total",
+            "dvt_cascade_recalibrations_total",
+            "dvt_cascade_latency_seconds"))
+        assert 'tier="t1"' in text, "missing mid-tier labels in /metrics"
+        print(f"cascade-smoke PASS (classify): {served[0]} requests "
+              f"(front {tiers['front']}, t1 {tiers['t1']}, "
+              f"big {tiers['big']}), 0 errors through a fault-injected "
+              f"3-tier int8-fronted chain with mid-load front AND mid "
+              f"reloads; per-hop resets/recalibrations verified "
               f"({cas['calibrations']} calibrations, {cas['resets']} "
-              f"resets); always-big tenant pinned to the big tier; "
-              f"all /metrics lines parsed from port {server.port}")
+              f"resets); always-big tenant pinned; all /metrics lines "
+              f"parsed from port {server.port}")
+    finally:
+        server.shutdown()
+        plane.stop(drain_deadline=5.0)
+
+
+def detect_smoke(workdir: str) -> None:
+    """Lane 2: the cascade routes the detect verb on device-decoded
+    rows (valid-count + max-score signal, greedy-IoU agreement), with
+    the Soft-NMS/per-class-K epilogue knobs live."""
+    from deep_vision_tpu.cli.serve import build_server
+
+    plane, server = build_server(_detect_args(workdir))
+    server.start_background()
+    base = f"http://{server.host}:{server.port}"
+    rng = np.random.default_rng(1)
+    imgs = [rng.uniform(0.0, 1.0, (64, 64, 3)).tolist()
+            for _ in range(4)]
+    try:
+        cas = _cascade_stats(base)
+        assert cas["tiers"] == [DET_FRONT, DET_BIG], cas["tiers"]
+        s, out, hdrs = _post(base, f"/v1/models/{DET_BIG}/detect",
+                             {"pixels": imgs[0]})
+        assert s == 200 and "num_detections" in out, out
+        assert hdrs.get("X-DVT-Tier") == "big", hdrs
+
+        # the Soft-NMS knobs made it through the CLI to the epilogue
+        _, models = _get(base, "/v1/models")
+        entries = models["models"]
+        det = entries[DET_FRONT]["model"]["detect"]
+        assert det["soft_nms"] == "gaussian" and det["max_per_class"] == 2
+        assert entries[DET_FRONT]["cascade"]["role"] == "front"
+
+        errors, served, fronted = [], [0], [0]
+        stop = threading.Event()
+        lock = threading.Lock()
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    s, out, hdrs = _post(
+                        base, f"/v1/models/{DET_BIG}/detect",
+                        {"pixels": imgs[i % len(imgs)]})
+                    assert s == 200 and "num_detections" in out, out
+                    with lock:
+                        served[0] += 1
+                        if hdrs.get("X-DVT-Tier") == "front":
+                            fronted[0] += 1
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+
+        threads = [threading.Thread(target=hammer, daemon=True)
+                   for _ in range(2)]
+        for t in threads:
+            t.start()
+
+        cas = _wait_for(
+            "detect cascade calibration from device-decoded samples",
+            lambda: (lambda c: c if c["calibrated"] else None)(
+                _cascade_stats(base)), deadline_s=120.0)
+        assert cas["samples"] >= 6, cas
+        _wait_for("front detect tier serving",
+                  lambda: fronted[0] or None, deadline_s=120.0)
+
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert len(errors) == 0, errors[:5]
+
+        _check_metrics(base, ("dvt_cascade_requests_total",
+                              "dvt_cascade_threshold",
+                              "dvt_cascade_hop_agreement"))
+        print(f"cascade-smoke PASS (detect): {served[0]} requests "
+              f"({fronted[0]} served by the front detector), 0 errors; "
+              f"device-decoded signal calibrated the chain "
+              f"(threshold {cas['threshold']:.2f}) with gaussian "
+              f"Soft-NMS + per-class-K epilogues on port {server.port}")
     finally:
         server.shutdown()
         plane.stop(drain_deadline=5.0)
@@ -211,9 +394,13 @@ def smoke(workdir: str) -> None:
 
 def main():
     with tempfile.TemporaryDirectory() as workdir:
-        for name in (FRONT, BIG):
+        for name in (NANO, FRONT, BIG):
             os.makedirs(os.path.join(workdir, name), exist_ok=True)
         smoke(workdir)
+    with tempfile.TemporaryDirectory() as workdir:
+        for name in (DET_FRONT, DET_BIG):
+            os.makedirs(os.path.join(workdir, name), exist_ok=True)
+        detect_smoke(workdir)
     return 0
 
 

@@ -303,6 +303,260 @@ def test_respond_identical_for_escalated_and_big_only():
     assert a == b
 
 
+# -- N-tier chains ---------------------------------------------------------
+
+
+def _mid_row(cls=3, prob=0.9):
+    return _front_row(cls=cls, prob=prob)
+
+
+def _router3(rows, *, delay_s=0.0, thresholds=(None, None), **spec_kw):
+    """3-tier small:mid:large router over a FakePlane; ``thresholds``
+    seeds hop 0 / hop 1 calibrations directly."""
+    spec_kw.setdefault("sample_period", 1000)
+    spec = CascadeSpec("small", "mid", "large", **spec_kw)
+    plane = FakePlane(dict(rows), delay_s=delay_s)
+    router = CascadeRouter(plane, spec)
+    for hop, thr in zip(router.hops, thresholds):
+        if thr is not None:
+            for _ in range(max(spec.min_sample, 1)):
+                hop.hist.record(thr, True)
+            router._recalibrate(hop)
+            assert hop.threshold is not None
+    return router, plane
+
+
+def test_three_tier_tokens_and_mid_serving():
+    """A calibrated middle hop answers with token "t1"; hop 0 low
+    confidence escalates one hop, not straight to big."""
+    router, plane = _router3(
+        {"small": _front_row(prob=0.2), "mid": _mid_row(prob=0.9),
+         "large": _big_row()},
+        thresholds=(0.5, 0.5))
+    tier, row = router.infer(np.zeros((4, 4, 1), np.float32))
+    assert tier == "t1" and isinstance(row, dict)
+    assert [name for name, _ in plane.calls] == ["small", "mid"]
+    st = router.stats()
+    assert st["served"] == {"front": 0, "t1": 1, "big": 0}
+    assert st["tiers"] == ["small", "mid", "large"]
+    assert [h["token"] for h in st["hops"]] == ["front", "t1"]
+
+
+def test_uncalibrated_hop_escalates_through_without_running_tier():
+    """Fail closed per hop: an uncalibrated middle hop is SKIPPED — its
+    tier never runs, the request proceeds down the chain."""
+    router, plane = _router3(
+        {"small": _front_row(prob=0.2), "mid": _mid_row(prob=0.99),
+         "large": _big_row()},
+        thresholds=(0.5, None))
+    tier, row = router.infer(np.zeros((4, 4, 1), np.float32))
+    assert tier == "big"
+    assert [name for name, _ in plane.calls] == ["small", "large"]
+    assert row.tobytes() == plane.rows["large"].tobytes()
+
+    # fully uncalibrated chain: only big runs
+    router2, plane2 = _router3(
+        {"small": _front_row(), "mid": _mid_row(),
+         "large": _big_row()})
+    tier, _ = router2.infer(np.zeros((4, 4, 1), np.float32))
+    assert tier == "big"
+    assert [name for name, _ in plane2.calls] == ["large"]
+
+
+def test_twice_escalated_request_never_exceeds_original_budget():
+    """Satellite: a request escalated through BOTH cheap tiers submits
+    to each next tier with strictly shrinking remainders of its ONE
+    original deadline — and sheds when the chain eats the budget."""
+    router, plane = _router3(
+        {"small": _front_row(prob=0.1), "mid": _mid_row(prob=0.1),
+         "large": _big_row()},
+        thresholds=(0.5, 0.5), delay_s=0.02)
+    tier, _ = router.infer(np.zeros((4, 4, 1), np.float32),
+                           deadline_ms=500.0)
+    assert tier == "big"
+    (n0, d0), (n1, d1), (n2, d2) = plane.calls
+    assert (n0, d0) == ("small", 500.0)  # hop 0 sees the EXACT budget
+    assert n1 == "mid" and n2 == "large"
+    # each hop burned >= 20ms of the same 500ms budget
+    assert 0.0 < d2 < d1 <= 500.0 - 20.0
+    assert d2 <= 500.0 - 40.0
+    assert router.stats()["escalations"] == 2
+
+    # budget dies mid-chain: big is never submitted, the client gets a
+    # deadline Shed
+    plane.calls.clear()
+    tier, row = router.infer(np.zeros((4, 4, 1), np.float32),
+                             deadline_ms=30.0)
+    assert tier == "big" and isinstance(row, Shed)
+    assert row.reason == "deadline"
+    assert [name for name, _ in plane.calls] == ["small", "mid"]
+    assert router.stats()["escalated_shed"] == 1
+
+
+def test_version_swap_resets_only_its_hop_big_resets_all():
+    """A mid-tier swap drops hop 1's calibration only; a big swap drops
+    every hop (big is every hop's comparison target)."""
+    router, plane = _router3(
+        {"small": _front_row(), "mid": _mid_row(), "large": _big_row()},
+        thresholds=(0.5, 0.7))
+    plane.listeners[0]("mid")
+    assert router.hops[0].threshold is not None
+    assert router.hops[1].threshold is None
+    # re-seed hop 1, then swap big: both hops drop
+    for _ in range(200):
+        router.hops[1].hist.record(0.7, True)
+    router._recalibrate(router.hops[1])
+    plane.listeners[0]("large")
+    assert router.hops[0].threshold is None
+    assert router.hops[1].threshold is None
+
+
+def test_ledger_roundtrip_and_any_tier_digest_rejection(tmp_path):
+    """Satellite: the ledger key covers ALL tier digests — a restore
+    adopts a hop's calibration only when EVERY live tier matches, so a
+    mid-tier reload while down rejects the record."""
+
+    class DigestPlane(FakePlane):
+        def __init__(self, rows, digests):
+            super().__init__(rows)
+            self.digests = digests
+
+        def resolve(self, name):
+            m = type("M", (), {})()
+            m.params_digest = self.digests[name]
+            return m
+
+    rows = {"small": _front_row(), "mid": _mid_row(),
+            "large": _big_row()}
+    digests = {"small": "d0", "mid": "d1", "large": "d2"}
+    spec = CascadeSpec("small", "mid", "large", sample_period=1000,
+                       min_sample=10)
+    plane = DigestPlane(rows, dict(digests))
+    router = CascadeRouter(plane, spec, root=str(tmp_path))
+    assert router.params_digest() == "d0+d1+d2"
+    for _ in range(10):
+        router.hops[0].hist.record(0.8, True)
+    router._recalibrate(router.hops[0])
+    for _ in range(10):
+        router.hops[1].hist.record(0.6, True)
+    router._recalibrate(router.hops[1])
+
+    # same digests: both hops restore, thresholds re-derived
+    r2 = CascadeRouter(DigestPlane(rows, dict(digests)), spec,
+                       root=str(tmp_path))
+    assert r2.restored is True
+    assert r2.hops[0].threshold == pytest.approx(0.8)
+    assert r2.hops[1].threshold == pytest.approx(0.6)
+
+    # ONE tier (the middle one) reloaded while down: every hop's
+    # record is stale — nothing restores
+    changed = dict(digests, mid="d1-reloaded")
+    r3 = CascadeRouter(DigestPlane(rows, changed), spec,
+                       root=str(tmp_path))
+    assert r3.restored is False
+    assert r3.hops[0].threshold is None
+    assert r3.hops[1].threshold is None
+
+    # a persisted reset for one hop wins over its older calibration
+    router._on_version_swap("mid")
+    r4 = CascadeRouter(DigestPlane(rows, dict(digests)), spec,
+                       root=str(tmp_path))
+    assert r4.hops[0].threshold == pytest.approx(0.8)
+    assert r4.hops[1].threshold is None
+
+
+def test_per_class_thresholds_and_fail_closed_class():
+    """Per-class axis: a class with its own qualifying sample uses its
+    own threshold; a measured-bad class fails CLOSED (escalates at any
+    confidence) instead of riding the pooled threshold."""
+    router, plane = _router(
+        {"small": _front_row(cls=3, prob=0.9), "large": _big_row()},
+        per_class=True, class_min_sample=20, min_sample=20,
+        min_agreement=0.9)
+    hop = router.hops[0]
+    # class 3 agrees from 0.62 up; class 1 NEVER agrees; class 7 thin
+    for _ in range(30):
+        hop.hist.record(0.62, True, cls=3)
+    for _ in range(30):
+        hop.hist.record(0.9, False, cls=1)
+    for _ in range(5):
+        hop.hist.record(0.9, True, cls=7)
+    router._recalibrate()
+    assert hop.class_thresholds[3] == pytest.approx(0.60)
+    assert hop.class_thresholds[1] is None  # fail-closed class
+    assert 7 not in hop.class_thresholds    # thin → pooled fallback
+
+    # class 3 at 0.9: served by the front tier
+    tier, _ = router.infer(np.zeros((4, 4, 1), np.float32))
+    assert tier == "front"
+    # class 1 at 0.9 (above any pooled threshold): still escalates
+    plane.rows["small"] = _front_row(cls=1, prob=0.97)
+    tier, _ = router.infer(np.zeros((4, 4, 1), np.float32))
+    assert tier == "big"
+    st = router.stats()
+    assert st["hops"][0]["class_thresholds"]["3"] == pytest.approx(0.6)
+
+
+def test_detect_cascade_rule_signal_and_agreement():
+    """The detect rule: confidence = best valid device-decoded score,
+    class = its label; agreement = the greedy-IoU verdict; decoded-row
+    shape errors are (None, None) → escalate."""
+    from deep_vision_tpu.serve.workloads import DetectWorkload
+
+    rule = DetectWorkload().cascade_rule()
+
+    def det_row(scores, classes, boxes=None):
+        k = len(scores)
+        b = boxes if boxes is not None else \
+            np.tile(np.array([0.1, 0.1, 0.3, 0.3], np.float32), (k, 1))
+        return {"boxes": np.asarray(b, np.float32),
+                "scores": np.asarray(scores, np.float32),
+                "classes": np.asarray(classes, np.int64),
+                "valid": (np.asarray(scores) > 0).astype(np.float32)}
+
+    cls, conf = rule.signal(det_row([0.9, 0.4, 0.0], [2, 5, 0]))
+    assert cls == 2 and conf == pytest.approx(0.9)
+    # empty detection is a SIGNAL (confidently nothing), not an error
+    cls, conf = rule.signal(det_row([0.0, 0.0], [0, 0]))
+    assert cls is None and conf == 0.0
+    # a dense (non-decoded) row has no signal: escalate
+    assert rule.signal(np.zeros((13, 13, 18))) == (None, None)
+
+    a = det_row([0.9], [2])
+    assert rule.agree(a, a) is True
+    far = det_row([0.9], [2],
+                  boxes=[[0.7, 0.7, 0.9, 0.9]])
+    assert rule.agree(a, far) is False
+
+
+def test_inner_hop_calibrates_against_final_tier():
+    """Each hop dual-runs its OWN tier against the final tier on the
+    traffic that reaches it: a front tier the big model keeps
+    contradicting never calibrates (fail-closed), while the middle
+    tier calibrates on the escalated-through stream and starts
+    serving."""
+    router, plane = _router3(
+        {"small": _front_row(cls=2, prob=0.97),   # big says 3: disagree
+         "mid": _mid_row(cls=3, prob=0.97),       # agrees with big
+         "large": _big_row(cls=3)},
+        sample_period=2, min_sample=3, min_agreement=0.9)
+    x = np.zeros((4, 4, 1), np.float32)
+    tiers = [router.infer(x)[0] for _ in range(20)]
+    st = router.stats()
+    # hop 0 ticks every request, sampling half of it — and every
+    # sample disagrees, so it stays uncalibrated
+    assert st["hops"][0]["samples"] == 10
+    assert not st["hops"][0]["calibrated"]
+    assert st["hops"][0]["agreement"] == pytest.approx(0.0)
+    # the other half escalates THROUGH to hop 1, which samples ITS
+    # even ticks against big, calibrates, and begins serving "t1"
+    assert st["hops"][1]["samples"] == 5
+    assert st["hops"][1]["calibrated"]
+    assert st["served"]["t1"] >= 1 and "t1" in tiers
+    # nothing was ever answered by the measured-bad front tier
+    assert st["served"]["front"] == 0
+
+
 # -- real plane ------------------------------------------------------------
 
 

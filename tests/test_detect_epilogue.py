@@ -11,6 +11,9 @@ the dense multi-scale pyramid.  Covered here:
     asserted from the engine's ``d2h_bytes_by_bucket`` counters;
   * trim-by-valid ``respond``: ``num_detections``, no padded/invalid
     rows, >= semantics at the threshold edge, empty-image answers;
+  * Soft-NMS (gaussian/linear decay) + per-class K suppression
+    variants: ops/boxes unit semantics, epilogue-vs-host parity with
+    the knobs on, bit-identity of the hard path at default knobs;
   * CenterNet through the same hook (family-switched decode, NMS-free);
   * the detect shadow-agreement rule (greedy IoU≥0.5 class-matched
     pairing): perfect / shifted / class-swapped / empty pairs;
@@ -213,6 +216,158 @@ def test_class_wise_nms_suppresses_within_class_only():
     _, _, bv = batched_nms(boxes[None], scores[None], 3,
                            classes=mixed[None])
     assert bv.sum() == 3
+
+
+# -- Soft-NMS + per-class K (ops/boxes suppression variants) ----------------
+
+
+def _overlap_triplet():
+    """Two heavily-overlapping same-class boxes plus one far box."""
+    boxes = np.asarray([[0.1, 0.1, 0.5, 0.5],
+                        [0.12, 0.12, 0.5, 0.5],
+                        [0.7, 0.7, 0.9, 0.9]], np.float32)
+    scores = np.asarray([0.9, 0.8, 0.7], np.float32)
+    return boxes, scores
+
+
+def test_soft_nms_gaussian_decays_instead_of_killing():
+    """Gaussian Soft-NMS keeps the overlapping neighbour at a decayed
+    score exp(-iou²/σ) — the hard path drops it outright — and a
+    score floor above the decayed value still kills it."""
+    from deep_vision_tpu.ops.boxes import broadcast_iou
+
+    boxes, scores = _overlap_triplet()
+    iou01 = float(np.asarray(broadcast_iou(boxes, boxes))[0, 1])
+    assert iou01 > 0.5
+
+    _, hard_sel, hard_valid = nms_single(boxes, scores, 3)
+    assert hard_valid.sum() == 2  # box 1 suppressed
+
+    idx, sel, valid = nms_single(boxes, scores, 3, soft="gaussian",
+                                 soft_sigma=0.5)
+    assert valid.sum() == 3  # everyone survives, reordered by decay
+    expect = 0.8 * np.exp(-(iou01 ** 2) / 0.5)
+    order = {int(i): float(s) for i, s in zip(np.asarray(idx),
+                                              np.asarray(sel))}
+    assert order[0] == pytest.approx(0.9)
+    assert order[2] == pytest.approx(0.7)      # iou 0 → no decay
+    assert order[1] == pytest.approx(expect, abs=1e-5)
+    # decay reorders: the far 0.7 box now outranks the decayed one
+    assert list(np.asarray(idx)) == [0, 2, 1]
+
+    # a floor above the decayed score kills the neighbour after all
+    _, _, v_floor = nms_single(boxes, scores, 3, soft="gaussian",
+                               soft_sigma=0.5,
+                               score_threshold=expect + 0.05)
+    assert v_floor.sum() == 2
+
+
+def test_soft_nms_linear_and_off_and_validation():
+    from deep_vision_tpu.ops.boxes import broadcast_iou
+
+    boxes, scores = _overlap_triplet()
+    iou01 = float(np.asarray(broadcast_iou(boxes, boxes))[0, 1])
+
+    idx, sel, valid = nms_single(boxes, scores, 3, soft="linear")
+    assert valid.sum() == 3
+    order = {int(i): float(s) for i, s in zip(np.asarray(idx),
+                                              np.asarray(sel))}
+    # linear decay only applies past the IoU threshold: (1 - iou)·s
+    assert order[1] == pytest.approx(0.8 * (1.0 - iou01), abs=1e-5)
+    assert order[2] == pytest.approx(0.7)
+
+    # soft="off" is the bit-identical hard path (the default)
+    for a, b in zip(nms_single(boxes, scores, 3),
+                    nms_single(boxes, scores, 3, soft="off")):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    with pytest.raises(ValueError, match="soft"):
+        nms_single(boxes, scores, 3, soft="sigmoid")
+
+
+def test_per_class_k_caps_within_class_only():
+    """max_per_class keeps each class's top-K VALID boxes: a crowd of
+    one class cannot monopolize the fixed epilogue rows, other classes
+    are untouched."""
+    # four disjoint boxes: three of class 0 (crowding), one of class 1
+    boxes = np.asarray([[0.0, 0.0, 0.2, 0.2],
+                        [0.3, 0.3, 0.5, 0.5],
+                        [0.6, 0.6, 0.8, 0.8],
+                        [0.0, 0.6, 0.2, 0.8]], np.float32)
+    scores = np.asarray([0.9, 0.8, 0.7, 0.6], np.float32)
+    classes = np.asarray([0, 0, 0, 1], np.int32)
+
+    _, _, v_uncapped = nms_single(boxes, scores, 4, classes=classes)
+    assert v_uncapped.sum() == 4
+
+    idx, sel, valid = nms_single(boxes, scores, 4, classes=classes,
+                                 max_per_class=2)
+    kept = {int(i) for i, v in zip(np.asarray(idx), np.asarray(valid))
+            if v > 0}
+    # class 0 keeps its best two (0.9, 0.8); the 0.7 third is cut;
+    # class 1's only box rides along
+    assert kept == {0, 1, 3}
+    # invalidated rows zero their score too
+    assert float(np.asarray(sel)[np.asarray(idx) == 2][0]) == 0.0
+
+    # cap without classes is a no-op (nothing to group by)
+    _, _, v_nocls = nms_single(boxes, scores, 4, max_per_class=2)
+    assert v_nocls.sum() == 4
+
+    # batched wrapper threads the cap
+    _, _, bv = batched_nms(boxes[None], scores[None], 4,
+                           classes=classes[None], max_per_class=2)
+    assert bv.sum() == 3
+
+
+def test_soft_nms_epilogue_vs_host_parity(yolo_serving):
+    """The fused epilogue honours the suppression knobs: device rows
+    with gaussian Soft-NMS + per-class K match host ``postprocess``
+    with the same knobs, and knobs at their defaults stay bit-identical
+    to the baseline program."""
+    import jax
+
+    from deep_vision_tpu.tasks.detection import postprocess
+
+    _, sm = yolo_serving
+    x = _images(2, 64)
+
+    sm_soft = copy.copy(sm)
+    sm_soft.detect_soft_nms = "gaussian"
+    sm_soft.detect_soft_sigma = 0.4
+    sm_soft.detect_max_per_class = 3
+    dev = jax.device_get(sm_soft.compile_bucket(2)(x))
+
+    pyr = jax.device_get(_host_view(sm).compile_bucket(2)(x))
+    boxes, scores, classes, valid = postprocess(
+        pyr, sm.num_classes, max_outputs=sm.detect_topk,
+        iou_threshold=sm.detect_iou_threshold,
+        score_threshold=sm.detect_score_threshold, class_aware=True,
+        soft_nms="gaussian", soft_sigma=0.4, max_per_class=3)
+    np.testing.assert_allclose(np.asarray(dev["boxes"]),
+                               np.asarray(boxes), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dev["scores"]),
+                               np.asarray(scores), atol=1e-5)
+    np.testing.assert_array_equal(np.asarray(dev["classes"]),
+                                  np.asarray(classes))
+    np.testing.assert_array_equal(np.asarray(dev["valid"]),
+                                  np.asarray(valid))
+
+    # default knobs ("off", K=0) leave the program bit-identical
+    sm_off = copy.copy(sm)
+    sm_off.detect_soft_nms = "off"
+    sm_off.detect_max_per_class = 0
+    base = jax.device_get(sm.compile_bucket(2)(x))
+    off = jax.device_get(sm_off.compile_bucket(2)(x))
+    for key in base:
+        np.testing.assert_array_equal(np.asarray(base[key]),
+                                      np.asarray(off[key]))
+
+    # describe() surfaces the knobs for operators
+    desc = sm_soft.describe()["detect"]
+    assert desc["soft_nms"] == "gaussian"
+    assert desc["soft_sigma"] == pytest.approx(0.4)
+    assert desc["max_per_class"] == 3
 
 
 # -- the ≥100× D2H gate at 416² --------------------------------------------
